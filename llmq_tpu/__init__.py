@@ -1,0 +1,22 @@
+"""llmq-tpu — a TPU-native, queue-based distributed LLM batch-inference framework.
+
+A ground-up rebuild of the capabilities of iPieter/llmq (reference:
+/root/reference/llmq) designed TPU-first:
+
+- The inference engine is implemented natively on JAX/XLA with Pallas TPU
+  kernels (paged KV-cache attention, flash prefill) instead of delegating to
+  vLLM's CUDA stack (reference: llmq/workers/vllm_worker.py).
+- Tensor/data parallelism runs over a ``jax.sharding.Mesh`` on the TPU ICI
+  fabric via ``NamedSharding``/``shard_map`` instead of NCCL.
+- Job distribution stays broker-mediated (reference: llmq/core/broker.py) but
+  ships self-contained broker implementations (in-memory, durable-file, TCP)
+  so no external RabbitMQ is required — while keeping the same durability,
+  ack/requeue, prefetch, and at-least-once semantics.
+
+Public API mirrors the reference's layering: core (models/config/broker),
+workers, engine, cli.
+"""
+
+from llmq_tpu._version import __version__
+
+__all__ = ["__version__"]

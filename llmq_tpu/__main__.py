@@ -1,0 +1,6 @@
+"""``python -m llmq_tpu`` → CLI entry point (reference: llmq/__main__.py:1-4)."""
+
+from llmq_tpu.cli.main import cli
+
+if __name__ == "__main__":
+    cli()

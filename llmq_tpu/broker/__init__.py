@@ -1,0 +1,23 @@
+"""Broker layer: durable job/result queues with at-least-once delivery.
+
+The reference delegates this layer to an external RabbitMQ process spoken to
+via aio-pika (``llmq/core/broker.py``). llmq-tpu keeps the same *semantics* —
+durable queues, per-consumer prefetch (QoS), ack / reject-requeue,
+``<q>.results`` and ``pipeline.<n>.<stage>`` topology, at-least-once delivery
+— but ships its own implementations selected by URL scheme:
+
+- ``memory://<ns>``  — in-process, for tests and single-process runs
+- ``file:///path``   — durable on-disk, multi-process on one node (atomic
+  rename as the claim primitive)
+- ``tcp://host:port`` — the llmq-tpu broker daemon (``llmq-tpu broker serve``)
+  for multi-host deployments
+- ``amqp://...``     — RabbitMQ passthrough when aio-pika is installed
+
+All implement the ``Broker`` interface in ``base.py``; the high-level facade
+used by workers/CLI is ``BrokerManager`` in ``manager.py``.
+"""
+
+from llmq_tpu.broker.base import Broker, DeliveredMessage, connect_broker
+from llmq_tpu.broker.manager import BrokerManager
+
+__all__ = ["Broker", "DeliveredMessage", "BrokerManager", "connect_broker"]

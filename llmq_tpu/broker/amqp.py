@@ -1,0 +1,146 @@
+"""RabbitMQ passthrough broker (optional).
+
+Kept for drop-in compatibility with reference deployments that already run a
+RabbitMQ (llmq/core/broker.py speaks AMQP via aio-pika). This module is only
+importable when ``aio_pika`` is installed; the rest of llmq-tpu never
+imports it unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
+from llmq_tpu.core.models import QueueStats
+
+try:
+    import aio_pika
+
+    HAVE_AIO_PIKA = True
+except ImportError:  # pragma: no cover - environment without aio-pika
+    aio_pika = None
+    HAVE_AIO_PIKA = False
+
+
+class AmqpBroker(Broker):
+    def __init__(self, url: str) -> None:
+        if not HAVE_AIO_PIKA:
+            raise ImportError(
+                "amqp:// broker URLs require the optional 'aio-pika' package; "
+                "use memory://, file://, or tcp:// (llmq-tpu broker daemon) "
+                "instead."
+            )
+        self.url = url
+        self._conn = None
+        self._channel = None
+        self._queues: Dict[str, object] = {}
+        self._consumers: Dict[str, object] = {}
+
+    async def connect(self) -> None:  # pragma: no cover - needs live RabbitMQ
+        self._conn = await aio_pika.connect_robust(self.url)
+        self._channel = await self._conn.channel()
+
+    async def close(self) -> None:  # pragma: no cover
+        if self._conn is not None:
+            await self._conn.close()
+        self._conn = None
+        self._channel = None
+
+    async def declare_queue(
+        self,
+        name: str,
+        *,
+        durable: bool = True,
+        ttl_ms: Optional[int] = None,
+        max_redeliveries: Optional[int] = None,
+    ) -> None:  # pragma: no cover
+        args = {}
+        if ttl_ms is not None:
+            args["x-message-ttl"] = ttl_ms
+        self._queues[name] = await self._channel.declare_queue(
+            name, durable=durable, arguments=args or None
+        )
+
+    async def publish(
+        self,
+        queue: str,
+        body: bytes,
+        *,
+        message_id: Optional[str] = None,
+        headers: Optional[Dict[str, object]] = None,
+    ) -> None:  # pragma: no cover
+        message = aio_pika.Message(
+            body=body,
+            message_id=message_id,
+            headers=headers or {},
+            delivery_mode=aio_pika.DeliveryMode.PERSISTENT,
+        )
+        await self._channel.default_exchange.publish(message, routing_key=queue)
+
+    async def consume(
+        self, queue: str, handler: MessageHandler, *, prefetch: int = 1
+    ) -> str:  # pragma: no cover
+        await self._channel.set_qos(prefetch_count=prefetch)
+        q = self._queues.get(queue) or await self._channel.declare_queue(
+            queue, durable=True
+        )
+
+        async def on_message(msg) -> None:
+            delivered = DeliveredMessage(
+                msg.body,
+                msg.message_id or "",
+                delivery_count=1 if msg.redelivered else 0,
+                headers=dict(msg.headers or {}),
+                _settle=_settler(msg),
+            )
+            await handler(delivered)
+
+        tag = await q.consume(on_message)
+        self._consumers[tag] = q
+        return tag
+
+    async def cancel(self, consumer_tag: str) -> None:  # pragma: no cover
+        q = self._consumers.pop(consumer_tag, None)
+        if q is not None:
+            await q.cancel(consumer_tag)
+
+    async def get(self, queue: str):  # pragma: no cover
+        q = self._queues.get(queue) or await self._channel.declare_queue(
+            queue, durable=True
+        )
+        msg = await q.get(fail=False)
+        if msg is None:
+            return None
+        return DeliveredMessage(
+            msg.body,
+            msg.message_id or "",
+            delivery_count=1 if msg.redelivered else 0,
+            headers=dict(msg.headers or {}),
+            _settle=_settler(msg),
+        )
+
+    async def stats(self, queue: str) -> QueueStats:  # pragma: no cover
+        q = await self._channel.declare_queue(queue, durable=True, passive=True)
+        return QueueStats(
+            queue_name=queue,
+            message_count=q.declaration_result.message_count,
+            consumer_count=q.declaration_result.consumer_count,
+            stats_source="amqp_fallback",
+        )
+
+    async def purge(self, queue: str) -> int:  # pragma: no cover
+        q = self._queues.get(queue) or await self._channel.declare_queue(
+            queue, durable=True
+        )
+        result = await q.purge()
+        return getattr(result, "message_count", 0)
+
+
+def _settler(msg):  # pragma: no cover
+    async def settle(verb: str, requeue: bool) -> None:
+        if verb == "ack":
+            await msg.ack()
+        else:
+            await msg.reject(requeue=requeue)
+
+    return settle

@@ -1,0 +1,215 @@
+"""Abstract broker interface + message envelope.
+
+Semantics contract (what every implementation must honor — mirrors what the
+reference relies on from RabbitMQ, SURVEY.md §1 L0):
+
+- **Durability**: published messages survive broker restart (for the
+  implementations that have a persistence story) and consumer churn.
+- **At-least-once**: a message is redelivered (to any consumer) if its
+  consumer disconnects or rejects with ``requeue=True`` before ack.
+- **Prefetch/QoS**: a consumer has at most ``prefetch`` unacked messages in
+  flight; this is the back-pressure mechanism that feeds continuous batching.
+- **Dead-lettering**: a message rejected-with-requeue more than
+  ``max_redeliveries`` times is routed to ``<queue>.failed`` instead of being
+  requeued forever (fixes the reference's retry-forever gap,
+  workers/base.py:245).
+- **TTL**: queues may declare a message TTL; expired messages are dropped at
+  dispatch time.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from llmq_tpu.core.models import QueueStats
+
+
+def new_message_id() -> str:
+    return uuid.uuid4().hex
+
+
+@dataclass
+class StoredMessage:
+    """Broker-side message record."""
+
+    body: bytes
+    message_id: str = field(default_factory=new_message_id)
+    headers: Dict[str, Any] = field(default_factory=dict)
+    delivery_count: int = 0
+    enqueued_at: float = field(default_factory=time.time)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "body": self.body.decode("utf-8"),
+                "message_id": self.message_id,
+                "headers": self.headers,
+                "delivery_count": self.delivery_count,
+                "enqueued_at": self.enqueued_at,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "StoredMessage":
+        d = json.loads(raw)
+        return cls(
+            body=d["body"].encode("utf-8"),
+            message_id=d["message_id"],
+            headers=d.get("headers", {}),
+            delivery_count=d.get("delivery_count", 0),
+            enqueued_at=d.get("enqueued_at", time.time()),
+        )
+
+
+class DeliveredMessage:
+    """A message as seen by a consumer; must be acked or rejected exactly once.
+
+    ``redelivered``/``delivery_count`` let workers implement poison-message
+    policies; the broker itself dead-letters past the redelivery cap.
+    """
+
+    def __init__(
+        self,
+        body: bytes,
+        message_id: str,
+        *,
+        delivery_count: int = 0,
+        headers: Optional[Dict[str, Any]] = None,
+        _settle: Optional[Callable[[str, bool], Awaitable[None]]] = None,
+    ) -> None:
+        self.body = body
+        self.message_id = message_id
+        self.delivery_count = delivery_count
+        self.headers = headers or {}
+        self._settle = _settle
+        self._settled = False
+
+    @property
+    def redelivered(self) -> bool:
+        return self.delivery_count > 0
+
+    async def ack(self) -> None:
+        await self._do_settle("ack", False)
+
+    async def reject(self, requeue: bool = False) -> None:
+        await self._do_settle("reject", requeue)
+
+    async def _do_settle(self, verb: str, requeue: bool) -> None:
+        if self._settled:
+            return
+        self._settled = True
+        if self._settle is not None:
+            await self._settle(verb, requeue)
+
+
+MessageHandler = Callable[[DeliveredMessage], Awaitable[None]]
+
+
+class Broker(abc.ABC):
+    """Transport-level broker API (one connection)."""
+
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    @abc.abstractmethod
+    async def declare_queue(
+        self,
+        name: str,
+        *,
+        durable: bool = True,
+        ttl_ms: Optional[int] = None,
+        max_redeliveries: Optional[int] = None,
+    ) -> None: ...
+
+    @abc.abstractmethod
+    async def publish(
+        self,
+        queue: str,
+        body: bytes,
+        *,
+        message_id: Optional[str] = None,
+        headers: Optional[Dict[str, Any]] = None,
+    ) -> None: ...
+
+    @abc.abstractmethod
+    async def consume(
+        self, queue: str, handler: MessageHandler, *, prefetch: int = 1
+    ) -> str:
+        """Start consuming; returns a consumer tag for ``cancel``."""
+
+    @abc.abstractmethod
+    async def cancel(self, consumer_tag: str) -> None: ...
+
+    @abc.abstractmethod
+    async def get(self, queue: str) -> Optional[DeliveredMessage]:
+        """Fetch a single message without starting a consumer (DLQ peek)."""
+
+    @abc.abstractmethod
+    async def stats(self, queue: str) -> QueueStats: ...
+
+    @abc.abstractmethod
+    async def purge(self, queue: str) -> int: ...
+
+    async def __aenter__(self) -> "Broker":
+        await self.connect()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+
+async def connect_broker(
+    url: str,
+    *,
+    retries: int = 5,
+    base_delay: float = 1.0,
+) -> Broker:
+    """Open a broker connection for ``url``, with exponential-backoff retry
+    (reference broker.py:27-49 behavior)."""
+    broker = make_broker(url)
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries):
+        try:
+            await broker.connect()
+            return broker
+        except Exception as exc:  # noqa: BLE001 — retrying any connect failure
+            last_exc = exc
+            if attempt < retries - 1:
+                await asyncio.sleep(base_delay * (2**attempt))
+    raise ConnectionError(
+        f"Could not connect to broker at {url!r} after {retries} attempts"
+    ) from last_exc
+
+
+def make_broker(url: str) -> Broker:
+    """Instantiate (without connecting) the implementation for a broker URL."""
+    scheme = url.split("://", 1)[0].lower() if "://" in url else ""
+    if scheme == "memory":
+        from llmq_tpu.broker.memory import MemoryBroker
+
+        return MemoryBroker(url)
+    if scheme == "file":
+        from llmq_tpu.broker.filebroker import FileBroker
+
+        return FileBroker(url)
+    if scheme == "tcp":
+        from llmq_tpu.broker.tcp import TcpBroker
+
+        return TcpBroker(url)
+    if scheme in ("amqp", "amqps"):
+        from llmq_tpu.broker.amqp import AmqpBroker
+
+        return AmqpBroker(url)
+    raise ValueError(
+        f"Unsupported broker URL scheme: {url!r} "
+        "(expected memory://, file://, tcp://, or amqp://)"
+    )

@@ -1,0 +1,236 @@
+"""High-level broker facade used by workers and the CLI.
+
+Counterpart of the reference's ``BrokerManager`` (``llmq/core/broker.py:18-353``):
+queue topology setup, job/result publish, pipeline stage routing, consume,
+stats, DLQ read, purge — but broker-implementation-agnostic (URL scheme
+selects memory/file/tcp/amqp).
+
+Pipeline routing fix (SURVEY.md §3.4): when a stage result hands off to the
+next stage, the *next stage's* prompt/messages template from the pipeline
+YAML is applied, with the previous output available as ``{result}`` alongside
+all passthrough extras. The reference only ever applied stage-1 templates.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional
+
+from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler, connect_broker
+from llmq_tpu.core.config import Config, get_config
+from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result
+from llmq_tpu.core.pipeline import PipelineConfig
+from llmq_tpu.core.template import resolve_template_string, resolve_template_value
+
+logger = logging.getLogger(__name__)
+
+RESULTS_SUFFIX = ".results"
+FAILED_SUFFIX = ".failed"
+
+
+def results_queue_name(queue: str) -> str:
+    return queue if queue.endswith(RESULTS_SUFFIX) else queue + RESULTS_SUFFIX
+
+
+class BrokerManager:
+    """One broker connection + the llmq queue topology conventions."""
+
+    def __init__(self, config: Optional[Config] = None, url: Optional[str] = None):
+        self.config = config or get_config()
+        self.url = url or self.config.broker_url
+        self._broker: Optional[Broker] = None
+
+    @property
+    def broker(self) -> Broker:
+        if self._broker is None:
+            raise RuntimeError("BrokerManager is not connected")
+        return self._broker
+
+    @property
+    def connected(self) -> bool:
+        return self._broker is not None
+
+    async def connect(self) -> None:
+        if self._broker is None:
+            self._broker = await connect_broker(self.url)
+            logger.debug("Connected to broker at %s", self.url)
+
+    async def disconnect(self) -> None:
+        if self._broker is not None:
+            await self._broker.close()
+            self._broker = None
+
+    async def __aenter__(self) -> "BrokerManager":
+        await self.connect()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.disconnect()
+
+    # --- topology ---------------------------------------------------------
+    async def setup_queue_infrastructure(self, queue: str) -> None:
+        """Declare ``<q>``, ``<q>.results``, ``<q>.failed`` (durable).
+
+        Reference broker.py:57-113; TTL from config is actually applied to
+        the job queue here (the reference never used its TTL setting).
+        """
+        await self.broker.declare_queue(
+            queue,
+            ttl_ms=self.config.job_ttl_ms,
+            max_redeliveries=self.config.max_redeliveries,
+        )
+        # Results are durable downloads: receivers may requeue (e.g. past a
+        # --limit) arbitrarily often without the message dead-lettering.
+        await self.broker.declare_queue(
+            results_queue_name(queue), max_redeliveries=1_000_000_000
+        )
+        await self.broker.declare_queue(queue + FAILED_SUFFIX)
+
+    async def setup_pipeline_infrastructure(self, pipeline: PipelineConfig) -> None:
+        """Declare every stage queue + the single final results queue."""
+        for qname in pipeline.stage_queue_names():
+            await self.broker.declare_queue(
+                qname,
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
+            await self.broker.declare_queue(qname + FAILED_SUFFIX)
+        await self.broker.declare_queue(pipeline.get_pipeline_results_queue_name())
+
+    # --- publish ----------------------------------------------------------
+    async def publish_job(self, queue: str, job: Job) -> None:
+        await self.broker.publish(
+            queue, job.model_dump_json().encode("utf-8"), message_id=job.id
+        )
+
+    async def publish_result(self, queue: str, result: Result) -> None:
+        await self.broker.publish(
+            results_queue_name(queue),
+            result.model_dump_json().encode("utf-8"),
+            message_id=result.id,
+        )
+
+    async def publish_pipeline_result(
+        self,
+        pipeline: PipelineConfig,
+        stage_name: str,
+        result: Result,
+    ) -> None:
+        """Route a stage result: final stage → results queue; otherwise build
+        the next stage's job (applying that stage's template) and publish it.
+        """
+        nxt = pipeline.next_stage(stage_name)
+        if nxt is None:
+            await self.broker.publish(
+                pipeline.get_pipeline_results_queue_name(),
+                result.model_dump_json().encode("utf-8"),
+                message_id=result.id,
+            )
+            return
+        job = self.build_next_stage_job(result, nxt)
+        await self.publish_job(pipeline.get_stage_queue_name(nxt.name), job)
+
+    @staticmethod
+    def build_next_stage_job(result: Result, next_stage) -> Job:
+        """Result → next stage Job, applying the next stage's own template.
+
+        Template variables available: every passthrough extra, plus
+        ``{result}`` (the previous stage's output) and ``{prompt}`` (the
+        previous stage's formatted prompt).
+        """
+        extras = {
+            k: v
+            for k, v in result.model_dump().items()
+            if k
+            not in {
+                "id",
+                "prompt",
+                "result",
+                "worker_id",
+                "duration_ms",
+                "timestamp",
+                "usage",
+            }
+        }
+        template_vars: Dict[str, Any] = {
+            **extras,
+            "result": result.result,
+            "prompt": result.prompt,
+        }
+        payload: Dict[str, Any] = {"id": result.id, **extras}
+        messages_tpl = next_stage.messages_template()
+        prompt_tpl = next_stage.prompt_template()
+        if messages_tpl is not None:
+            payload["messages"] = resolve_template_value(messages_tpl, template_vars)
+        elif prompt_tpl is not None:
+            payload["prompt"] = resolve_template_string(prompt_tpl, template_vars)
+        else:
+            # No template on the next stage: previous output becomes the
+            # prompt verbatim (reference behavior, broker.py:171-192).
+            payload["prompt"] = result.result
+        # Preserve the upstream output for later stages' templates.
+        payload.setdefault("previous_result", result.result)
+        return Job(**payload)
+
+    # --- consume ----------------------------------------------------------
+    async def consume_jobs(
+        self, queue: str, handler: MessageHandler, *, prefetch: Optional[int] = None
+    ) -> str:
+        return await self.broker.consume(
+            queue, handler, prefetch=prefetch or self.config.queue_prefetch
+        )
+
+    async def consume_results(
+        self, queue: str, handler: MessageHandler, *, prefetch: int = 100
+    ) -> str:
+        """Consume from a results queue; bare queue names get ``.results``
+        appended (reference broker.py:204-220)."""
+        qname = results_queue_name(queue)
+        if qname != queue:
+            await self.setup_queue_infrastructure(queue)
+        return await self.broker.consume(qname, handler, prefetch=prefetch)
+
+    async def cancel(self, consumer_tag: str) -> None:
+        await self.broker.cancel(consumer_tag)
+
+    # --- ops --------------------------------------------------------------
+    async def get_queue_stats(self, queue: str) -> QueueStats:
+        return await self.broker.stats(queue)
+
+    async def get_failed_jobs(
+        self, queue: str, limit: int = 10
+    ) -> List[ErrorInfo]:
+        """Peek the DLQ non-destructively (messages are requeued after read).
+
+        Reference broker.py:291-338 — but here the DLQ actually receives
+        messages (redelivery cap in the broker core).
+        """
+        dlq = queue + FAILED_SUFFIX
+        errors: List[ErrorInfo] = []
+        fetched: List[DeliveredMessage] = []
+        for _ in range(limit):
+            msg = await self.broker.get(dlq)
+            if msg is None:
+                break
+            fetched.append(msg)
+            try:
+                data = json.loads(msg.body.decode("utf-8"))
+            except json.JSONDecodeError:
+                data = {"id": msg.message_id}
+            errors.append(
+                ErrorInfo(
+                    job_id=str(data.get("id", msg.message_id)),
+                    error_message=str(
+                        msg.headers.get("x-error", "exceeded redelivery limit")
+                    ),
+                    worker_id=msg.headers.get("x-worker-id"),
+                    redeliveries=int(msg.headers.get("x-delivery-count", 0) or 0),
+                )
+            )
+        for msg in fetched:
+            await msg.reject(requeue=True)  # put back for later inspection
+        return errors
+
+    async def purge_queue(self, queue: str) -> int:
+        return await self.broker.purge(queue)

@@ -1,0 +1,1 @@
+"""Click CLI (reference: llmq/cli/). Entry: ``llmq-tpu`` / ``python -m llmq_tpu``."""

@@ -1,0 +1,262 @@
+"""CLI root & command definitions.
+
+Counterpart of reference ``llmq/cli/main.py:6-549``: ``submit``, ``receive``,
+``status``, ``health``, ``errors``, ``clear``, and the ``worker`` subgroup —
+plus the llmq-tpu-only ``broker`` subgroup (the reference assumed an external
+RabbitMQ; we ship the daemon).
+
+Heavy imports (jax, engine, submit machinery) are deferred into command
+bodies so ``--help`` stays instant (same lazy-import pattern as the
+reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+import click
+
+from llmq_tpu._version import __version__
+
+
+def _parse_maps(map_args: Tuple[str, ...]) -> dict:
+    """Parse repeated ``--map field=SPEC`` options (reference main.py:104-113)."""
+    from llmq_tpu.core.template import parse_map_spec
+
+    mapping = {}
+    for raw in map_args:
+        if "=" not in raw:
+            raise click.BadParameter(
+                f"--map must be field=TEMPLATE, got {raw!r}", param_hint="--map"
+            )
+        field, _, spec = raw.partition("=")
+        mapping[field.strip()] = parse_map_spec(spec)
+    return mapping
+
+
+@click.group()
+@click.version_option(version=__version__, prog_name="llmq-tpu")
+def cli() -> None:
+    """llmq-tpu: TPU-native queue-based LLM batch inference."""
+
+
+# ---------------------------------------------------------------------------
+# submit / receive
+# ---------------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("queue_or_pipeline")
+@click.argument("source")
+@click.option("--map", "map_args", multiple=True, help="field=TEMPLATE mapping")
+@click.option("-p", "--pipeline", "is_pipeline", is_flag=True, help="QUEUE arg is a pipeline YAML")
+@click.option("--stream", is_flag=True, help="Stream results to stdout while submitting")
+@click.option("--split", default="train", show_default=True, help="HF dataset split")
+@click.option("--subset", default=None, help="HF dataset subset/config name")
+@click.option("--limit", type=int, default=None, help="Submit at most N jobs")
+def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subset, limit):
+    """Submit jobs from a JSONL file, '-' (stdin), or an HF dataset.
+
+    QUEUE_OR_PIPELINE is a queue name, or with -p a pipeline YAML path.
+    """
+    from llmq_tpu.cli.submit import run_pipeline_submit, run_submit
+
+    mapping = _parse_maps(map_args)
+    if is_pipeline:
+        asyncio.run(
+            run_pipeline_submit(
+                queue_or_pipeline, source, mapping,
+                stream=stream, split=split, subset=subset, limit=limit,
+            )
+        )
+    else:
+        asyncio.run(
+            run_submit(
+                queue_or_pipeline, source, mapping,
+                stream=stream, split=split, subset=subset, limit=limit,
+            )
+        )
+
+
+@cli.command()
+@click.argument("queue_or_pipeline")
+@click.option("-p", "--pipeline", "is_pipeline", is_flag=True, help="Arg is a pipeline YAML")
+@click.option("--timeout", type=float, default=None, help="Idle timeout seconds (exit when no results)")
+@click.option("--limit", type=int, default=None, help="Stop after N results")
+def receive(queue_or_pipeline, is_pipeline, timeout, limit):
+    """Receive results as JSONL on stdout."""
+    from llmq_tpu.cli.receive import run_pipeline_receive, run_receive
+
+    if is_pipeline:
+        asyncio.run(run_pipeline_receive(queue_or_pipeline, timeout=timeout, limit=limit))
+    else:
+        asyncio.run(run_receive(queue_or_pipeline, timeout=timeout, limit=limit))
+
+
+# ---------------------------------------------------------------------------
+# monitoring / ops
+# ---------------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("queue", required=False)
+@click.option("-p", "--pipeline", "pipeline_path", default=None, help="Pipeline YAML to visualize")
+def status(queue, pipeline_path):
+    """Show connection, queue, or pipeline status."""
+    from llmq_tpu.cli.monitor import (
+        show_connection_status,
+        show_pipeline_status,
+        show_status,
+    )
+
+    if pipeline_path:
+        asyncio.run(show_pipeline_status(pipeline_path))
+    elif queue:
+        asyncio.run(show_status(queue))
+    else:
+        asyncio.run(show_connection_status())
+
+
+@cli.command()
+@click.argument("queue")
+def health(queue):
+    """Heuristic health check for a queue (consumers, backlog)."""
+    from llmq_tpu.cli.monitor import check_health
+
+    asyncio.run(check_health(queue))
+
+
+@cli.command()
+@click.argument("queue")
+@click.option("--limit", type=int, default=10, show_default=True)
+def errors(queue, limit):
+    """List dead-lettered jobs from <queue>.failed."""
+    from llmq_tpu.cli.monitor import show_errors
+
+    asyncio.run(show_errors(queue, limit=limit))
+
+
+@cli.command()
+@click.argument("queue")
+@click.option("--yes", is_flag=True, help="Skip confirmation")
+def clear(queue, yes):
+    """Purge all ready messages from a queue."""
+    from llmq_tpu.cli.monitor import clear_queue
+
+    if not yes:
+        click.confirm(f"Purge all messages from '{queue}'?", abort=True)
+    asyncio.run(clear_queue(queue))
+
+
+# ---------------------------------------------------------------------------
+# workers
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def worker() -> None:
+    """Run workers (TPU inference, dummy, dedup, pipeline stages)."""
+
+
+@worker.command("run")
+@click.argument("model")
+@click.argument("queue")
+@click.option("-tp", "--tensor-parallel", type=int, default=None,
+              help="Tensor-parallel degree (default: all local devices)")
+@click.option("-dp", "--data-parallel", type=int, default=1, show_default=True,
+              help="Data-parallel replicas within this worker")
+@click.option("-c", "--concurrency", type=int, default=None,
+              help="Override prefetch/in-flight job count")
+@click.option("--max-num-seqs", type=int, default=None, help="Engine batch slots")
+@click.option("--max-model-len", type=int, default=None, help="Context window cap")
+@click.option("--dtype", default="bfloat16", show_default=True)
+def worker_run(model, queue, tensor_parallel, data_parallel, concurrency,
+               max_num_seqs, max_model_len, dtype):
+    """Run a TPU inference worker serving MODEL on QUEUE."""
+    from llmq_tpu.cli.worker import run_tpu_worker
+
+    run_tpu_worker(
+        model, queue,
+        tensor_parallel=tensor_parallel,
+        data_parallel=data_parallel,
+        concurrency=concurrency,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        dtype=dtype,
+    )
+
+
+@worker.command("dummy")
+@click.argument("queue")
+@click.option("-c", "--concurrency", type=int, default=None)
+@click.option("--delay", type=float, default=1.0, show_default=True,
+              help="Simulated processing seconds per job")
+def worker_dummy(queue, concurrency, delay):
+    """Run a dummy echo worker (testing)."""
+    from llmq_tpu.cli.worker import run_dummy_worker
+
+    run_dummy_worker(queue, concurrency=concurrency, delay=delay)
+
+
+@worker.command("dedup")
+@click.argument("queue")
+@click.option("--batch-size", type=int, default=256, show_default=True)
+@click.option("--mode", type=click.Choice(["dedup", "outliers", "representative"]),
+              default="dedup", show_default=True)
+@click.option("--threshold", type=float, default=0.9, show_default=True,
+              help="Similarity threshold for duplicate detection")
+def worker_dedup(queue, batch_size, mode, threshold):
+    """Run a semantic dedup/filter worker (reference: semhash worker)."""
+    from llmq_tpu.cli.worker import run_dedup_worker
+
+    run_dedup_worker(queue, batch_size=batch_size, mode=mode, threshold=threshold)
+
+
+@worker.command("pipeline")
+@click.argument("config_path")
+@click.argument("stage")
+@click.option("-c", "--concurrency", type=int, default=None)
+def worker_pipeline(config_path, stage, concurrency):
+    """Run a worker for one STAGE of a pipeline YAML."""
+    from llmq_tpu.cli.worker import run_pipeline_worker
+
+    run_pipeline_worker(config_path, stage, concurrency=concurrency)
+
+
+# ---------------------------------------------------------------------------
+# broker daemon (llmq-tpu-only: the reference assumed external RabbitMQ)
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def broker() -> None:
+    """Run/inspect the self-hosted broker daemon."""
+
+
+@broker.command("serve")
+@click.option("--host", default="0.0.0.0", show_default=True)
+@click.option("--port", type=int, default=5672, show_default=True)
+@click.option("--persist-dir", default=None,
+              help="Journal directory for durability across restarts")
+def broker_serve(host: str, port: int, persist_dir: Optional[str]):
+    """Start the llmq-tpu broker daemon (the RabbitMQ equivalent)."""
+    from llmq_tpu.broker.tcp import BrokerServer
+    from llmq_tpu.utils.logging import setup_logging
+
+    setup_logging(structured=False)
+    server = BrokerServer(host, port, persist_dir=persist_dir)
+    click.echo(f"llmq-tpu broker daemon on {host}:{port}"
+               + (f" (journal: {persist_dir})" if persist_dir else " (in-memory)"))
+    try:
+        asyncio.run(server.serve_forever())
+    except KeyboardInterrupt:
+        click.echo("broker stopped")
+
+
+def main() -> None:  # console-script entry point
+    cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,216 @@
+"""Monitoring/ops commands (reference: llmq/cli/monitor.py:19-591).
+
+``status`` (connection probe / queue table / pipeline visualization),
+``health`` (heuristics + live worker heartbeats), ``errors`` (DLQ listing),
+``clear`` (purge). Rendering via rich when stdout is a TTY-ish console.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from rich.console import Console
+from rich.table import Table
+
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.core.config import get_config
+from llmq_tpu.core.models import QueueStats, WorkerHealth
+from llmq_tpu.core.pipeline import load_pipeline_config
+from llmq_tpu.workers.base import HEALTH_SUFFIX
+
+console = Console(stderr=False)
+
+BACKLOG_WARN_THRESHOLD = 10_000
+
+
+async def show_connection_status() -> None:
+    cfg = get_config()
+    mgr = BrokerManager(cfg)
+    try:
+        await mgr.connect()
+        console.print(f"[green]✓[/green] Connected to broker at {cfg.broker_url}")
+        await mgr.disconnect()
+    except Exception as exc:  # noqa: BLE001
+        console.print(f"[red]✗[/red] Cannot connect to {cfg.broker_url}: {exc}")
+
+
+def _stats_row(stats: QueueStats) -> List[str]:
+    def fmt(v) -> str:
+        return "-" if v is None else str(v)
+
+    return [
+        stats.queue_name,
+        fmt(stats.message_count),
+        fmt(stats.message_count_ready),
+        fmt(stats.message_count_unacknowledged),
+        fmt(stats.consumer_count),
+        _fmt_bytes(stats.message_bytes),
+    ]
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024:
+            return f"{n:.0f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+async def show_status(queue: str) -> None:
+    async with BrokerManager(get_config()) as mgr:
+        table = Table(title=f"Queue status: {queue}")
+        for col in ("queue", "total", "ready", "unacked", "consumers", "bytes"):
+            table.add_column(col)
+        for q in (queue, f"{queue}.results", f"{queue}.failed"):
+            stats = await mgr.get_queue_stats(q)
+            table.add_row(*_stats_row(stats))
+        console.print(table)
+        main_stats = await mgr.get_queue_stats(queue)
+        _print_warnings(main_stats)
+
+
+def _print_warnings(stats: QueueStats) -> None:
+    if (stats.consumer_count or 0) == 0 and (stats.message_count_ready or 0) > 0:
+        console.print(
+            "[yellow]⚠ No consumers — jobs will sit in the queue until a "
+            "worker attaches[/yellow]"
+        )
+    if (stats.message_count_ready or 0) > BACKLOG_WARN_THRESHOLD:
+        console.print(
+            f"[yellow]⚠ Large backlog ({stats.message_count_ready} ready "
+            "messages)[/yellow]"
+        )
+
+
+async def check_health(queue: str) -> None:
+    """Queue heuristics + live worker heartbeats (the reference only had
+    queue-level heuristics, monitor.py:48-75; heartbeats are llmq-tpu's
+    WorkerHealth producer)."""
+    async with BrokerManager(get_config()) as mgr:
+        stats = await mgr.get_queue_stats(queue)
+        healthy = True
+        if stats.stats_source == "unavailable":
+            console.print(f"[red]✗ Queue '{queue}' does not exist[/red]")
+            return
+        if (stats.message_count_ready or 0) > BACKLOG_WARN_THRESHOLD:
+            healthy = False
+            console.print(
+                f"[yellow]⚠ Backlog: {stats.message_count_ready} ready[/yellow]"
+            )
+        # Drain available heartbeats (TTL-bounded queue, newest wins per worker)
+        beats: dict[str, WorkerHealth] = {}
+        peeked = []
+        while True:
+            msg = await mgr.broker.get(queue + HEALTH_SUFFIX)
+            if msg is None:
+                break
+            peeked.append(msg)
+            try:
+                health = WorkerHealth.model_validate_json(msg.body)
+                prev = beats.get(health.worker_id)
+                if prev is None or health.last_seen >= prev.last_seen:
+                    beats[health.worker_id] = health
+            except Exception:  # noqa: BLE001
+                pass
+        for msg in peeked:
+            # Non-destructive: keep heartbeats readable for the next check
+            # (they expire via queue TTL anyway).
+            await msg.reject(requeue=True)
+        # Worker liveness: trust the broker's consumer census when it has
+        # one (memory/tcp); fall back to heartbeats where it doesn't (file
+        # broker can't see other processes' consumers).
+        if stats.consumer_count is not None:
+            if stats.consumer_count == 0 and not beats:
+                healthy = False
+                console.print("[red]✗ No workers consuming[/red]")
+        elif not beats:
+            healthy = False
+            console.print(
+                "[red]✗ No worker heartbeats in the last 2 minutes[/red]"
+            )
+        if beats:
+            table = Table(title="Worker heartbeats (last 2 min)")
+            for col in ("worker", "status", "jobs", "avg ms", "last seen"):
+                table.add_column(col)
+            for health in beats.values():
+                table.add_row(
+                    health.worker_id,
+                    health.status,
+                    str(health.jobs_processed),
+                    f"{health.avg_duration_ms:.0f}" if health.avg_duration_ms else "-",
+                    health.last_seen.strftime("%H:%M:%S"),
+                )
+            console.print(table)
+        if healthy:
+            console.print(f"[green]✓ Queue '{queue}' looks healthy[/green]")
+
+
+async def show_errors(queue: str, *, limit: int = 10) -> None:
+    async with BrokerManager(get_config()) as mgr:
+        errors = await mgr.get_failed_jobs(queue, limit=limit)
+        if not errors:
+            console.print(f"[green]No dead-lettered jobs in '{queue}.failed'[/green]")
+            return
+        table = Table(title=f"Dead-lettered jobs: {queue}.failed")
+        for col in ("job id", "error", "redeliveries", "worker"):
+            table.add_column(col)
+        for err in errors:
+            table.add_row(
+                err.job_id,
+                err.error_message,
+                str(err.redeliveries),
+                err.worker_id or "-",
+            )
+        console.print(table)
+
+
+async def clear_queue(queue: str) -> None:
+    async with BrokerManager(get_config()) as mgr:
+        n = await mgr.purge_queue(queue)
+        console.print(f"Purged {n} messages from '{queue}'")
+
+
+async def show_pipeline_status(pipeline_path: str) -> None:
+    """Per-stage stats + flow diagram + status classification
+    (reference monitor.py:357-591)."""
+    pipeline = load_pipeline_config(pipeline_path)
+    async with BrokerManager(get_config()) as mgr:
+        table = Table(title=f"Pipeline: {pipeline.name}")
+        for col in ("stage", "worker", "ready", "unacked", "consumers", "status"):
+            table.add_column(col)
+        flow_parts: List[str] = []
+        warnings: List[str] = []
+        for stage in pipeline.stages:
+            qname = pipeline.get_stage_queue_name(stage.name)
+            stats = await mgr.get_queue_stats(qname)
+            ready = stats.message_count_ready or 0
+            consumers = stats.consumer_count or 0
+            if consumers == 0 and ready > 0:
+                status, color = "NO WORKERS", "red"
+                warnings.append(
+                    f"Stage '{stage.name}' has {ready} jobs but no workers"
+                )
+            elif ready > BACKLOG_WARN_THRESHOLD:
+                status, color = "BACKLOG", "yellow"
+                warnings.append(f"Stage '{stage.name}' backlog: {ready}")
+            else:
+                status, color = "HEALTHY", "green"
+            table.add_row(
+                stage.name,
+                stage.worker,
+                str(ready),
+                str(stats.message_count_unacknowledged or 0),
+                str(consumers) if stats.consumer_count is not None else "-",
+                f"[{color}]{status}[/{color}]",
+            )
+            flow_parts.append(f"[{color}]{stage.name}[/{color}]({ready})")
+        results_stats = await mgr.get_queue_stats(
+            pipeline.get_pipeline_results_queue_name()
+        )
+        flow_parts.append(f"results({results_stats.message_count_ready or 0})")
+        console.print(table)
+        console.print("flow: " + " → ".join(flow_parts))
+        for warning in warnings:
+            console.print(f"[yellow]⚠ {warning}[/yellow]")

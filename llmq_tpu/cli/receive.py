@@ -1,0 +1,121 @@
+"""Result receivers → JSONL on stdout (reference: llmq/cli/receive.py).
+
+Durable results queues make receiving resumable: detach any time, re-attach
+later and drain (reference broker.py:75-78). Exit on idle timeout or --limit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+import time
+from typing import Optional
+
+from llmq_tpu.broker.manager import BrokerManager, results_queue_name
+from llmq_tpu.core.config import get_config
+from llmq_tpu.core.models import Result
+from llmq_tpu.core.pipeline import load_pipeline_config
+
+logger = logging.getLogger(__name__)
+
+
+class ResultReceiver:
+    def __init__(
+        self,
+        queue: str,
+        *,
+        timeout: Optional[float] = None,
+        limit: Optional[int] = None,
+        is_pipeline_results: bool = False,
+    ) -> None:
+        self.queue = queue
+        self.timeout = timeout
+        self.limit = limit
+        self.is_pipeline_results = is_pipeline_results
+        self.broker = BrokerManager(get_config())
+        self.received = 0
+        self._last_at = time.monotonic()
+        self._done = asyncio.Event()
+
+    async def run(self) -> int:
+        await self.broker.connect()
+        start = time.monotonic()
+        try:
+            if self.is_pipeline_results:
+                await self.broker.broker.declare_queue(self.queue)
+                tag = await self.broker.broker.consume(
+                    self.queue, self._on_message, prefetch=100
+                )
+            else:
+                tag = await self.broker.consume_results(self.queue, self._on_message)
+            self._last_at = time.monotonic()
+            while not self._done.is_set():
+                if self.timeout is not None and (
+                    time.monotonic() - self._last_at > self.timeout
+                ):
+                    logger.info("Idle timeout after %d results", self.received)
+                    break
+                await asyncio.sleep(0.1)
+            await self.broker.cancel(tag)
+            elapsed = time.monotonic() - start
+            if elapsed > 0 and self.received:
+                logger.info(
+                    "Received %d results in %.1fs (%.1f/s)",
+                    self.received,
+                    elapsed,
+                    self.received / elapsed,
+                )
+            return self.received
+        finally:
+            await self.broker.disconnect()
+
+    async def _on_message(self, message) -> None:
+        if self._done.is_set():
+            # Past --limit: leave prefetched results on the queue for the
+            # next receiver instead of printing/acking them.
+            await message.reject(requeue=True)
+            return
+        try:
+            result = Result.model_validate_json(message.body)
+        except Exception as exc:  # noqa: BLE001 — malformed: drop, don't loop
+            logger.error("Dropping malformed result: %s", exc)
+            await message.reject(requeue=False)
+            return
+        sys.stdout.write(result.model_dump_json() + "\n")
+        sys.stdout.flush()
+        await message.ack()
+        self.received += 1
+        self._last_at = time.monotonic()
+        if self.limit is not None and self.received >= self.limit:
+            self._done.set()
+
+
+async def run_receive(
+    queue: str, *, timeout: Optional[float] = None, limit: Optional[int] = None
+) -> None:
+    from llmq_tpu.utils.logging import setup_logging
+
+    setup_logging(structured=False)
+    # Accept both bare queue names and explicit .results names.
+    receiver = ResultReceiver(queue, timeout=timeout, limit=limit)
+    await receiver.run()
+
+
+async def run_pipeline_receive(
+    pipeline_path: str,
+    *,
+    timeout: Optional[float] = None,
+    limit: Optional[int] = None,
+) -> None:
+    from llmq_tpu.utils.logging import setup_logging
+
+    setup_logging(structured=False)
+    pipeline = load_pipeline_config(pipeline_path)
+    receiver = ResultReceiver(
+        pipeline.get_pipeline_results_queue_name(),
+        timeout=timeout,
+        limit=limit,
+        is_pipeline_results=True,
+    )
+    await receiver.run()

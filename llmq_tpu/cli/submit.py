@@ -1,0 +1,332 @@
+"""Job submission (reference: llmq/cli/submit.py:28-874).
+
+Sources (same detection rules as the reference, submit.py:78-94):
+``-`` = stdin JSONL; an existing path = JSONL file; anything else = a
+HuggingFace dataset name (streaming).
+
+``--map`` semantics live in ``core/template.py`` (single canonical module).
+Submission is chunked (``LLMQ_CHUNK_SIZE``) with concurrent publishes inside
+a chunk. ``--stream`` consumes results while submitting, with an
+idle-reset timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Any, AsyncIterator, Dict, Iterator, Optional
+
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.core.config import get_config
+from llmq_tpu.core.models import Job, Result
+from llmq_tpu.core.pipeline import PipelineConfig, load_pipeline_config
+from llmq_tpu.core.template import create_job_from_row, resolve_template_value
+
+logger = logging.getLogger(__name__)
+
+
+def _iter_jsonl(stream) -> Iterator[Dict[str, Any]]:
+    for lineno, line in enumerate(stream, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError as exc:
+            logger.warning("Skipping malformed JSONL line %d: %s", lineno, exc)
+
+
+def _iter_hf_dataset(
+    name: str, *, split: str, subset: Optional[str]
+) -> Iterator[Dict[str, Any]]:
+    """Streaming HF dataset iterator with subset/split fallback
+    (reference submit.py:96-136)."""
+    from datasets import load_dataset
+
+    try:
+        ds = (
+            load_dataset(name, subset, split=split, streaming=True)
+            if subset
+            else load_dataset(name, split=split, streaming=True)
+        )
+    except ValueError:
+        # Fallback: some datasets need an explicit default config or
+        # different split naming.
+        ds = load_dataset(name, split="train", streaming=True)
+    for row in ds:
+        yield dict(row)
+
+
+def iter_source(
+    source: str, *, split: str = "train", subset: Optional[str] = None
+) -> Iterator[Dict[str, Any]]:
+    if source == "-":
+        return _iter_jsonl(sys.stdin)
+    if Path(source).exists():
+        return _iter_jsonl(Path(source).open())
+    return _iter_hf_dataset(source, split=split, subset=subset)
+
+
+class JobSubmitter:
+    """Chunked concurrent submission + optional result streaming
+    (reference JobSubmitter, submit.py:28-606)."""
+
+    def __init__(
+        self,
+        queue: str,
+        source: str,
+        mapping: Optional[Dict[str, Any]] = None,
+        *,
+        stream: bool = False,
+        split: str = "train",
+        subset: Optional[str] = None,
+        limit: Optional[int] = None,
+        broker: Optional[BrokerManager] = None,
+        stream_idle_timeout: float = 30.0,
+    ) -> None:
+        self.queue = queue
+        self.source = source
+        self.mapping = mapping or {}
+        self.stream = stream
+        self.split = split
+        self.subset = subset
+        self.limit = limit
+        self.config = get_config()
+        self.broker = broker or BrokerManager(self.config)
+        self._owns_broker = broker is None
+        self.stream_idle_timeout = stream_idle_timeout
+        self.submitted = 0
+        self.received = 0
+        self._last_result_at = 0.0
+
+    async def run(self) -> int:
+        await self.broker.connect()
+        try:
+            await self.broker.setup_queue_infrastructure(self.queue)
+            consumer_tag = None
+            if self.stream:
+                consumer_tag = await self.broker.consume_results(
+                    self.queue, self._on_result
+                )
+            await self._submit_all()
+            if self.stream:
+                await self._wait_for_results()
+                if consumer_tag:
+                    await self.broker.cancel(consumer_tag)
+            return self.submitted
+        finally:
+            if self._owns_broker:
+                await self.broker.disconnect()
+
+    # --- submission -------------------------------------------------------
+    async def _submit_all(self) -> None:
+        import uuid
+
+        start = time.monotonic()
+        run_id = uuid.uuid4().hex[:10]  # unique per submit run; no clock collisions
+        chunk: list[Job] = []
+        seq = 0
+        for row in iter_source(self.source, split=self.split, subset=self.subset):
+            if self.limit is not None and seq >= self.limit:
+                break
+            job_dict = create_job_from_row(
+                row, self.mapping or None, job_id=f"{run_id}-{seq}"
+            )
+            seq += 1
+            try:
+                chunk.append(Job(**job_dict))
+            except Exception as exc:  # noqa: BLE001 — skip bad rows, keep going
+                logger.warning("Skipping invalid row %d: %s", seq, exc)
+                continue
+            if len(chunk) >= self.config.chunk_size:
+                await self._submit_chunk(chunk)
+                chunk = []
+        if chunk:
+            await self._submit_chunk(chunk)
+        elapsed = time.monotonic() - start
+        rate = self.submitted / elapsed if elapsed > 0 else 0.0
+        logger.info(
+            "Submitted %d jobs to '%s' in %.1fs (%.0f jobs/s)",
+            self.submitted,
+            self.queue,
+            elapsed,
+            rate,
+        )
+
+    async def _submit_chunk(self, jobs: list[Job]) -> None:
+        await asyncio.gather(
+            *(self.broker.publish_job(self.queue, job) for job in jobs)
+        )
+        self.submitted += len(jobs)
+        print(
+            f"\rsubmitted {self.submitted} jobs", end="", file=sys.stderr, flush=True
+        )
+        await asyncio.sleep(0.01)  # let the loop breathe between chunks
+
+    # --- streaming --------------------------------------------------------
+    async def _on_result(self, message) -> None:
+        try:
+            result = Result.model_validate_json(message.body)
+        except Exception:  # noqa: BLE001
+            await message.reject(requeue=False)
+            return
+        sys.stdout.write(result.model_dump_json() + "\n")
+        sys.stdout.flush()
+        self.received += 1
+        self._last_result_at = time.monotonic()
+        await message.ack()
+
+    async def _wait_for_results(self) -> None:
+        """Idle-reset timeout: exit when all results arrived or nothing has
+        arrived for stream_idle_timeout seconds (reference submit.py:284-293)."""
+        self._last_result_at = time.monotonic()
+        while self.received < self.submitted:
+            if time.monotonic() - self._last_result_at > self.stream_idle_timeout:
+                logger.warning(
+                    "Idle timeout: %d/%d results received",
+                    self.received,
+                    self.submitted,
+                )
+                break
+            await asyncio.sleep(0.1)
+
+
+class PipelineSubmitter:
+    """Submit to stage 1 of a pipeline (reference PipelineSubmitter,
+    submit.py:609-874): sets up all stage queues, merges stage-1 templates
+    *under* user --map, optionally streams final results."""
+
+    def __init__(
+        self,
+        pipeline: PipelineConfig,
+        source: str,
+        mapping: Optional[Dict[str, Any]] = None,
+        *,
+        stream: bool = False,
+        split: str = "train",
+        subset: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> None:
+        self.pipeline = pipeline
+        self.source = source
+        self.mapping = dict(mapping or {})
+        self.stream = stream
+        self.split = split
+        self.subset = subset
+        self.limit = limit
+        self.broker = BrokerManager(get_config())
+
+    def _effective_mapping(self) -> Dict[str, Any]:
+        """Stage-1 templates from YAML, overridden by user --map
+        (reference submit.py:667-687,736-737)."""
+        merged: Dict[str, Any] = {}
+        stage1 = self.pipeline.stages[0]
+        if stage1.messages_template() is not None:
+            merged["messages"] = stage1.messages_template()
+        elif stage1.prompt_template() is not None:
+            merged["prompt"] = stage1.prompt_template()
+        merged.update(self.mapping)
+        return merged
+
+    async def run(self) -> int:
+        await self.broker.connect()
+        try:
+            await self.broker.setup_pipeline_infrastructure(self.pipeline)
+            stage1_queue = self.pipeline.get_stage_queue_name(
+                self.pipeline.stages[0].name
+            )
+            consumer_tag = None
+            receiver = _PipelineResultPrinter()
+            if self.stream:
+                consumer_tag = await self.broker.broker.consume(
+                    self.pipeline.get_pipeline_results_queue_name(),
+                    receiver.on_result,
+                    prefetch=100,
+                )
+            submitter = JobSubmitter(
+                stage1_queue,
+                self.source,
+                self._effective_mapping(),
+                split=self.split,
+                subset=self.subset,
+                limit=self.limit,
+                broker=self.broker,
+            )
+            # Reuse connection; submitter must not tear down pipeline infra.
+            submitted = 0
+            await submitter._submit_all()
+            submitted = submitter.submitted
+            if self.stream:
+                last = time.monotonic()
+                while receiver.count < submitted:
+                    if receiver.count > 0:
+                        last = max(last, receiver.last_at)
+                    if time.monotonic() - last > 30.0:
+                        break
+                    await asyncio.sleep(0.1)
+                if consumer_tag:
+                    await self.broker.cancel(consumer_tag)
+            return submitted
+        finally:
+            await self.broker.disconnect()
+
+
+class _PipelineResultPrinter:
+    def __init__(self) -> None:
+        self.count = 0
+        self.last_at = 0.0
+
+    async def on_result(self, message) -> None:
+        try:
+            result = Result.model_validate_json(message.body)
+        except Exception:  # noqa: BLE001
+            await message.reject(requeue=False)
+            return
+        sys.stdout.write(result.model_dump_json() + "\n")
+        sys.stdout.flush()
+        self.count += 1
+        self.last_at = time.monotonic()
+        await message.ack()
+
+
+async def run_submit(
+    queue: str,
+    source: str,
+    mapping: Dict[str, Any],
+    *,
+    stream: bool = False,
+    split: str = "train",
+    subset: Optional[str] = None,
+    limit: Optional[int] = None,
+) -> None:
+    from llmq_tpu.utils.logging import setup_logging
+
+    setup_logging(structured=False)
+    submitter = JobSubmitter(
+        queue, source, mapping, stream=stream, split=split, subset=subset, limit=limit
+    )
+    await submitter.run()
+
+
+async def run_pipeline_submit(
+    pipeline_path: str,
+    source: str,
+    mapping: Dict[str, Any],
+    *,
+    stream: bool = False,
+    split: str = "train",
+    subset: Optional[str] = None,
+    limit: Optional[int] = None,
+) -> None:
+    from llmq_tpu.utils.logging import setup_logging
+
+    setup_logging(structured=False)
+    pipeline = load_pipeline_config(pipeline_path)
+    submitter = PipelineSubmitter(
+        pipeline, source, mapping, stream=stream, split=split, subset=subset, limit=limit
+    )
+    await submitter.run()

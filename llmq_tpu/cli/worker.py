@@ -1,0 +1,146 @@
+"""Worker launchers (reference: llmq/cli/worker.py:9-250)."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from typing import Optional
+
+import click
+
+from llmq_tpu.core.pipeline import load_pipeline_config
+from llmq_tpu.utils.logging import setup_logging
+
+
+def run_tpu_worker(
+    model: str,
+    queue: str,
+    *,
+    tensor_parallel: Optional[int] = None,
+    data_parallel: int = 1,
+    concurrency: Optional[int] = None,
+    max_num_seqs: Optional[int] = None,
+    max_model_len: Optional[int] = None,
+    dtype: str = "bfloat16",
+) -> None:
+    """Launch the TPU inference worker (reference run_vllm_worker)."""
+    setup_logging(structured=True)
+    try:
+        from llmq_tpu.workers.tpu_worker import TPUWorker
+    except ImportError as exc:
+        click.echo(f"TPU worker unavailable: {exc}", err=True)
+        sys.exit(1)
+    click.echo(f"Starting TPU worker: model={model} queue={queue}", err=True)
+    worker = TPUWorker(
+        queue,
+        model=model,
+        tensor_parallel=tensor_parallel,
+        data_parallel=data_parallel,
+        concurrency=concurrency,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        dtype=dtype,
+    )
+    _run(worker)
+
+
+def run_dummy_worker(
+    queue: str, *, concurrency: Optional[int] = None, delay: float = 1.0
+) -> None:
+    setup_logging(structured=True)
+    from llmq_tpu.workers.dummy import DummyWorker
+
+    click.echo(f"Starting dummy worker on queue '{queue}'", err=True)
+    _run(DummyWorker(queue, delay=delay, concurrency=concurrency))
+
+
+def run_dedup_worker(
+    queue: str,
+    *,
+    batch_size: int = 256,
+    mode: str = "dedup",
+    threshold: float = 0.9,
+    concurrency: Optional[int] = None,
+) -> None:
+    setup_logging(structured=True)
+    from llmq_tpu.workers.dedup import DedupWorker
+
+    click.echo(f"Starting dedup worker ({mode}) on queue '{queue}'", err=True)
+    _run(
+        DedupWorker(
+            queue,
+            batch_size=batch_size,
+            mode=mode,
+            threshold=threshold,
+            concurrency=concurrency,
+        )
+    )
+
+
+def run_pipeline_worker(
+    config_path: str, stage: str, *, concurrency: Optional[int] = None
+) -> None:
+    """Resolve a pipeline stage → its worker type, wired for stage routing
+    (reference cli/worker.py:130-239)."""
+    setup_logging(structured=True)
+    pipeline = load_pipeline_config(config_path)
+    stage_cfg = pipeline.get_stage_by_name(stage)
+    if stage_cfg is None:
+        click.echo(
+            f"Stage '{stage}' not in pipeline '{pipeline.name}' "
+            f"(stages: {[s.name for s in pipeline.stages]})",
+            err=True,
+        )
+        sys.exit(1)
+    queue = pipeline.get_stage_queue_name(stage)
+    common = dict(pipeline=pipeline, stage_name=stage, concurrency=concurrency)
+    if stage_cfg.worker in ("tpu", "vllm"):  # accept reference YAMLs naming vllm
+        try:
+            from llmq_tpu.workers.tpu_worker import TPUWorker
+        except ImportError as exc:
+            click.echo(f"TPU worker unavailable: {exc}", err=True)
+            sys.exit(1)
+
+        model = stage_cfg.config.get("model")
+        if not model:
+            click.echo(f"Stage '{stage}' needs config.model", err=True)
+            sys.exit(1)
+        worker = TPUWorker(
+            queue,
+            model=model,
+            max_model_len=stage_cfg.config.get("max_model_len"),
+            max_num_seqs=stage_cfg.config.get("max_num_seqs"),
+            **common,
+        )
+    elif stage_cfg.worker == "dummy":
+        from llmq_tpu.workers.dummy import DummyWorker
+
+        worker = DummyWorker(
+            queue, delay=float(stage_cfg.config.get("delay", 1.0)), **common
+        )
+    elif stage_cfg.worker in ("dedup", "semhash"):
+        from llmq_tpu.workers.dedup import DedupWorker
+
+        worker = DedupWorker(
+            queue,
+            batch_size=int(stage_cfg.config.get("batch_size", 256)),
+            mode=stage_cfg.config.get("mode", "dedup"),
+            threshold=float(stage_cfg.config.get("threshold", 0.9)),
+            **common,
+        )
+    else:
+        click.echo(f"Unknown worker type '{stage_cfg.worker}'", err=True)
+        sys.exit(1)
+    click.echo(
+        f"Starting {stage_cfg.worker} worker for stage '{stage}' of "
+        f"pipeline '{pipeline.name}'",
+        err=True,
+    )
+    _run(worker)
+
+
+def _run(worker) -> None:
+    try:
+        asyncio.run(worker.run())
+    except KeyboardInterrupt:
+        pass

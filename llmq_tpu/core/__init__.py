@@ -1,0 +1,29 @@
+"""Core runtime: config, data models, templating, pipeline schema, broker API.
+
+Counterpart of the reference's ``llmq/core`` layer (see SURVEY.md §1 L1).
+"""
+
+from llmq_tpu.core.config import Config, get_config
+from llmq_tpu.core.models import (
+    ErrorInfo,
+    Job,
+    QueueStats,
+    Result,
+    SamplingOptions,
+    WorkerHealth,
+)
+from llmq_tpu.core.pipeline import PipelineConfig, PipelineStage, load_pipeline_config
+
+__all__ = [
+    "Config",
+    "get_config",
+    "Job",
+    "Result",
+    "SamplingOptions",
+    "QueueStats",
+    "WorkerHealth",
+    "ErrorInfo",
+    "PipelineConfig",
+    "PipelineStage",
+    "load_pipeline_config",
+]

@@ -1,0 +1,151 @@
+"""Environment-driven configuration.
+
+Counterpart of the reference's ``llmq/core/config.py:9-69`` — env vars (with
+``.env`` autoload) materialised into a pydantic model, re-read on every
+``get_config()`` call so tests can monkeypatch the environment.
+
+Differences from the reference, on purpose:
+
+- TPU-native knob names (``LLMQ_*`` / ``TPU_*``); the reference's ``VLLM_*``
+  names are accepted as fallback aliases so existing llmq deployment scripts
+  keep working unchanged (parity with ``utils/run_llmq_benchmark.slurm:32-33``).
+- ``.env`` parsing is implemented here (python-dotenv is not a dependency).
+- ``job_ttl_minutes`` is actually applied by the broker layer (the reference
+  declared it but never used it — SURVEY.md §5 "dead config").
+- ``max_redeliveries`` adds a real dead-letter policy (the reference requeued
+  failed jobs forever — ``workers/base.py:245``).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from pydantic import BaseModel, Field
+
+
+def load_env_file(path: str | os.PathLike = ".env", *, override: bool = False) -> None:
+    """Minimal ``.env`` loader: KEY=VALUE lines, ``#`` comments, optional quotes."""
+    p = Path(path)
+    if not p.is_file():
+        return
+    for raw in p.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        if line.startswith("export "):
+            line = line[len("export ") :]
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in "\"'":
+            value = value[1:-1]
+        if key and (override or key not in os.environ):
+            os.environ[key] = value
+
+
+_ENV_LOADED = False
+
+
+def _ensure_env_loaded() -> None:
+    global _ENV_LOADED
+    if not _ENV_LOADED:
+        load_env_file()
+        _ENV_LOADED = True
+
+
+def _env(name: str, *aliases: str) -> Optional[str]:
+    for key in (name, *aliases):
+        value = os.getenv(key)
+        if value is not None:
+            return value
+    return None
+
+
+def _env_int(name: str, *aliases: str, default: Optional[int] = None) -> Optional[int]:
+    value = _env(name, *aliases)
+    return int(value) if value not in (None, "") else default
+
+
+def _env_float(name: str, *aliases: str, default: Optional[float] = None) -> Optional[float]:
+    value = _env(name, *aliases)
+    return float(value) if value not in (None, "") else default
+
+
+class Config(BaseModel):
+    """Runtime configuration snapshot (one env read per instantiation)."""
+
+    # --- broker -----------------------------------------------------------
+    broker_url: str = Field(
+        default_factory=lambda: _env("LLMQ_BROKER_URL", "BROKER_URL", "RABBITMQ_URL")
+        or "tcp://127.0.0.1:5672/",
+        description=(
+            "Broker endpoint. Schemes: memory:// (in-process), file:///path "
+            "(durable on-disk), tcp://host:port/ (llmq-tpu broker daemon), "
+            "amqp://... (RabbitMQ, if aio-pika is installed)."
+        ),
+    )
+
+    queue_prefetch: int = Field(
+        default_factory=lambda: _env_int(
+            "LLMQ_QUEUE_PREFETCH", "VLLM_QUEUE_PREFETCH", default=100
+        ),
+        description="Messages prefetched (in flight) per worker consumer.",
+    )
+
+    # --- engine -----------------------------------------------------------
+    hbm_utilization: float = Field(
+        default_factory=lambda: _env_float(
+            "TPU_HBM_UTILIZATION", "VLLM_GPU_MEMORY_UTILIZATION", default=0.9
+        ),
+        description="Fraction of device HBM the engine may claim for the KV cache.",
+    )
+
+    max_num_seqs: Optional[int] = Field(
+        default_factory=lambda: _env_int("LLMQ_MAX_NUM_SEQS", "VLLM_MAX_NUM_SEQS"),
+        description="Max sequences resident in one continuous-batching step.",
+    )
+
+    max_model_len: Optional[int] = Field(
+        default_factory=lambda: _env_int("LLMQ_MAX_MODEL_LEN", "VLLM_MAX_MODEL_LEN"),
+        description="Context-window cap (prompt + generation).",
+    )
+
+    max_tokens: int = Field(
+        default_factory=lambda: _env_int(
+            "LLMQ_MAX_TOKENS", "VLLM_MAX_TOKENS", default=8192
+        ),
+        description="Default max new tokens per request (per-job override allowed).",
+    )
+
+    # --- queue/job policy -------------------------------------------------
+    job_ttl_minutes: int = Field(
+        default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),
+        description="Job time-to-live; expired jobs are dropped by the broker.",
+    )
+
+    max_redeliveries: int = Field(
+        default_factory=lambda: _env_int("LLMQ_MAX_REDELIVERIES", default=3),
+        description="Redeliveries before a job is dead-lettered to <q>.failed.",
+    )
+
+    chunk_size: int = Field(
+        default_factory=lambda: _env_int("LLMQ_CHUNK_SIZE", default=10000),
+        description="Jobs submitted per publish chunk.",
+    )
+
+    log_level: str = Field(
+        default_factory=lambda: _env("LLMQ_LOG_LEVEL") or "INFO",
+        description="Logging level.",
+    )
+
+    @property
+    def job_ttl_ms(self) -> int:
+        return self.job_ttl_minutes * 60 * 1000
+
+
+def get_config() -> Config:
+    """Fresh config (env re-read each call, like the reference's config.py:67-69)."""
+    _ensure_env_loaded()
+    return Config()

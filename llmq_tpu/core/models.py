@@ -1,0 +1,154 @@
+"""Job / Result / stats schemas.
+
+Counterpart of the reference's ``llmq/core/models.py:6-91``. Same wire-level
+contract (a reference user's JSONL job files work unchanged):
+
+- ``Job`` requires exactly one of ``prompt`` / ``messages`` and allows extra
+  fields, which double as template variables and are passed through to the
+  ``Result`` (reference models.py:19-46, workers/base.py:173-186).
+- ``Result`` carries id/prompt/result/worker_id/duration_ms/timestamp plus
+  passthrough extras (reference models.py:49-62).
+
+Additions over the reference:
+
+- ``SamplingOptions`` — per-job sampling overrides (temperature/top_p/top_k/
+  max_tokens/seed). The reference hardcoded temperature=0.7
+  (vllm_worker.py:162); here any job may carry a ``sampling`` object.
+- ``Result.usage`` — prompt/completion token counts (the reference had no
+  token accounting outside the offline benchmark).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+_RESERVED_JOB_FIELDS = {"id", "prompt", "messages", "chat_mode", "stop", "sampling"}
+
+
+def utcnow() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+class SamplingOptions(BaseModel):
+    """Per-request sampling configuration (engine-level contract)."""
+
+    temperature: float = Field(default=0.7, ge=0.0)
+    top_p: float = Field(default=1.0, gt=0.0, le=1.0)
+    top_k: int = Field(default=0, ge=0, description="0 disables top-k")
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    min_tokens: int = Field(default=0, ge=0)
+    seed: Optional[int] = None
+    stop: Optional[List[str]] = None
+
+    model_config = ConfigDict(extra="forbid")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+class Job(BaseModel):
+    """A unit of work: one prompt (or chat) to run through a model."""
+
+    id: str = Field(..., description="Unique job identifier")
+    prompt: Optional[str] = Field(
+        None, description="Prompt template; ``{var}`` placeholders resolve from extras"
+    )
+    messages: Optional[List[Dict[str, Any]]] = Field(
+        None, description="Chat messages for chat-template models"
+    )
+    chat_mode: bool = Field(
+        default=False, description="Force chat-template application"
+    )
+    stop: Optional[List[str]] = Field(
+        None, description="Stop sequences; None = EOS only"
+    )
+    sampling: Optional[SamplingOptions] = Field(
+        None, description="Per-job sampling overrides"
+    )
+
+    model_config = ConfigDict(extra="allow")
+
+    @model_validator(mode="after")
+    def _prompt_xor_messages(self) -> "Job":
+        if self.prompt is not None and self.messages is not None:
+            raise ValueError(
+                "Cannot specify both 'prompt' and 'messages'. Use one or the other."
+            )
+        if self.prompt is None and self.messages is None:
+            raise ValueError("Must specify either 'prompt' or 'messages'.")
+        return self
+
+    def extras(self) -> Dict[str, Any]:
+        """Extra (non-schema) fields — template variables / passthrough data."""
+        return {
+            k: v
+            for k, v in self.model_dump().items()
+            if k not in _RESERVED_JOB_FIELDS
+        }
+
+    def get_formatted_prompt(self) -> str:
+        """Resolve ``{var}`` placeholders in ``prompt`` from the job's extras."""
+        if self.prompt is None:
+            raise ValueError("Cannot format prompt: prompt is None")
+        from llmq_tpu.core.template import resolve_template_string
+
+        return resolve_template_string(self.prompt, self.extras())
+
+
+class Result(BaseModel):
+    """Outcome of one job; extra fields from the job are passed through."""
+
+    id: str = Field(..., description="Job ID this result corresponds to")
+    prompt: str = Field(..., description="The formatted prompt that was processed")
+    result: str = Field(..., description="Generated text")
+    worker_id: str = Field(..., description="Worker that processed this job")
+    duration_ms: float = Field(..., description="Processing duration (ms)")
+    timestamp: datetime = Field(default_factory=utcnow)
+    usage: Optional[Dict[str, int]] = Field(
+        None, description="Token accounting: prompt_tokens/completion_tokens"
+    )
+
+    model_config = ConfigDict(extra="allow")
+
+
+class QueueStats(BaseModel):
+    """Depth/consumer snapshot of one queue (reference models.py:65-75)."""
+
+    queue_name: str
+    message_count: Optional[int] = None
+    message_count_ready: Optional[int] = None
+    message_count_unacknowledged: Optional[int] = None
+    consumer_count: Optional[int] = None
+    message_bytes: Optional[int] = None
+    message_bytes_ready: Optional[int] = None
+    message_bytes_unacknowledged: Optional[int] = None
+    processing_rate: Optional[float] = None
+    stats_source: str = "unknown"
+
+
+class WorkerHealth(BaseModel):
+    """Worker heartbeat record (the reference declared this but never produced
+    one — models.py:78-84; llmq-tpu workers publish them periodically)."""
+
+    worker_id: str
+    status: str
+    last_seen: datetime
+    jobs_processed: int
+    avg_duration_ms: Optional[float] = None
+    queue: Optional[str] = None
+    engine_stats: Optional[Dict[str, Any]] = None
+
+
+class ErrorInfo(BaseModel):
+    """Dead-letter record (reference models.py:86-91; actually produced here
+    when a job exceeds max_redeliveries)."""
+
+    job_id: str
+    error_message: str
+    timestamp: datetime = Field(default_factory=utcnow)
+    worker_id: Optional[str] = None
+    redeliveries: int = 0

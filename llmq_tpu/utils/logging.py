@@ -1,0 +1,56 @@
+"""Two-mode logging (reference: llmq/utils/logging.py:8-75).
+
+Workers log JSON lines to stdout (machine-tailable, ``| jq .``); CLI commands
+log human-readable lines to stderr so stdout stays clean for JSONL results.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from datetime import datetime, timezone
+from typing import Optional
+
+
+class JsonLineFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": datetime.now(timezone.utc).isoformat(),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "extra_fields", None)
+        if isinstance(extra, dict):
+            entry.update(extra)
+        return json.dumps(entry, default=str)
+
+
+def setup_logging(
+    *, structured: bool = False, level: Optional[str] = None
+) -> None:
+    """Configure root logging. ``structured=True`` → JSON lines on stdout
+    (worker mode); else human format on stderr (CLI mode)."""
+    if level is None:
+        from llmq_tpu.core.config import get_config
+
+        level = get_config().log_level
+    root = logging.getLogger()
+    root.setLevel(level.upper())
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    if structured:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(JsonLineFormatter())
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+    root.addHandler(handler)

@@ -1,0 +1,20 @@
+"""Workers: long-running queue consumers.
+
+Counterpart of reference ``llmq/workers/``. ``TPUWorker`` (the vLLM-worker
+equivalent) is imported lazily so the package works without jax initialised
+(reference guarded VLLMWorker the same way, workers/__init__.py:9-14).
+"""
+
+from llmq_tpu.workers.base import BaseWorker
+from llmq_tpu.workers.dummy import DummyWorker
+from llmq_tpu.workers.dedup import DedupWorker
+
+__all__ = ["BaseWorker", "DummyWorker", "DedupWorker", "TPUWorker"]
+
+
+def __getattr__(name: str):
+    if name == "TPUWorker":
+        from llmq_tpu.workers.tpu_worker import TPUWorker
+
+        return TPUWorker
+    raise AttributeError(name)

@@ -1,0 +1,261 @@
+"""Worker base: lifecycle + message loop + error policy.
+
+Counterpart of reference ``llmq/workers/base.py:15-275``. A worker:
+
+1. initialises its processor (e.g. compiles the TPU engine),
+2. connects to the broker and sets prefetch = concurrency,
+3. consumes jobs; per message: parse → process → Result (with extra-field
+   passthrough) → publish (direct or pipeline-routed) → ack,
+4. on ValueError: ack-and-drop with an error result policy (malformed job —
+   retrying can't help; reference base.py:228-235),
+5. on any other exception: reject-requeue (broker dead-letters past the
+   redelivery cap — the reference requeued forever),
+6. SIGINT/SIGTERM → graceful drain and cleanup.
+
+Additions over the reference: periodic WorkerHealth heartbeats published to
+``<queue>.health`` (the reference declared the model but nothing produced
+it), and engine stats surfaced through them.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import logging
+import signal
+import time
+from typing import Optional
+
+from llmq_tpu.broker.base import DeliveredMessage
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.core.config import Config, get_config
+from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
+from llmq_tpu.core.pipeline import PipelineConfig
+
+HEALTH_SUFFIX = ".health"
+HEALTH_TTL_MS = 120_000
+HEARTBEAT_INTERVAL_S = 30.0
+
+
+class BaseWorker(abc.ABC):
+    def __init__(
+        self,
+        queue: str,
+        *,
+        config: Optional[Config] = None,
+        concurrency: Optional[int] = None,
+        pipeline: Optional[PipelineConfig] = None,
+        stage_name: Optional[str] = None,
+    ) -> None:
+        self.queue = queue
+        self.config = config or get_config()
+        self.concurrency = concurrency or self.config.queue_prefetch
+        self.pipeline = pipeline
+        self.stage_name = stage_name
+        self.worker_id = self._generate_worker_id()
+        self.logger = logging.getLogger(f"worker.{self.worker_id}")
+        self.broker = BrokerManager(self.config)
+        self.running = False
+        self.jobs_processed = 0
+        self.jobs_failed = 0
+        self.total_duration_ms = 0.0
+        self._consumer_tag: Optional[str] = None
+        self._in_flight = 0
+        self._drained = asyncio.Event()
+        self._drained.set()
+
+    # --- abstract surface (reference base.py:57-75) -----------------------
+    @abc.abstractmethod
+    def _generate_worker_id(self) -> str: ...
+
+    @abc.abstractmethod
+    async def _initialize_processor(self) -> None: ...
+
+    @abc.abstractmethod
+    async def _process_job(self, job: Job) -> str: ...
+
+    @abc.abstractmethod
+    async def _cleanup_processor(self) -> None: ...
+
+    # --- lifecycle --------------------------------------------------------
+    async def initialize(self) -> None:
+        self.logger.info("Initializing worker %s", self.worker_id)
+        await self._initialize_processor()
+        await self.broker.connect()
+        if self.pipeline is not None:
+            await self.broker.setup_pipeline_infrastructure(self.pipeline)
+        else:
+            await self.broker.setup_queue_infrastructure(self.queue)
+        # Heartbeats expire via TTL; the huge redelivery cap keeps repeated
+        # non-destructive health peeks from ever dead-lettering them.
+        await self.broker.broker.declare_queue(
+            self.queue + HEALTH_SUFFIX,
+            ttl_ms=HEALTH_TTL_MS,
+            max_redeliveries=1_000_000_000,
+        )
+
+    async def run(self) -> None:
+        """Main entry: initialize, consume until stopped, then clean up."""
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self.request_shutdown)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main thread or platform without signal support
+        try:
+            await self.initialize()
+            self.running = True
+            self._consumer_tag = await self.broker.consume_jobs(
+                self.queue, self._process_message, prefetch=self.concurrency
+            )
+            self.logger.info(
+                "Worker %s starting to consume from '%s' (prefetch=%d)",
+                self.worker_id,
+                self.queue,
+                self.concurrency,
+            )
+            last_beat = 0.0
+            while self.running:
+                now = time.time()
+                if now - last_beat >= HEARTBEAT_INTERVAL_S:
+                    await self._publish_heartbeat()
+                    last_beat = now
+                await asyncio.sleep(1.0)
+        finally:
+            await self.shutdown()
+
+    def request_shutdown(self) -> None:
+        if self.running:
+            self.logger.info("Shutdown requested; draining in-flight jobs")
+        self.running = False
+
+    async def shutdown(self) -> None:
+        if self._consumer_tag is not None and self.broker.connected:
+            try:
+                await self.broker.cancel(self._consumer_tag)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            self._consumer_tag = None
+        try:
+            await asyncio.wait_for(self._drained.wait(), timeout=30.0)
+        except asyncio.TimeoutError:
+            self.logger.warning("Timed out draining %d in-flight jobs", self._in_flight)
+        await self._cleanup_processor()
+        if self.broker.connected:
+            await self.broker.disconnect()
+        self.logger.info(
+            "Worker %s stopped (processed=%d failed=%d)",
+            self.worker_id,
+            self.jobs_processed,
+            self.jobs_failed,
+        )
+
+    # --- the hot loop (reference base.py:137-245) -------------------------
+    async def _process_message(self, message: DeliveredMessage) -> None:
+        self._in_flight += 1
+        self._drained.clear()
+        start = time.monotonic()
+        try:
+            job = Job.model_validate_json(message.body)
+        except Exception as exc:  # malformed payload: drop, never requeue
+            self.logger.error("Unparseable job dropped: %s", exc)
+            self.jobs_failed += 1
+            await message.reject(requeue=False)
+            self._settle_in_flight()
+            return
+        try:
+            output = await self._process_job(job)
+            duration_ms = (time.monotonic() - start) * 1000
+            result = self._build_result(job, output, duration_ms)
+            await self._publish_result(result)
+            await message.ack()
+            self.jobs_processed += 1
+            self.total_duration_ms += duration_ms
+            if self.jobs_processed % 100 == 0:
+                self.logger.info(
+                    "Processed %d jobs (avg %.0f ms)",
+                    self.jobs_processed,
+                    self.total_duration_ms / self.jobs_processed,
+                )
+        except ValueError as exc:
+            # Job is semantically invalid — retrying can't fix it. Ack &
+            # drop (reference base.py:228-235).
+            self.logger.error("Job %s invalid, dropping: %s", job.id, exc)
+            self.jobs_failed += 1
+            await message.ack()
+        except Exception as exc:  # noqa: BLE001 — transient: requeue
+            self.logger.warning(
+                "Job %s failed (delivery %d), requeueing: %s",
+                job.id,
+                message.delivery_count,
+                exc,
+            )
+            self.jobs_failed += 1
+            await message.reject(requeue=True)
+        finally:
+            self._settle_in_flight()
+
+    def _settle_in_flight(self) -> None:
+        self._in_flight -= 1
+        if self._in_flight <= 0:
+            self._drained.set()
+
+    def _build_result(self, job: Job, output: str, duration_ms: float) -> Result:
+        """Result with extra-field passthrough (reference base.py:164-186).
+
+        Built dict-first so a job extra named like a Result field (e.g. a
+        dataset with a ``result`` column) can't TypeError the hot loop —
+        Result's own fields win, the colliding extra is preserved under
+        ``job_<name>``.
+        """
+        prompt_repr = (
+            job.get_formatted_prompt() if job.prompt is not None else ""
+        )
+        payload = dict(job.extras())
+        reserved = {
+            "id": job.id,
+            "prompt": prompt_repr,
+            "result": output,
+            "worker_id": self.worker_id,
+            "duration_ms": duration_ms,
+        }
+        for key in (*reserved, "timestamp", "usage"):
+            if key in payload:
+                payload[f"job_{key}"] = payload.pop(key)
+        payload.update(reserved)
+        return Result.model_validate(payload)
+
+    async def _publish_result(self, result: Result) -> None:
+        if self.pipeline is not None and self.stage_name is not None:
+            await self.broker.publish_pipeline_result(
+                self.pipeline, self.stage_name, result
+            )
+        else:
+            await self.broker.publish_result(self.queue, result)
+
+    # --- heartbeats -------------------------------------------------------
+    async def _publish_heartbeat(self) -> None:
+        health = WorkerHealth(
+            worker_id=self.worker_id,
+            status="running" if self.running else "stopping",
+            last_seen=utcnow(),
+            jobs_processed=self.jobs_processed,
+            avg_duration_ms=(
+                self.total_duration_ms / self.jobs_processed
+                if self.jobs_processed
+                else None
+            ),
+            queue=self.queue,
+            engine_stats=self._engine_stats(),
+        )
+        try:
+            await self.broker.broker.publish(
+                self.queue + HEALTH_SUFFIX,
+                health.model_dump_json().encode("utf-8"),
+            )
+        except Exception:  # noqa: BLE001 — heartbeats are best-effort
+            self.logger.debug("Heartbeat publish failed", exc_info=True)
+
+    def _engine_stats(self) -> Optional[dict]:
+        """Subclasses may surface engine metrics (batch occupancy etc.)."""
+        return None

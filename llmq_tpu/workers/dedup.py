@@ -1,0 +1,204 @@
+"""Semantic dedup / outlier / representative filter worker.
+
+Capability-parity with the reference's SemHashWorker (``llmq/workers/
+semhash_worker.py:10-191``), which delegated to the MinishLab ``semhash``
+library. That dependency isn't available here, so the similarity engine is
+implemented natively: hashed character-n-gram TF vectors (a SimHash-family
+representation) + cosine similarity in numpy. Same worker contract:
+
+- accumulate jobs into batches of ``batch_size`` and process per batch,
+- three modes: ``dedup`` (drop near-duplicates), ``outliers`` (drop texts
+  far from the batch centroid), ``representative`` (keep one text per
+  similarity cluster),
+- kept jobs produce their text as the result; dropped jobs produce a
+  ``DEDUP_DROPPED`` marker result (so accounting stays 1-job-1-result and
+  downstream consumers can filter),
+- partial batches flush on shutdown (reference semhash_worker.py:185-191)
+  and after a 5s idle window (so a trickle of jobs is never stuck waiting
+  for a full batch — a deadlock the reference had when fewer than
+  ``batch_size`` jobs remained).
+
+Note: the worker forces ``concurrency >= batch_size``; with a smaller
+prefetch the batch could never fill.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from llmq_tpu.core.models import Job
+from llmq_tpu.workers.base import BaseWorker
+
+DROPPED_MARKER = "DEDUP_DROPPED"
+
+_DIM = 4096
+_NGRAM = 3
+
+
+def text_of(job: Job) -> str:
+    """Pull the text to compare from common fields (reference
+    semhash_worker.py:159-183)."""
+    for field in ("text", "content", "document"):
+        extras = job.extras()
+        if field in extras and isinstance(extras[field], str):
+            return extras[field]
+    if job.messages:
+        parts = [
+            str(m.get("content", "")) for m in job.messages if m.get("content")
+        ]
+        if parts:
+            return "\n".join(parts)
+    if job.prompt is not None:
+        return job.get_formatted_prompt()
+    return ""
+
+
+def embed(texts: List[str], dim: int = _DIM, n: int = _NGRAM) -> np.ndarray:
+    """Hashed char-n-gram TF embedding, L2-normalised. Pure numpy."""
+    out = np.zeros((len(texts), dim), dtype=np.float32)
+    for i, t in enumerate(texts):
+        t = t.lower()
+        if len(t) < n:
+            t = t + " " * (n - len(t))
+        for j in range(len(t) - n + 1):
+            out[i, hash(t[j : j + n]) % dim] += 1.0
+    norms = np.linalg.norm(out, axis=1, keepdims=True)
+    np.divide(out, norms, out=out, where=norms > 0)
+    return out
+
+
+def select_keep_mask(
+    vectors: np.ndarray, mode: str, threshold: float
+) -> np.ndarray:
+    """Which rows to keep, per mode. O(b²) cosine similarity on the batch."""
+    b = vectors.shape[0]
+    if b == 0:
+        return np.zeros(0, dtype=bool)
+    sims = vectors @ vectors.T
+    if mode == "dedup":
+        keep = np.ones(b, dtype=bool)
+        for i in range(1, b):
+            if sims[i, :i][keep[:i]].max(initial=-1.0) >= threshold:
+                keep[i] = False  # near-duplicate of an earlier kept text
+        return keep
+    if mode == "outliers":
+        centroid = vectors.mean(axis=0)
+        cnorm = np.linalg.norm(centroid)
+        if cnorm == 0:
+            return np.ones(b, dtype=bool)
+        sim_to_centroid = vectors @ (centroid / cnorm)
+        # Drop the least-central fraction implied by threshold (e.g. 0.9 →
+        # keep the 90% most central).
+        k = max(1, int(round(b * threshold)))
+        order = np.argsort(-sim_to_centroid)
+        keep = np.zeros(b, dtype=bool)
+        keep[order[:k]] = True
+        return keep
+    if mode == "representative":
+        # Greedy leader clustering at `threshold`; keep each cluster leader.
+        keep = np.zeros(b, dtype=bool)
+        leaders: List[int] = []
+        for i in range(b):
+            if not leaders or sims[i, leaders].max() < threshold:
+                leaders.append(i)
+                keep[i] = True
+        return keep
+    raise ValueError(f"Unknown dedup mode: {mode!r}")
+
+
+@dataclass
+class _Pending:
+    job: Job
+    future: asyncio.Future
+
+
+class DedupWorker(BaseWorker):
+    def __init__(
+        self,
+        queue: str,
+        *,
+        batch_size: int = 256,
+        mode: str = "dedup",
+        threshold: float = 0.9,
+        **kwargs,
+    ) -> None:
+        self.batch_size = batch_size
+        self.mode = mode
+        self.threshold = threshold
+        self.idle_flush_s = 5.0
+        self._pending: List[_Pending] = []
+        self._last_arrival = 0.0
+        self._batch_lock: Optional[asyncio.Lock] = None
+        self._flusher: Optional[asyncio.Task] = None
+        super().__init__(queue, **kwargs)
+        self.concurrency = max(self.concurrency, batch_size)
+
+    def _generate_worker_id(self) -> str:
+        return f"dedup-{self.mode}-{uuid.uuid4().hex[:8]}"
+
+    async def _initialize_processor(self) -> None:
+        self._batch_lock = asyncio.Lock()
+        self._flusher = asyncio.ensure_future(self._idle_flush_loop())
+
+    async def _idle_flush_loop(self) -> None:
+        """Flush a partial batch once arrivals go idle for idle_flush_s."""
+        while True:
+            await asyncio.sleep(1.0)
+            assert self._batch_lock is not None
+            flush: Optional[List[_Pending]] = None
+            async with self._batch_lock:
+                if (
+                    self._pending
+                    and asyncio.get_running_loop().time() - self._last_arrival
+                    > self.idle_flush_s
+                ):
+                    flush = self._pending
+                    self._pending = []
+            if flush:
+                self._process_batch(flush)
+
+    async def _process_job(self, job: Job) -> str:
+        """Queue the job into the current batch; resolves when the batch
+        (or a shutdown flush) processes it."""
+        assert self._batch_lock is not None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        flush: Optional[List[_Pending]] = None
+        async with self._batch_lock:
+            self._pending.append(_Pending(job, fut))
+            self._last_arrival = asyncio.get_running_loop().time()
+            if len(self._pending) >= self.batch_size:
+                flush = self._pending
+                self._pending = []
+        if flush is not None:
+            self._process_batch(flush)
+        return await fut
+
+    def _process_batch(self, batch: List[_Pending]) -> None:
+        texts = [text_of(p.job) for p in batch]
+        vectors = embed(texts)
+        keep = select_keep_mask(vectors, self.mode, self.threshold)
+        for pending, kept, text in zip(batch, keep, texts):
+            if not pending.future.done():
+                pending.future.set_result(text if kept else DROPPED_MARKER)
+
+    async def _cleanup_processor(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+        assert self._batch_lock is not None
+        async with self._batch_lock:
+            flush = self._pending
+            self._pending = []
+        if flush:
+            self._process_batch(flush)
+
+    def _engine_stats(self) -> Optional[Dict]:
+        return {
+            "mode": self.mode,
+            "batch_size": self.batch_size,
+            "pending": len(self._pending),
+        }

@@ -1,0 +1,73 @@
+"""CLI behavior via click's test runner (the reference had no CLI tests —
+SURVEY.md §4 notes the gap; we cover the surface)."""
+
+import json
+
+from click.testing import CliRunner
+
+from llmq_tpu.cli.main import cli
+
+
+def test_help():
+    result = CliRunner().invoke(cli, ["--help"])
+    assert result.exit_code == 0
+    for cmd in ("submit", "receive", "status", "health", "errors", "clear", "worker", "broker"):
+        assert cmd in result.output
+
+
+def test_version():
+    result = CliRunner().invoke(cli, ["--version"])
+    assert result.exit_code == 0
+    assert "llmq-tpu" in result.output
+
+
+def test_worker_help_lists_types():
+    result = CliRunner().invoke(cli, ["worker", "--help"])
+    assert result.exit_code == 0
+    for cmd in ("run", "dummy", "dedup", "pipeline"):
+        assert cmd in result.output
+
+
+def test_submit_bad_map():
+    result = CliRunner().invoke(cli, ["submit", "q", "-", "--map", "no-equals-sign"])
+    assert result.exit_code != 0
+    assert "field=TEMPLATE" in result.output
+
+
+def test_submit_stdin_and_status(mem_url, monkeypatch):
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    runner = CliRunner()
+    jobs = "\n".join(
+        json.dumps({"id": f"s{i}", "prompt": "p {x}", "x": i}) for i in range(3)
+    )
+    result = runner.invoke(cli, ["submit", "cliq", "-"], input=jobs + "\n")
+    assert result.exit_code == 0, result.output
+    # Note: memory:// broker state dies with the submit's event loop, so a
+    # separate status invocation can't see the messages; status must still
+    # succeed and render the table.
+    result = runner.invoke(cli, ["status", "cliq"])
+    assert result.exit_code == 0, result.output
+    assert "cliq" in result.output
+
+
+def test_status_no_args_probe(mem_url, monkeypatch):
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    result = CliRunner().invoke(cli, ["status"])
+    assert result.exit_code == 0
+    assert "Connected" in result.output
+
+
+def test_clear_requires_confirmation(mem_url, monkeypatch):
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    result = CliRunner().invoke(cli, ["clear", "someq"], input="n\n")
+    assert result.exit_code != 0  # aborted
+    result = CliRunner().invoke(cli, ["clear", "someq", "--yes"])
+    assert result.exit_code == 0
+    assert "Purged" in result.output
+
+
+def test_errors_empty(mem_url, monkeypatch):
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    result = CliRunner().invoke(cli, ["errors", "someq"])
+    assert result.exit_code == 0
+    assert "No dead-lettered" in result.output

@@ -1,0 +1,100 @@
+"""Job/Result schema behavior (parity with reference tests/test_models.py)."""
+
+import json
+
+import pytest
+from pydantic import ValidationError
+
+from llmq_tpu.core.models import Job, Result, SamplingOptions
+
+
+class TestJob:
+    def test_prompt_job(self, sample_job_dict):
+        job = Job(**sample_job_dict)
+        assert job.id == "job-1"
+        assert job.prompt == "Translate {text} to {lang}"
+        assert job.messages is None
+
+    def test_messages_job(self):
+        job = Job(id="j", messages=[{"role": "user", "content": "hi"}])
+        assert job.prompt is None
+        assert job.messages[0]["content"] == "hi"
+
+    def test_prompt_xor_messages_both(self):
+        with pytest.raises(ValidationError):
+            Job(id="j", prompt="p", messages=[{"role": "user", "content": "x"}])
+
+    def test_prompt_xor_messages_neither(self):
+        with pytest.raises(ValidationError):
+            Job(id="j")
+
+    def test_extra_fields_passthrough(self, sample_job_dict):
+        job = Job(**sample_job_dict, source="fineweb", shard=3)
+        extras = job.extras()
+        assert extras["source"] == "fineweb"
+        assert extras["shard"] == 3
+        assert "prompt" not in extras and "id" not in extras
+
+    def test_formatted_prompt(self, sample_job_dict):
+        job = Job(**sample_job_dict)
+        assert job.get_formatted_prompt() == "Translate hello world to Dutch"
+
+    def test_formatted_prompt_braces_in_data(self):
+        job = Job(id="j", prompt="Echo {text}", text="a {weird} value")
+        # Substitution is single-pass: braces in data stay literal.
+        assert job.get_formatted_prompt() == "Echo a {weird} value"
+
+    def test_formatted_prompt_missing_var_left_verbatim(self):
+        job = Job(id="j", prompt="Hello {name}")
+        assert job.get_formatted_prompt() == "Hello {name}"
+
+    def test_stop_sequences(self):
+        job = Job(id="j", prompt="p", stop=["\n\n", "###"])
+        assert job.stop == ["\n\n", "###"]
+
+    def test_sampling_options(self):
+        job = Job(id="j", prompt="p", sampling={"temperature": 0.0, "max_tokens": 64})
+        assert job.sampling.greedy
+        assert job.sampling.max_tokens == 64
+
+    def test_json_roundtrip(self, sample_job_dict):
+        job = Job(**sample_job_dict)
+        data = json.loads(job.model_dump_json())
+        job2 = Job(**data)
+        assert job2 == job
+
+
+class TestResult:
+    def test_result_passthrough_extras(self):
+        r = Result(
+            id="j",
+            prompt="p",
+            result="out",
+            worker_id="w1",
+            duration_ms=12.5,
+            lang="nl",
+        )
+        dumped = json.loads(r.model_dump_json())
+        assert dumped["lang"] == "nl"
+        assert dumped["worker_id"] == "w1"
+
+    def test_usage_field(self):
+        r = Result(
+            id="j",
+            prompt="p",
+            result="out",
+            worker_id="w",
+            duration_ms=1.0,
+            usage={"prompt_tokens": 5, "completion_tokens": 7},
+        )
+        assert r.usage["completion_tokens"] == 7
+
+
+class TestSamplingOptions:
+    def test_defaults(self):
+        s = SamplingOptions()
+        assert s.temperature == 0.7 and not s.greedy
+
+    def test_rejects_unknown_fields(self):
+        with pytest.raises(ValidationError):
+            SamplingOptions(banana=1)

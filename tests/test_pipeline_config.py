@@ -1,0 +1,87 @@
+"""Pipeline YAML schema tests (reference core/pipeline.py parity + fixes)."""
+
+import pytest
+from pydantic import ValidationError
+
+from llmq_tpu.core.pipeline import PipelineConfig
+
+SIMPLE = """
+name: simple-test
+stages:
+  - name: stage1
+    worker: dummy
+  - name: stage2
+    worker: dummy
+"""
+
+TRANSLATE = """
+name: translate-format
+stages:
+  - name: translate
+    worker: tpu
+    config:
+      model: some/model-9b
+      prompt: "Translate to Dutch: {text}"
+  - name: format
+    worker: tpu
+    config:
+      model: some/model-2b
+      prompt: "Format this translation nicely: {result}"
+config:
+  timeout_minutes: 60
+"""
+
+
+def test_load_simple():
+    cfg = PipelineConfig.from_yaml_string(SIMPLE)
+    assert cfg.name == "simple-test"
+    assert [s.name for s in cfg.stages] == ["stage1", "stage2"]
+
+
+def test_queue_names():
+    cfg = PipelineConfig.from_yaml_string(SIMPLE)
+    assert cfg.get_stage_queue_name("stage1") == "pipeline.simple-test.stage1"
+    assert cfg.get_pipeline_results_queue_name() == "pipeline.simple-test.results"
+    assert cfg.stage_queue_names() == [
+        "pipeline.simple-test.stage1",
+        "pipeline.simple-test.stage2",
+    ]
+
+
+def test_next_stage():
+    cfg = PipelineConfig.from_yaml_string(SIMPLE)
+    assert cfg.next_stage("stage1").name == "stage2"
+    assert cfg.next_stage("stage2") is None
+    with pytest.raises(KeyError):
+        cfg.next_stage("nope")
+
+
+def test_stage_templates():
+    cfg = PipelineConfig.from_yaml_string(TRANSLATE)
+    assert cfg.stages[0].prompt_template() == "Translate to Dutch: {text}"
+    assert "{result}" in cfg.stages[1].prompt_template()
+
+
+def test_invalid_names():
+    with pytest.raises(ValidationError):
+        PipelineConfig.from_yaml_string("name: 'bad name!'\nstages:\n  - name: a\n    worker: dummy\n")
+    with pytest.raises(ValidationError):
+        PipelineConfig.from_yaml_string("name: ok\nstages:\n  - name: 'sp ace'\n    worker: dummy\n")
+
+
+def test_duplicate_stage_names():
+    bad = """
+name: p
+stages:
+  - name: s
+    worker: dummy
+  - name: s
+    worker: dummy
+"""
+    with pytest.raises(ValidationError):
+        PipelineConfig.from_yaml_string(bad)
+
+
+def test_missing_file(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        PipelineConfig.from_yaml_file(tmp_path / "nope.yaml")

@@ -1,0 +1,87 @@
+"""Canonical templating module tests (reference duplicated this logic; we
+guarantee one behavior — SURVEY.md §2 #16)."""
+
+import pytest
+
+from llmq_tpu.core.template import (
+    apply_mapping,
+    create_job_from_row,
+    extract_template_variables,
+    parse_map_spec,
+    resolve_template_string,
+    resolve_template_value,
+)
+
+
+def test_extract_variables():
+    assert extract_template_variables("Translate {text} to {lang}") == ["text", "lang"]
+    assert extract_template_variables("no vars") == []
+    assert extract_template_variables("{{literal}} {x}") == ["x"]
+
+
+def test_resolve_string():
+    assert resolve_template_string("a {b} c", {"b": "B"}) == "a B c"
+
+
+def test_resolve_missing_nonstrict():
+    assert resolve_template_string("a {b}", {}) == "a {b}"
+
+
+def test_resolve_missing_strict():
+    with pytest.raises(KeyError):
+        resolve_template_string("a {b}", {}, strict=True)
+
+
+def test_resolve_value_recursive():
+    messages = [{"role": "user", "content": "Translate {text}"}]
+    out = resolve_template_value(messages, {"text": "hoi"})
+    assert out[0]["content"] == "Translate hoi"
+
+
+def test_parse_map_spec_json_vs_string():
+    assert parse_map_spec('["a", "{x}"]') == ["a", "{x}"]
+    assert parse_map_spec("Translate {x}") == "Translate {x}"
+
+
+def test_apply_mapping_template_column_literal():
+    row = {"text": "hi", "lang": "nl"}
+    mapping = {
+        "prompt": "Translate {text} to {lang}",  # string template
+        "orig": "text",  # column copy
+        "tag": "static-value",  # literal (not a column)
+    }
+    out = apply_mapping(mapping, row)
+    assert out["prompt"] == "Translate hi to nl"
+    assert out["orig"] == "hi"
+    assert out["tag"] == "static-value"
+
+
+def test_apply_mapping_messages_json():
+    row = {"text": "hi"}
+    mapping = {"messages": [{"role": "user", "content": "Say {text}"}]}
+    out = apply_mapping(mapping, row)
+    assert out["messages"][0]["content"] == "Say hi"
+
+
+def test_create_job_from_row_fallback_text():
+    job = create_job_from_row({"text": "plain doc"})
+    assert job["prompt"] == "plain doc"
+    assert "id" in job
+
+
+def test_create_job_from_row_existing_prompt():
+    job = create_job_from_row({"prompt": "already here", "x": 1})
+    assert job["prompt"] == "already here"
+    assert job["x"] == 1
+
+
+def test_create_job_from_row_no_text_raises():
+    with pytest.raises(ValueError):
+        create_job_from_row({"content": "no text column"})
+
+
+def test_create_job_from_row_mapping_prompt_wins_over_messages_column():
+    row = {"messages": [{"role": "user", "content": "x"}], "text": "t"}
+    job = create_job_from_row(row, {"prompt": "P {text}"})
+    assert job["prompt"] == "P t"
+    assert "messages" not in job

@@ -1,0 +1,218 @@
+"""Worker loop behavior: processing, passthrough, error policy, pipelines.
+
+Pattern mirrors the reference's integration tests (real broker semantics via
+the in-process broker + DummyWorker as fake backend, test_integration.py).
+"""
+
+import asyncio
+import json
+
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.core.config import Config
+from llmq_tpu.core.models import Job, Result
+from llmq_tpu.core.pipeline import PipelineConfig
+from llmq_tpu.workers.dedup import DROPPED_MARKER, DedupWorker, embed, select_keep_mask
+from llmq_tpu.workers.dummy import DummyWorker
+
+
+async def _drain_results(mgr, queue, n, timeout=10.0):
+    out = []
+    deadline = asyncio.get_running_loop().time() + timeout
+    while len(out) < n and asyncio.get_running_loop().time() < deadline:
+        msg = await mgr.broker.get(queue)
+        if msg is None:
+            await asyncio.sleep(0.02)
+            continue
+        out.append(Result(**json.loads(msg.body)))
+        await msg.ack()
+    return out
+
+
+async def _run_worker_until(worker, condition, timeout=10.0):
+    task = asyncio.ensure_future(worker.run())
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not condition() and asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.02)
+    worker.request_shutdown()
+    await asyncio.wait_for(task, timeout=15.0)
+
+
+class TestDummyWorker:
+    async def test_end_to_end(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            for i in range(5):
+                await mgr.publish_job(
+                    "q", Job(id=f"j{i}", prompt="say {word}", word=f"w{i}")
+                )
+            worker = DummyWorker("q", delay=0, config=cfg, concurrency=4)
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 5)
+            results = await _drain_results(mgr, "q.results", 5)
+            assert {r.id for r in results} == {f"j{i}" for i in range(5)}
+            r0 = next(r for r in results if r.id == "j0")
+            assert r0.result == "echo say w0"
+            assert r0.prompt == "say w0"
+            # extra-field passthrough
+            assert json.loads(r0.model_dump_json())["word"] == "w0"
+
+    async def test_malformed_job_dropped(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.broker.publish("q", b"this is not json")
+            await mgr.publish_job("q", Job(id="ok", prompt="fine"))
+            worker = DummyWorker("q", delay=0, config=cfg)
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 1)
+            assert worker.jobs_failed == 1
+            stats = await mgr.get_queue_stats("q")
+            assert stats.message_count == 0  # bad message not requeued
+
+    async def test_processing_error_requeues_then_dlqs(self, mem_url):
+        class FailingWorker(DummyWorker):
+            async def _process_job(self, job):
+                raise RuntimeError("boom")
+
+        cfg = Config(broker_url=mem_url, max_redeliveries=1)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job("q", Job(id="doomed", prompt="p"))
+            worker = FailingWorker("q", delay=0, config=cfg)
+            # 1 initial + 1 redelivery then DLQ
+            await _run_worker_until(worker, lambda: worker.jobs_failed >= 2)
+            await asyncio.sleep(0.1)
+            errors = await mgr.get_failed_jobs("q")
+            assert len(errors) == 1
+            assert errors[0].job_id == "doomed"
+
+    async def test_invalid_job_value_error_acked(self, mem_url):
+        class PickyWorker(DummyWorker):
+            async def _process_job(self, job):
+                raise ValueError("semantically bad")
+
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job("q", Job(id="bad", prompt="p"))
+            worker = PickyWorker("q", delay=0, config=cfg)
+            await _run_worker_until(worker, lambda: worker.jobs_failed >= 1)
+            stats = await mgr.get_queue_stats("q")
+            assert stats.message_count == 0  # dropped, not requeued
+
+    async def test_chat_messages(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job(
+                "q", Job(id="c", messages=[{"role": "user", "content": "hoi"}])
+            )
+            worker = DummyWorker("q", delay=0, config=cfg)
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 1)
+            results = await _drain_results(mgr, "q.results", 1)
+            assert results[0].result == "echo hoi"
+
+
+class TestPipelineWorkers:
+    async def test_two_stage_pipeline(self, mem_url):
+        pipeline = PipelineConfig.from_yaml_string(
+            """
+name: twostep
+stages:
+  - name: first
+    worker: dummy
+  - name: second
+    worker: dummy
+    config:
+      prompt: "stage2 saw: {result}"
+"""
+        )
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_pipeline_infrastructure(pipeline)
+            q1 = pipeline.get_stage_queue_name("first")
+            await mgr.publish_job(q1, Job(id="x", prompt="start", source="test"))
+
+            w1 = DummyWorker(
+                q1, delay=0, config=cfg, pipeline=pipeline, stage_name="first"
+            )
+            w2 = DummyWorker(
+                pipeline.get_stage_queue_name("second"),
+                delay=0,
+                config=cfg,
+                pipeline=pipeline,
+                stage_name="second",
+            )
+            t1 = asyncio.ensure_future(w1.run())
+            t2 = asyncio.ensure_future(w2.run())
+            final = await _drain_results(mgr, "pipeline.twostep.results", 1)
+            w1.request_shutdown()
+            w2.request_shutdown()
+            await asyncio.gather(t1, t2)
+            assert len(final) == 1
+            # stage-2 template applied to stage-1 output (the fix)
+            assert final[0].result == "echo stage2 saw: echo start"
+            # passthrough extra survived both hops
+            assert json.loads(final[0].model_dump_json())["source"] == "test"
+
+
+class TestDedupMath:
+    def test_embed_shapes_and_norm(self):
+        import numpy as np
+
+        v = embed(["hello world", "hello world!", "totally different text"])
+        assert v.shape[0] == 3
+        norms = np.linalg.norm(v, axis=1)
+        assert np.allclose(norms, 1.0, atol=1e-5)
+        sim_close = float(v[0] @ v[1])
+        sim_far = float(v[0] @ v[2])
+        assert sim_close > sim_far
+
+    def test_dedup_mask(self):
+        texts = ["the quick brown fox", "the quick brown fox!", "unrelated zebra"]
+        keep = select_keep_mask(embed(texts), "dedup", threshold=0.8)
+        assert keep.tolist() == [True, False, True]
+
+    def test_representative_mask(self):
+        texts = [
+            "alpha beta gamma",
+            "alpha beta gamma delta",
+            "omega psi chi",
+        ]
+        keep = select_keep_mask(embed(texts), "representative", threshold=0.7)
+        assert keep[0] and keep[2]
+
+    def test_outliers_mask_keeps_fraction(self):
+        texts = ["cat dog", "cat dog bird", "cat dog fish", "quantum entanglement"]
+        keep = select_keep_mask(embed(texts), "outliers", threshold=0.75)
+        assert keep.sum() == 3
+        assert not keep[3]
+
+
+class TestDedupWorker:
+    async def test_batch_dedup_end_to_end(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("d")
+            texts = ["same text here", "same text here", "different content"]
+            for i, t in enumerate(texts):
+                await mgr.publish_job("d", Job(id=f"t{i}", prompt="{text}", text=t))
+            worker = DedupWorker(
+                "d", batch_size=3, threshold=0.95, config=cfg, concurrency=8
+            )
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 3)
+            results = await _drain_results(mgr, "d.results", 3)
+            by_id = {r.id: r.result for r in results}
+            assert by_id["t0"] == "same text here"
+            assert by_id["t1"] == DROPPED_MARKER
+            assert by_id["t2"] == "different content"
+
+    async def test_partial_batch_flushes_on_shutdown(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("d")
+            await mgr.publish_job("d", Job(id="only", prompt="{text}", text="solo"))
+            worker = DedupWorker("d", batch_size=100, config=cfg)
+            worker.idle_flush_s = 0.2  # fast idle flush for the test
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 1)
+            results = await _drain_results(mgr, "d.results", 1)
+            assert results[0].result == "solo"
