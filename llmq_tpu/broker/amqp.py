@@ -120,13 +120,23 @@ class AmqpBroker(Broker):
         )
 
     async def stats(self, queue: str) -> QueueStats:  # pragma: no cover
-        q = await self._channel.declare_queue(queue, durable=True, passive=True)
-        return QueueStats(
-            queue_name=queue,
-            message_count=q.declaration_result.message_count,
-            consumer_count=q.declaration_result.consumer_count,
-            stats_source="amqp_fallback",
-        )
+        # Passive declare raises (and poisons the channel) for a missing
+        # queue; use a throwaway channel and map the failure onto the
+        # cross-implementation 'unavailable' contract.
+        try:
+            channel = await self._conn.channel()
+            try:
+                q = await channel.declare_queue(queue, durable=True, passive=True)
+                return QueueStats(
+                    queue_name=queue,
+                    message_count=q.declaration_result.message_count,
+                    consumer_count=q.declaration_result.consumer_count,
+                    stats_source="amqp_fallback",
+                )
+            finally:
+                await channel.close()
+        except Exception:  # noqa: BLE001 — queue missing / channel error
+            return QueueStats(queue_name=queue, stats_source="unavailable")
 
     async def purge(self, queue: str) -> int:  # pragma: no cover
         q = self._queues.get(queue) or await self._channel.declare_queue(

@@ -34,6 +34,26 @@ def new_message_id() -> str:
     return uuid.uuid4().hex
 
 
+def encode_body(body: bytes) -> Dict[str, Any]:
+    """Encode a payload for a JSON envelope. Payloads are normally UTF-8
+    JSON (Job/Result), but the Broker contract accepts arbitrary bytes —
+    non-UTF-8 bodies ride as base64 with an ``enc`` marker."""
+    try:
+        return {"body": body.decode("utf-8")}
+    except UnicodeDecodeError:
+        import base64
+
+        return {"body": base64.b64encode(body).decode("ascii"), "enc": "b64"}
+
+
+def decode_body(envelope: Dict[str, Any]) -> bytes:
+    if envelope.get("enc") == "b64":
+        import base64
+
+        return base64.b64decode(envelope["body"])
+    return envelope["body"].encode("utf-8")
+
+
 @dataclass
 class StoredMessage:
     """Broker-side message record."""
@@ -47,7 +67,7 @@ class StoredMessage:
     def to_json(self) -> str:
         return json.dumps(
             {
-                "body": self.body.decode("utf-8"),
+                **encode_body(self.body),
                 "message_id": self.message_id,
                 "headers": self.headers,
                 "delivery_count": self.delivery_count,
@@ -59,7 +79,7 @@ class StoredMessage:
     def from_json(cls, raw: str) -> "StoredMessage":
         d = json.loads(raw)
         return cls(
-            body=d["body"].encode("utf-8"),
+            body=decode_body(d),
             message_id=d["message_id"],
             headers=d.get("headers", {}),
             delivery_count=d.get("delivery_count", 0),

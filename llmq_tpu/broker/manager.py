@@ -96,7 +96,11 @@ class BrokerManager:
                 max_redeliveries=self.config.max_redeliveries,
             )
             await self.broker.declare_queue(qname + FAILED_SUFFIX)
-        await self.broker.declare_queue(pipeline.get_pipeline_results_queue_name())
+        # Same durable-download semantics as <q>.results (see above).
+        await self.broker.declare_queue(
+            pipeline.get_pipeline_results_queue_name(),
+            max_redeliveries=1_000_000_000,
+        )
 
     # --- publish ----------------------------------------------------------
     async def publish_job(self, queue: str, job: Job) -> None:

@@ -227,7 +227,9 @@ class BrokerCore:
         self._schedule_dispatch(queue)
 
     def remove_consumer(self, tag: str, *, requeue_in_flight: bool = True) -> None:
-        for q in self.queues.values():
+        # list(): dead-lettering inside the loop may auto-declare a
+        # '.failed' queue, and mutating self.queues mid-iteration raises.
+        for q in list(self.queues.values()):
             consumer = q.consumers.pop(tag, None)
             if consumer is not None:
                 consumer.cancelled = True

@@ -36,6 +36,8 @@ from llmq_tpu.broker.base import (
     Broker,
     DeliveredMessage,
     MessageHandler,
+    decode_body,
+    encode_body,
     new_message_id,
 )
 from llmq_tpu.broker.memory import BrokerCore
@@ -134,7 +136,7 @@ class BrokerServer:
         for rec in live.values():
             self.core.publish(
                 rec["queue"],
-                rec["body"].encode("utf-8"),
+                decode_body(rec),
                 message_id=rec["message_id"],
                 headers=rec.get("headers", {}),
                 delivery_count=rec.get("delivery_count", 0),
@@ -177,7 +179,7 @@ class BrokerServer:
                     "op": "publish",
                     "queue": qname,
                     "message_id": msg.message_id,
-                    "body": msg.body.decode("utf-8"),
+                    **encode_body(msg.body),
                     "headers": msg.headers,
                     "delivery_count": msg.delivery_count,
                 }
@@ -199,7 +201,7 @@ class BrokerServer:
                 "op": "publish",
                 "queue": queue + ".failed",
                 "message_id": msg.message_id,
-                "body": msg.body.decode("utf-8"),
+                **encode_body(msg.body),
                 "headers": headers,
             }
         )
@@ -302,12 +304,13 @@ class BrokerServer:
                     "queue": req["queue"],
                     "message_id": message_id,
                     "body": req["body"],
+                    **({"enc": req["enc"]} if req.get("enc") else {}),
                     "headers": req.get("headers", {}),
                 }
             )
             self.core.publish(
                 req["queue"],
-                req["body"].encode("utf-8"),
+                decode_body(req),
                 message_id=message_id,
                 headers=req.get("headers"),
             )
@@ -326,7 +329,7 @@ class BrokerServer:
                             "queue": queue,
                             "tag": tag,
                             "message_id": message.message_id,
-                            "body": message.body.decode("utf-8"),
+                            **encode_body(message.body),
                             "delivery_count": message.delivery_count,
                             "headers": message.headers,
                         }
@@ -389,7 +392,7 @@ class BrokerServer:
                         empty=False,
                         tag=tag,
                         message_id=message.message_id,
-                        body=message.body.decode("utf-8"),
+                        **encode_body(message.body),
                         delivery_count=message.delivery_count,
                         headers=message.headers,
                     )
@@ -429,6 +432,10 @@ class TcpBroker(Broker):
         self._recv_task: Optional[asyncio.Task] = None
         self._replies: Dict[str, asyncio.Future] = {}
         self._handlers: Dict[str, MessageHandler] = {}
+        # Deliveries can land before consume() has registered the handler
+        # (the server starts dispatching the moment the consumer exists);
+        # buffer them per-tag until the handler is in place.
+        self._undispatched: Dict[str, list] = {}
         self._write_lock: Optional[asyncio.Lock] = None
         self._req_seq = 0
 
@@ -485,10 +492,13 @@ class TcpBroker(Broker):
                             RuntimeError(frame.get("error", "broker error"))
                         )
             elif ftype == "deliver":
-                handler = self._handlers.get(frame["tag"])
+                tag = frame["tag"]
+                handler = self._handlers.get(tag)
                 if handler is not None:
                     message = self._delivered_from(frame)
                     asyncio.ensure_future(self._run_handler(handler, message))
+                else:
+                    self._undispatched.setdefault(tag, []).append(frame)
 
     async def _run_handler(
         self, handler: MessageHandler, message: DeliveredMessage
@@ -517,7 +527,7 @@ class TcpBroker(Broker):
                 pass  # server requeues in-flight messages on disconnect
 
         return DeliveredMessage(
-            frame["body"].encode("utf-8"),
+            decode_body(frame),
             message_id,
             delivery_count=frame.get("delivery_count", 0),
             headers=frame.get("headers", {}),
@@ -567,7 +577,7 @@ class TcpBroker(Broker):
             {
                 "op": "publish",
                 "queue": queue,
-                "body": body.decode("utf-8"),
+                **encode_body(body),
                 "message_id": message_id,
                 "headers": headers or {},
             }
@@ -581,6 +591,9 @@ class TcpBroker(Broker):
         )
         tag = reply["tag"]
         self._handlers[tag] = handler
+        for frame in self._undispatched.pop(tag, []):
+            message = self._delivered_from(frame)
+            asyncio.ensure_future(self._run_handler(handler, message))
         return tag
 
     async def cancel(self, consumer_tag: str) -> None:

@@ -131,13 +131,14 @@ class JobSubmitter:
         chunk: list[Job] = []
         seq = 0
         for row in iter_source(self.source, split=self.split, subset=self.subset):
-            if self.limit is not None and seq >= self.limit:
+            # --limit counts jobs actually accepted, not raw rows.
+            if self.limit is not None and self.submitted + len(chunk) >= self.limit:
                 break
-            job_dict = create_job_from_row(
-                row, self.mapping or None, job_id=f"{run_id}-{seq}"
-            )
             seq += 1
             try:
+                job_dict = create_job_from_row(
+                    row, self.mapping or None, job_id=f"{run_id}-{seq}"
+                )
                 chunk.append(Job(**job_dict))
             except Exception as exc:  # noqa: BLE001 — skip bad rows, keep going
                 logger.warning("Skipping invalid row %d: %s", seq, exc)

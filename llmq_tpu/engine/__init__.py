@@ -1,0 +1,24 @@
+"""TPU inference engine.
+
+The native replacement for what the reference delegated to vLLM
+(``AsyncLLMEngine`` — reference vllm_worker.py:4-5,104-123): model forward
+via JAX/XLA, paged KV cache, continuous-batching scheduler, async request
+API, HF checkpoint loading, sampling.
+
+Submodules import lazily — pulling in ``llmq_tpu.engine`` must not initialise
+jax for code paths that never touch the engine.
+"""
+
+__all__ = ["EngineConfig", "InferenceEngine", "AsyncEngine"]
+
+
+def __getattr__(name: str):
+    if name == "EngineConfig":
+        from llmq_tpu.engine.config import EngineConfig
+
+        return EngineConfig
+    if name in ("InferenceEngine", "AsyncEngine"):
+        from llmq_tpu.engine import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(name)

@@ -1,0 +1,200 @@
+"""HF checkpoint loading: safetensors → stacked param pytree.
+
+The reference inherited weight loading from vLLM; here it's native. Reads a
+HuggingFace model directory (config.json + *.safetensors), maps tensor names
+onto the ``models/transformer.py`` layout, stacks per-layer weights on a
+leading [L, ...] axis (for the scanned layer body), and places shards
+directly onto devices with the engine's NamedShardings — each tensor is
+loaded once and shipped to its device placement without a full host-side
+model copy per device.
+
+Name mapping (HF → ours):
+    model.embed_tokens.weight            embed                 [V, H]
+    model.layers.N.input_layernorm       layers.ln1[N]
+    model.layers.N.self_attn.{q,k,v}_proj  layers.{q,k,v}_proj[N]  (transposed)
+    model.layers.N.self_attn.o_proj      layers.o_proj[N]      (transposed)
+    model.layers.N.post_attention_layernorm
+        → layers.ln2[N] for llama/qwen (it is the pre-MLP norm there)
+        → layers.post_attn_norm[N] for gemma2 (true post-attn norm)
+    model.layers.N.pre_feedforward_layernorm   layers.ln2[N]   (gemma2)
+    model.layers.N.post_feedforward_layernorm  layers.post_mlp_norm[N]
+    model.layers.N.mlp.{gate,up,down}_proj     layers.*[N]     (transposed)
+    model.norm.weight                    final_norm
+    lm_head.weight                       lm_head               (transposed)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import Params
+
+logger = logging.getLogger(__name__)
+
+
+def _open_checkpoint(model_path: Path) -> Dict[str, Any]:
+    """Map tensor name → (file, loader) across all safetensors shards."""
+    from safetensors import safe_open
+
+    index: Dict[str, Path] = {}
+    index_file = model_path / "model.safetensors.index.json"
+    if index_file.exists():
+        weight_map = json.loads(index_file.read_text())["weight_map"]
+        for name, fname in weight_map.items():
+            index[name] = model_path / fname
+    else:
+        shards = sorted(model_path.glob("*.safetensors"))
+        if not shards:
+            raise FileNotFoundError(f"No *.safetensors under {model_path}")
+        for shard in shards:
+            with safe_open(shard, framework="np") as f:
+                for name in f.keys():
+                    index[name] = shard
+    return index
+
+
+class _TensorReader:
+    """Lazily reads tensors from safetensors shards, one file handle each."""
+
+    def __init__(self, model_path: Path) -> None:
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.index = _open_checkpoint(model_path)
+        self._handles: Dict[Path, Any] = {}
+
+    def names(self) -> List[str]:
+        return list(self.index.keys())
+
+    def get(self, name: str) -> np.ndarray:
+        path = self.index[name]
+        handle = self._handles.get(path)
+        if handle is None:
+            handle = self._safe_open(path, framework="np")
+            self._handles[path] = handle
+        tensor = handle.get_tensor(name)
+        return tensor
+
+    def close(self) -> None:
+        self._handles.clear()
+
+
+def _to_jnp(x: np.ndarray, dtype) -> jnp.ndarray:
+    # Some checkpoints store bf16, which numpy renders via ml_dtypes; view
+    # through jnp handles both.
+    return jnp.asarray(x).astype(dtype)
+
+
+def load_checkpoint(
+    model_path: str | Path,
+    config: Optional[ModelConfig] = None,
+    *,
+    dtype=jnp.bfloat16,
+    put: Optional[Callable[[str, jnp.ndarray], jnp.ndarray]] = None,
+) -> Params:
+    """Load an HF checkpoint directory into the stacked param layout.
+
+    ``put(param_name, array)`` lets the caller apply device placement /
+    sharding per parameter (engine passes a NamedSharding-aware placer);
+    default is plain host→default-device transfer.
+    """
+    model_path = Path(model_path)
+    if config is None:
+        config = ModelConfig.from_pretrained(model_path)
+    reader = _TensorReader(model_path)
+    place = put or (lambda name, arr: jax.device_put(arr))
+    L = config.num_layers
+
+    def tensor(name: str) -> np.ndarray:
+        return reader.get(name)
+
+    def stacked(fmt: str, *, transpose: bool = False) -> jnp.ndarray:
+        parts = []
+        for i in range(L):
+            arr = np.asarray(tensor(fmt.format(i=i)))
+            if transpose:
+                arr = arr.T
+            parts.append(arr)
+        return np.stack(parts)
+
+    def has(name: str) -> bool:
+        return name in reader.index
+
+    layers: Params = {}
+    layers["ln1"] = _to_jnp(
+        stacked("model.layers.{i}.input_layernorm.weight"), dtype
+    )
+    if config.post_norms:  # gemma2 4-norm layout
+        layers["post_attn_norm"] = _to_jnp(
+            stacked("model.layers.{i}.post_attention_layernorm.weight"), dtype
+        )
+        layers["ln2"] = _to_jnp(
+            stacked("model.layers.{i}.pre_feedforward_layernorm.weight"), dtype
+        )
+        layers["post_mlp_norm"] = _to_jnp(
+            stacked("model.layers.{i}.post_feedforward_layernorm.weight"), dtype
+        )
+    else:
+        layers["ln2"] = _to_jnp(
+            stacked("model.layers.{i}.post_attention_layernorm.weight"), dtype
+        )
+    for ours, theirs in (
+        ("q_proj", "self_attn.q_proj"),
+        ("k_proj", "self_attn.k_proj"),
+        ("v_proj", "self_attn.v_proj"),
+        ("o_proj", "self_attn.o_proj"),
+        ("gate_proj", "mlp.gate_proj"),
+        ("up_proj", "mlp.up_proj"),
+        ("down_proj", "mlp.down_proj"),
+    ):
+        layers[ours] = _to_jnp(
+            stacked(f"model.layers.{{i}}.{theirs}.weight", transpose=True), dtype
+        )
+    if config.attention_bias:
+        for ours, theirs in (
+            ("q_bias", "self_attn.q_proj"),
+            ("k_bias", "self_attn.k_proj"),
+            ("v_bias", "self_attn.v_proj"),
+        ):
+            layers[ours] = _to_jnp(
+                stacked(f"model.layers.{{i}}.{theirs}.bias"), dtype
+            )
+    if config.qk_norm:
+        layers["q_norm"] = _to_jnp(
+            stacked("model.layers.{i}.self_attn.q_norm.weight"), dtype
+        )
+        layers["k_norm"] = _to_jnp(
+            stacked("model.layers.{i}.self_attn.k_norm.weight"), dtype
+        )
+
+    params: Params = {
+        "embed": _to_jnp(np.asarray(tensor("model.embed_tokens.weight")), dtype),
+        "final_norm": _to_jnp(np.asarray(tensor("model.norm.weight")), dtype),
+        "layers": layers,
+    }
+    if not config.tie_word_embeddings and has("lm_head.weight"):
+        params["lm_head"] = _to_jnp(np.asarray(tensor("lm_head.weight")).T, dtype)
+
+    placed = {
+        "embed": place("embed", params["embed"]),
+        "final_norm": place("final_norm", params["final_norm"]),
+        "layers": {
+            k: place(f"layers.{k}", v) for k, v in params["layers"].items()
+        },
+    }
+    if "lm_head" in params:
+        placed["lm_head"] = place("lm_head", params["lm_head"])
+    reader.close()
+    n_params = sum(x.size for x in jax.tree.leaves(placed))
+    logger.info(
+        "Loaded %s: %.2fB params as %s", model_path, n_params / 1e9, dtype
+    )
+    return placed

@@ -1,0 +1,9 @@
+"""Model definitions: one generic decoder covering the Llama/Qwen2/Gemma2
+families (the model set the reference's production runs used — Tower-Plus is
+Qwen2-based, plus Llama-3.2 and Gemma-2 from BASELINE.json configs).
+"""
+
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import Transformer, init_params
+
+__all__ = ["ModelConfig", "Transformer", "init_params"]

@@ -1,0 +1,377 @@
+"""Generic decoder-only transformer, TPU-first.
+
+Design (vs. the reference's delegation to vLLM's torch models):
+
+- **Pure functions over a param pytree** — no Module state; everything jits
+  and shards with `jax.sharding.NamedSharding` annotations applied by the
+  engine.
+- **Stacked layers + `lax.scan`** — per-layer weights are stacked on a
+  leading [L, ...] axis and the layer loop is a scan: one compiled layer
+  body regardless of depth (80-layer 72B compiles as fast as a 2-layer
+  test model), and the paged KV cache rides through the scan as xs/ys.
+- **Family differences as data** (ModelConfig): Qwen2 QKV bias, Gemma-2
+  softcaps/post-norms/alternating sliding window, Gemma ``(1+w)`` RMSNorm,
+  Qwen3 QK-norm — all static config the compiler folds away.
+- **Paged KV cache everywhere**: prefill writes pages while attending over
+  the in-flight prompt; decode attends through the block table
+  (ops/attention.py reference impls; Pallas kernels swap in on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.ops import attention as attn_ops
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float, *, one_plus: bool = False
+) -> jnp.ndarray:
+    """RMSNorm in f32 accumulation. Gemma uses ``x * (1 + w)``."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    x32 = x32 * jax.lax.rsqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    out = x32 * (1.0 + w) if one_plus else x32 * w
+    return out.astype(dtype)
+
+
+def compute_rope_inv_freq(config: ModelConfig) -> jnp.ndarray:
+    """Inverse RoPE frequencies [head_dim/2], with llama3-style scaling."""
+    d = config.head_dim_
+    inv_freq = 1.0 / (
+        config.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    scaling = config.rope_scaling or {}
+    rope_type = scaling.get("rope_type", scaling.get("type"))
+    if rope_type == "llama3":
+        factor = scaling.get("factor", 8.0)
+        low_factor = scaling.get("low_freq_factor", 1.0)
+        high_factor = scaling.get("high_freq_factor", 4.0)
+        original_ctx = scaling.get("original_max_position_embeddings", 8192)
+        low_freq_wavelen = original_ctx / low_factor
+        high_freq_wavelen = original_ctx / high_factor
+        wavelen = 2 * math.pi / inv_freq
+        scaled = inv_freq / factor
+        smooth = (original_ctx / wavelen - low_factor) / (high_factor - low_factor)
+        smoothed = (1 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_freq_wavelen,
+            scaled,
+            jnp.where(wavelen < high_freq_wavelen, inv_freq, smoothed),
+        )
+    elif rope_type == "linear":
+        inv_freq = inv_freq / scaling.get("factor", 1.0)
+    return inv_freq
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., T, n, d]
+    positions: jnp.ndarray,  # [..., T]
+    inv_freq: jnp.ndarray,  # [d/2]
+) -> jnp.ndarray:
+    """Rotate-half RoPE; positions may be -1 (padding) — harmless garbage."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, d/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(h: jnp.ndarray, lp: Params, activation: str) -> jnp.ndarray:
+    gate = h @ lp["gate_proj"]
+    up = h @ lp["up_proj"]
+    if activation == "gelu_tanh":
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        act = jax.nn.silu(gate)
+    return (act * up) @ lp["down_proj"]
+
+
+# ---------------------------------------------------------------------------
+# Transformer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Transformer:
+    """Functional model: ``prefill`` and ``decode`` over a paged KV cache."""
+
+    config: ModelConfig
+
+    # --- shared layer body -------------------------------------------------
+    def _qkv(
+        self, lp: Params, h: jnp.ndarray, positions: jnp.ndarray, inv_freq
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        d = cfg.head_dim_
+        *lead, _ = h.shape
+        q = h @ lp["q_proj"]
+        k = h @ lp["k_proj"]
+        v = h @ lp["v_proj"]
+        if cfg.attention_bias:
+            q = q + lp["q_bias"]
+            k = k + lp["k_bias"]
+            v = v + lp["v_bias"]
+        q = q.reshape(*lead, cfg.num_heads, d)
+        k = k.reshape(*lead, cfg.num_kv_heads, d)
+        v = v.reshape(*lead, cfg.num_kv_heads, d)
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        return q, k, v
+
+    def _finish_layer(
+        self, lp: Params, h: jnp.ndarray, attn_out: jnp.ndarray
+    ) -> jnp.ndarray:
+        cfg = self.config
+        one_plus = cfg.model_type.startswith("gemma")
+        *lead, _, _ = attn_out.shape
+        attn_flat = attn_out.reshape(*lead, cfg.num_heads * cfg.head_dim_)
+        attn_proj = attn_flat @ lp["o_proj"]
+        if cfg.post_norms:
+            attn_proj = rms_norm(
+                attn_proj, lp["post_attn_norm"], cfg.rms_norm_eps, one_plus=one_plus
+            )
+        h = h + attn_proj
+        mlp_in = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, one_plus=one_plus)
+        mlp_out = _mlp(mlp_in, lp, cfg.activation)
+        if cfg.post_norms:
+            mlp_out = rms_norm(
+                mlp_out, lp["post_mlp_norm"], cfg.rms_norm_eps, one_plus=one_plus
+            )
+        return h + mlp_out
+
+    def _window_for_layers(self) -> jnp.ndarray:
+        """Per-layer effective sliding window ([L]); 'disabled' = max ctx."""
+        cfg = self.config
+        disabled = cfg.max_position_embeddings + 1
+        return jnp.array(
+            [
+                cfg.sliding_window
+                if cfg.layer_uses_sliding_window(i)
+                else disabled
+                for i in range(cfg.num_layers)
+            ],
+            dtype=jnp.int32,
+        )
+
+    def _embed(self, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        h = params["embed"][tokens]
+        if cfg.scale_embeddings:
+            h = h * jnp.asarray(
+                math.sqrt(cfg.hidden_size), dtype=h.dtype
+            )
+        return h
+
+    def _logits(self, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        one_plus = cfg.model_type.startswith("gemma")
+        h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, one_plus=one_plus)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = (h @ head).astype(jnp.float32)
+        if cfg.logit_softcap is not None:
+            logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+        return logits
+
+    # --- prefill -----------------------------------------------------------
+    def prefill(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [B, T] right-padded prompt bucket
+        lengths: jnp.ndarray,  # [B] true prompt lengths
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [B, pages_per_seq]
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Full-prompt forward. Returns (last-token logits [B, V], k_pages,
+        v_pages) with the prompt's K/V written into the cache pages."""
+        cfg = self.config
+        B, T = tokens.shape
+        inv_freq = compute_rope_inv_freq(cfg)
+        pos_grid = jnp.arange(T)[None, :].astype(jnp.int32)
+        positions = jnp.where(
+            pos_grid < lengths[:, None], jnp.broadcast_to(pos_grid, (B, T)), -1
+        )
+        h = self._embed(params, tokens)
+        windows = self._window_for_layers()
+        one_plus = cfg.model_type.startswith("gemma")
+
+        def layer_fn(carry, xs):
+            # KV pages ride in the carry and are updated one layer-slice at
+            # a time: with donated buffers XLA aliases the whole stack
+            # in-place (scan ys would allocate a second full KV cache).
+            h, kps, vps = carry
+            lp, window, li = xs
+            kp = kps[li]
+            vp = vps[li]
+            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
+            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            kp, vp = attn_ops.write_kv_pages(kp, vp, k, v, block_tables, positions)
+            attn_out = attn_ops.full_prefill_attention(
+                q,
+                k,
+                v,
+                scale=cfg.attn_scale,
+                lengths=lengths,
+                sliding_window=window,
+                softcap=cfg.attn_softcap,
+            )
+            h = self._finish_layer(lp, h, attn_out)
+            kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)
+            vps = jax.lax.dynamic_update_index_in_dim(vps, vp, li, 0)
+            return (h, kps, vps), None
+
+        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (h, k_pages, v_pages), _ = jax.lax.scan(
+            layer_fn,
+            (h, k_pages, v_pages),
+            (params["layers"], windows, layer_idx),
+        )
+        last_idx = jnp.maximum(lengths - 1, 0)
+        last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+        return self._logits(params, last_h), k_pages, v_pages
+
+    # --- decode ------------------------------------------------------------
+    def decode(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [S] current token per slot
+        context_lens: jnp.ndarray,  # [S] tokens already cached (excl. new)
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [S, pages_per_seq]
+        active: jnp.ndarray,  # [S] bool — slot holds a live sequence
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One decode step for every active slot. Returns (logits [S, V],
+        k_pages, v_pages)."""
+        cfg = self.config
+        S = tokens.shape[0]
+        inv_freq = compute_rope_inv_freq(cfg)
+        positions = jnp.where(active, context_lens, -1).astype(jnp.int32)  # [S]
+        h = self._embed(params, tokens)  # [S, H]
+        windows = self._window_for_layers()
+        one_plus = cfg.model_type.startswith("gemma")
+        ctx_incl = jnp.where(active, context_lens + 1, 0)
+
+        def layer_fn(carry, xs):
+            h, kps, vps = carry
+            lp, window, li = xs
+            kp = kps[li]
+            vp = vps[li]
+            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
+            q, k, v = self._qkv(lp, x[:, None, :], positions[:, None], inv_freq)
+            # q/k/v: [S, 1, heads, d]
+            kp, vp = attn_ops.write_kv_pages(
+                kp, vp, k, v, block_tables, positions[:, None]
+            )
+            attn_out = attn_ops.paged_decode_attention(
+                q[:, 0],
+                kp,
+                vp,
+                block_tables,
+                ctx_incl,
+                scale=cfg.attn_scale,
+                sliding_window=window,
+                softcap=cfg.attn_softcap,
+            )
+            h = self._finish_layer(lp, h, attn_out)
+            kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)
+            vps = jax.lax.dynamic_update_index_in_dim(vps, vp, li, 0)
+            return (h, kps, vps), None
+
+        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (h, k_pages, v_pages), _ = jax.lax.scan(
+            layer_fn,
+            (h, k_pages, v_pages),
+            (params["layers"], windows, layer_idx),
+        )
+        return self._logits(params, h), k_pages, v_pages
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    config: ModelConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    """Random init (testing / benchmarks without a checkpoint)."""
+    cfg = config
+    d = cfg.head_dim_
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(
+            dtype
+        )
+
+    layers: Params = {
+        "ln1": jnp.ones((L, H), dtype),
+        "ln2": jnp.ones((L, H), dtype),
+        "q_proj": w(next(keys), (L, H, cfg.num_heads * d), H),
+        "k_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
+        "v_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
+        "o_proj": w(next(keys), (L, cfg.num_heads * d, H), cfg.num_heads * d),
+        "gate_proj": w(next(keys), (L, H, I), H),
+        "up_proj": w(next(keys), (L, H, I), H),
+        "down_proj": w(next(keys), (L, I, H), I),
+    }
+    if cfg.attention_bias:
+        layers["q_bias"] = jnp.zeros((L, cfg.num_heads * d), dtype)
+        layers["k_bias"] = jnp.zeros((L, cfg.num_kv_heads * d), dtype)
+        layers["v_bias"] = jnp.zeros((L, cfg.num_kv_heads * d), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, d), dtype)
+        layers["k_norm"] = jnp.ones((L, d), dtype)
+    if cfg.post_norms:
+        layers["post_attn_norm"] = jnp.ones((L, H), dtype)
+        layers["post_mlp_norm"] = jnp.ones((L, H), dtype)
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, H), H),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (H, cfg.vocab_size), H)
+    return params
+
+
+def make_kv_pages(
+    config: ModelConfig,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.bfloat16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Allocate the paged KV cache: [L, P, page, n_kv, d] ×2."""
+    shape = (
+        config.num_layers,
+        num_pages,
+        page_size,
+        config.num_kv_heads,
+        config.head_dim_,
+    )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,10 @@
+"""Compute ops: attention (XLA reference + Pallas TPU kernels), KV paging.
+
+The reference inherited CUDA PagedAttention from vLLM
+(SURVEY.md §2b); here the equivalents are:
+
+- ``ops.attention`` — pure-XLA reference implementations (run anywhere,
+  used for CPU tests and as the numerical oracle for the kernels)
+- ``ops.pallas_attention`` — Pallas TPU kernels (flash prefill,
+  paged-KV decode) compiled via Mosaic
+"""

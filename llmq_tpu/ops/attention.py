@@ -1,0 +1,139 @@
+"""Attention + paged-KV reference implementations (pure XLA).
+
+These are the numerical ground truth the Pallas kernels are tested against,
+and the fallback path on non-TPU backends. Replaces what the reference
+outsourced to vLLM's CUDA PagedAttention (SURVEY.md §2b).
+
+KV cache layout (paged):
+    k_pages, v_pages: [num_pages, page_size, num_kv_heads, head_dim]
+    block_tables:     [num_seqs, pages_per_seq] int32 — logical→physical page
+    context_lens:     [num_seqs] int32 — tokens already in cache per sequence
+
+All functions are shape-polymorphic only in ways XLA can specialize once:
+fixed page_size, fixed pages_per_seq, bucketed sequence lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+
+
+def _softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[..., n_kv, d] → [..., n_kv*n_rep, d] (GQA key/value head expansion)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def full_prefill_attention(
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k: jnp.ndarray,  # [B, T, n_kv_heads, head_dim]
+    v: jnp.ndarray,  # [B, T, n_kv_heads, head_dim]
+    *,
+    scale: float,
+    lengths: Optional[jnp.ndarray] = None,  # [B] valid prompt lengths
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a full (possibly right-padded) prompt."""
+    B, T, n_heads, _ = q.shape
+    n_rep = n_heads // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = _softcap(scores, softcap)
+    q_pos = jnp.arange(T)[:, None]
+    k_pos = jnp.arange(T)[None, :]
+    mask = k_pos <= q_pos
+    if sliding_window is not None:
+        mask &= k_pos > q_pos - sliding_window
+    if lengths is not None:
+        mask = mask[None, :, :] & (k_pos[None, :, :] < lengths[:, None, None])
+        mask = mask[:, None, :, :]
+    else:
+        mask = mask[None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [S, n_heads, head_dim] — one new token per sequence
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, head_dim]
+    v_pages: jnp.ndarray,  # [P, page_size, n_kv, head_dim]
+    block_tables: jnp.ndarray,  # [S, pages_per_seq] int32
+    context_lens: jnp.ndarray,  # [S] int32 — INCLUDING the new token
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Decode-step attention reading K/V through the page table.
+
+    Reference implementation: gathers each sequence's pages into a
+    contiguous [S, max_ctx] view and does a masked softmax. The Pallas
+    kernel computes the same thing without materializing the gather.
+    """
+    S, n_heads, head_dim = q.shape
+    page_size = k_pages.shape[1]
+    pages_per_seq = block_tables.shape[1]
+    max_ctx = pages_per_seq * page_size
+    n_kv = k_pages.shape[2]
+    n_rep = n_heads // n_kv
+
+    # [S, pages_per_seq, page_size, n_kv, d] → [S, max_ctx, n_kv, d]
+    k = k_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
+    v = v_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scores = jnp.einsum("shd,skhd->shk", q, k) * scale
+    scores = _softcap(scores, softcap)
+    k_pos = jnp.arange(max_ctx)[None, :]
+    mask = k_pos < context_lens[:, None]
+    if sliding_window is not None:
+        mask &= k_pos >= context_lens[:, None] - sliding_window
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("shk,skhd->shd", weights, v)
+
+
+def write_kv_pages(
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, d]
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, T, n_kv, d]
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, pages_per_seq]
+    positions: jnp.ndarray,  # [B, T] absolute token positions (−1 = skip)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter fresh K/V into their pages.
+
+    Padded/inactive entries use position −1 and are routed to a reserved
+    scratch page (physical page 0 by convention) so the scatter stays
+    fixed-shape with no conditionals. The allocator never hands out page 0.
+    """
+    B, T, n_kv, d = k_new.shape
+    page_size = k_pages.shape[1]
+    pos = positions.reshape(B * T)
+    valid = pos >= 0
+    logical_page = jnp.where(valid, pos // page_size, 0)
+    offset = jnp.where(valid, pos % page_size, 0)
+    batch_idx = jnp.repeat(jnp.arange(B), T)
+    physical_page = block_tables[batch_idx, logical_page]
+    physical_page = jnp.where(valid, physical_page, 0)  # scratch page
+    k_flat = k_new.reshape(B * T, n_kv, d)
+    v_flat = v_new.reshape(B * T, n_kv, d)
+    k_pages = k_pages.at[physical_page, offset].set(k_flat, mode="drop")
+    v_pages = v_pages.at[physical_page, offset].set(v_flat, mode="drop")
+    return k_pages, v_pages

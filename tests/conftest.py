@@ -12,13 +12,21 @@ import asyncio
 import inspect
 import os
 
-# Must happen before any jax import in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before any jax backend initialisation. Note: this image's
+# axon sitecustomize imports jax at interpreter startup and pins
+# jax_platforms to "axon,cpu" at the *config* level, so an env-var
+# override alone is not enough — reset the config too (before any
+# jax.devices() call initialises backends).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
