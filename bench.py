@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Headline benchmark: engine decode throughput on the local chip(s).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What it measures: output tokens/sec of the continuous-batching engine on
+the largest architecture preset that fits device HBM, random weights
+(numerics identical to a real checkpoint), synthetic token prompts —
+the TPU-native counterpart of the reference's `performance_benchmark.py`
+"output tokens/sec" metric (reference performance_benchmark.py:329-335).
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md). The
+north star is "Tower-Plus-9B at >= A100-class tokens/sec/chip"
+(BASELINE.json). We take 1500 output tok/s as the A100-class figure for a
+9B dense decoder under vLLM continuous batching and scale it inversely
+with parameter count for smaller benched models:
+    baseline(model) = 1500 * 9e9 / n_params.
+``vs_baseline`` > 1.0 means faster than that A100-class estimate.
+
+Env knobs: LLMQ_BENCH_PRESET, LLMQ_BENCH_REQUESTS, LLMQ_BENCH_PROMPT,
+LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def pick_preset(limit_bytes, platform: str) -> str:
+    if platform == "cpu":
+        return "tiny"
+    gb = (limit_bytes or 16 * 2**30) / 2**30
+    # bf16 params ~2 bytes each; leave room for KV cache + activations.
+    for preset, param_gb in (
+        ("tower-plus-9b", 20.5),
+        ("qwen2.5-7b", 15.2),
+        ("qwen2.5-3b", 6.8),
+        ("qwen2.5-1.5b", 3.6),
+        ("qwen2.5-0.5b", 1.4),
+    ):
+        if gb * 0.92 > param_gb * 1.35:
+            return preset
+    return "qwen2.5-0.5b"
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llmq_tpu.engine.engine import EngineConfig, EngineCore
+    from llmq_tpu.engine.sampling import SamplingParams
+    from llmq_tpu.engine.tokenizer import ByteTokenizer
+    from llmq_tpu.models.presets import get_preset
+    from llmq_tpu.models.transformer import init_params
+    from llmq_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    try:
+        limit = (devices[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:
+        limit = None
+    preset = os.environ.get("LLMQ_BENCH_PRESET") or pick_preset(limit, platform)
+    on_cpu = platform == "cpu"
+
+    n_requests = int(os.environ.get("LLMQ_BENCH_REQUESTS", 8 if on_cpu else 96))
+    prompt_len = int(os.environ.get("LLMQ_BENCH_PROMPT", 16 if on_cpu else 200))
+    gen_len = int(os.environ.get("LLMQ_BENCH_GEN", 16 if on_cpu else 128))
+    max_seqs = int(os.environ.get("LLMQ_BENCH_SEQS", 4 if on_cpu else 48))
+
+    config = get_preset(preset)
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    print(
+        f"bench: preset={preset} ({config.num_params()/1e9:.2f}B) on "
+        f"{len(devices)}x {platform}, {n_requests} reqs, "
+        f"prompt {prompt_len}, gen {gen_len}",
+        file=sys.stderr,
+    )
+    params = init_params(config, jax.random.key(0), dtype=dtype)
+    mesh = make_mesh()  # all local devices, tp
+    core = EngineCore(
+        config,
+        params,
+        ByteTokenizer(),
+        mesh=mesh,
+        engine_config=EngineConfig(
+            max_num_seqs=max_seqs,
+            max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
+            kv_dtype=dtype,
+            num_pages=256 if on_cpu else None,
+            page_size=8 if on_cpu else 32,
+        ),
+    )
+
+    rng = np.random.default_rng(0)
+    sp = lambda: SamplingParams(  # noqa: E731
+        temperature=0.0, max_tokens=gen_len, ignore_eos=True
+    )
+
+    def run(n, tag):
+        for i in range(n):
+            ids = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+            core.add_request(f"{tag}-{i}", prompt_ids=ids, params=sp())
+        done = 0
+        start = time.monotonic()
+        while core.has_work:
+            done += len(core.step())
+        elapsed = time.monotonic() - start
+        assert done == n, f"{done}/{n} finished"
+        return elapsed
+
+    run(min(2, n_requests), "warmup")  # compile prefill bucket + decode
+    gen_before = core.total_generated_tokens
+    elapsed = run(n_requests, "bench")
+    out_tokens = core.total_generated_tokens - gen_before
+
+    tok_s = out_tokens / elapsed
+    tok_s_chip = tok_s / len(devices)
+    baseline = 1500.0 * 9e9 / config.num_params()
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tokens_per_sec_per_chip[{preset}]",
+                "value": round(tok_s_chip, 2),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s_chip / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

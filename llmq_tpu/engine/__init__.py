@@ -9,16 +9,22 @@ Submodules import lazily — pulling in ``llmq_tpu.engine`` must not initialise
 jax for code paths that never touch the engine.
 """
 
-__all__ = ["EngineConfig", "InferenceEngine", "AsyncEngine"]
+__all__ = [
+    "AsyncEngine",
+    "EngineConfig",
+    "EngineCore",
+    "RequestOutput",
+    "SamplingParams",
+]
 
 
 def __getattr__(name: str):
-    if name == "EngineConfig":
-        from llmq_tpu.engine.config import EngineConfig
-
-        return EngineConfig
-    if name in ("InferenceEngine", "AsyncEngine"):
+    if name in ("AsyncEngine", "EngineConfig", "EngineCore", "RequestOutput"):
         from llmq_tpu.engine import engine as _engine
 
         return getattr(_engine, name)
+    if name == "SamplingParams":
+        from llmq_tpu.engine.sampling import SamplingParams
+
+        return SamplingParams
     raise AttributeError(name)

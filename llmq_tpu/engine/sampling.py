@@ -1,0 +1,192 @@
+"""Token sampling: batched, per-slot parameters, jit-compiled.
+
+The reference hardcoded ``SamplingParams(temperature=0.7)`` and delegated
+the actual sampling to vLLM (``vllm_worker.py:161-165``). Here sampling is
+native and *per-job overridable* (SURVEY.md §5 config plan): every slot in
+the continuous batch carries its own temperature/top-k/top-p/seed, shipped
+to the device as arrays so one compiled sampler serves any mix of greedy
+and stochastic requests.
+
+TPU notes: the sampler works on ``[S, V]`` logits. Top-k/top-p use one
+descending sort of the vocab axis (XLA sorts are fast and fuse with the
+masking); the Gumbel-max trick turns sampling into an argmax — no host
+round-trip, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling configuration (reference default temp 0.7)."""
+
+    temperature: float = 0.7
+    top_p: float = 1.0
+    top_k: int = 0  # 0 disables top-k
+    max_tokens: int = 8192
+    min_tokens: int = 0  # suppress EOS/stop until this many tokens emitted
+    stop: Tuple[str, ...] = ()
+    stop_token_ids: Tuple[int, ...] = ()
+    seed: Optional[int] = None
+    ignore_eos: bool = False
+
+    @classmethod
+    def from_job_extras(
+        cls, extras: dict, *, default_max_tokens: int
+    ) -> "SamplingParams":
+        """Per-job overrides from Job extra fields (``extra="allow"``)."""
+
+        def _tuple(value) -> Tuple[str, ...]:
+            if value is None:
+                return ()
+            if isinstance(value, str):
+                return (value,)
+            return tuple(value)
+
+        return cls(
+            temperature=float(extras.get("temperature", 0.7)),
+            top_p=float(extras.get("top_p", 1.0)),
+            top_k=int(extras.get("top_k", 0)),
+            max_tokens=int(extras.get("max_tokens", default_max_tokens)),
+            min_tokens=int(extras.get("min_tokens", 0)),
+            stop=_tuple(extras.get("stop")),
+            stop_token_ids=tuple(int(t) for t in _tuple(extras.get("stop_token_ids"))),
+            seed=(int(extras["seed"]) if extras.get("seed") is not None else None),
+            ignore_eos=bool(extras.get("ignore_eos", False)),
+        )
+
+
+def pack_sampling_arrays(
+    params: Sequence[Optional[SamplingParams]],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Stack per-slot params into (temperature [S], top_k [S], top_p [S]).
+
+    Empty slots (None) pack as greedy — they are masked out by ``active``
+    anyway, greedy just keeps their lanes NaN-free.
+    """
+    temps = jnp.asarray(
+        [p.temperature if p else 0.0 for p in params], dtype=jnp.float32
+    )
+    top_ks = jnp.asarray([p.top_k if p else 0 for p in params], dtype=jnp.int32)
+    top_ps = jnp.asarray(
+        [p.top_p if p else 1.0 for p in params], dtype=jnp.float32
+    )
+    return temps, top_ks, top_ps
+
+
+def required_mode(params: "SamplingParams") -> str:
+    """Cheapest sampler variant able to serve this request exactly."""
+    if params.temperature <= 0.0:
+        return "greedy"
+    if params.top_k <= 0 and params.top_p >= 1.0:
+        return "stochastic"
+    return "filtered"
+
+
+_MODE_ORDER = ("greedy", "stochastic", "filtered")
+
+
+def join_modes(modes) -> str:
+    """The cheapest variant exact for every request in the batch."""
+    best = 0
+    for m in modes:
+        best = max(best, _MODE_ORDER.index(m))
+    return _MODE_ORDER[best]
+
+
+def _step_gumbel(key_data, steps, shape) -> jnp.ndarray:
+    base_keys = jax.random.wrap_key_data(key_data)
+    step_keys = jax.vmap(jax.random.fold_in)(base_keys, steps)
+    return jax.vmap(
+        lambda key: jax.random.gumbel(key, shape[1:], dtype=jnp.float32)
+    )(step_keys)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [S, V] float32
+    key_data: jnp.ndarray,  # [S, ...] per-slot PRNG key data (see make_base_key)
+    steps: jnp.ndarray,  # [S] int32 — per-slot generation step, folded into keys
+    temperature: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S] int32, 0 = off
+    top_p: jnp.ndarray,  # [S] float32, 1.0 = off
+    *,
+    mode: str = "filtered",
+) -> jnp.ndarray:
+    """Sample one token per slot; temperature <= 0 means greedy.
+
+    ``mode`` is *static* — the engine compiles one decode executable per
+    variant actually used and picks per step (a greedy batch must not pay
+    a [S, V] vocab sort — on a 150k vocab that sort dwarfs the model step):
+
+    - ``greedy``      argmax only;
+    - ``stochastic``  Gumbel-max (exact sampling, no sort) — valid when no
+                      slot filters by top-k/top-p;
+    - ``filtered``    one descending vocab sort; per-slot *dynamic* k/p as
+                      rank masks and cumulative-probability masks on the
+                      sorted axis, then Gumbel argmax, un-sorted back.
+
+    The step counter is folded into slot keys on device, so the host never
+    touches PRNG state in the hot loop. Greedy lanes inside stochastic/
+    filtered batches are handled by the final ``where``.
+    """
+    S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    if mode == "greedy":
+        return greedy
+
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / safe_temp
+
+    if mode == "stochastic":
+        gumbel = _step_gumbel(key_data, steps, (S, V))
+        sampled = jnp.argmax(scaled + gumbel, axis=-1)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    # Descending sort once; all filters become rank masks.
+    sort_idx = jnp.argsort(-scaled, axis=-1)  # [S, V]
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    ranks = jnp.arange(V)[None, :]
+
+    # top-k: keep ranks < k (k==0 → keep all).
+    k = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep = ranks < k
+
+    # top-p: keep the smallest prefix with cumulative prob >= p. The
+    # standard formulation keeps entries whose *preceding* cumulative mass
+    # is < p, which always retains rank 0.
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep &= cum_before < top_p[:, None]
+
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
+    # Gumbel noise is drawn in *token* space and permuted through the same
+    # sort, so an unfiltered slot samples bit-identically to `stochastic`
+    # mode — a seeded request's stream can't change when an unrelated
+    # filtered request joins the batch and switches the variant.
+    gumbel = _step_gumbel(key_data, steps, (S, V))
+    gumbel_sorted = jnp.take_along_axis(gumbel, sort_idx, axis=-1)
+    choice_rank = jnp.argmax(masked + gumbel_sorted, axis=-1)  # [S]
+    sampled = jnp.take_along_axis(sort_idx, choice_rank[:, None], axis=-1)[:, 0]
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def make_base_key(seed: Optional[int], slot: int) -> jnp.ndarray:
+    """Key data for one slot, computed once at admission.
+
+    Seeded requests are reproducible across runs; unseeded ones derive
+    from the slot index (distinct streams, arbitrary — vLLM semantics).
+    """
+    return jax.random.key_data(
+        jax.random.key(seed if seed is not None else 0x5EED ^ slot)
+    )
+
+

@@ -1,0 +1,239 @@
+"""Continuous-batching scheduler: slots, paged KV allocation, preemption.
+
+This is the host-side half of what vLLM's C++/CUDA scheduler did for the
+reference (SURVEY.md §2b "continuous batching scheduler"). The device half
+is a *fixed-shape* compiled decode step over ``max_num_seqs`` slots; this
+module decides which sequence lives in which slot and which physical KV
+pages back it, so the device program never recompiles as requests churn.
+
+Invariants (property-tested in tests/test_scheduler.py):
+  - a physical page is owned by at most one sequence (page 0 is a reserved
+    scratch page for masked writes and is never handed out),
+  - every admitted sequence has pages covering len(tokens)+1 positions
+    (room for the KV write of the token being decoded),
+  - slots hold at most one sequence; finished/preempted sequences release
+    pages immediately,
+  - admission is FIFO; preemption evicts the *youngest* running sequence
+    (its re-prefill wastes the least work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from llmq_tpu.engine.sampling import SamplingParams
+
+
+class OutOfPages(Exception):
+    """No free KV pages; caller should preempt or defer."""
+
+
+class PageAllocator:
+    """Free-list allocator over the physical KV page pool.
+
+    Page 0 is reserved: masked/padded token positions scatter there
+    (``ops/attention.py::write_kv_pages``), so it must never back live data.
+    """
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Allocate n pages atomically; raises OutOfPages if short."""
+        if n > len(self._free):
+            raise OutOfPages(f"want {n} pages, have {len(self._free)}")
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for page in pages:
+            if page not in self._allocated:
+                raise ValueError(f"double-free or foreign page {page}")
+            self._allocated.remove(page)
+            self._free.append(page)
+
+
+@dataclasses.dataclass
+class Sequence:
+    """One request's generation state (host side)."""
+
+    rid: str
+    prompt_ids: List[int]
+    params: SamplingParams
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
+    preempt_count: int = 0
+    finish_reason: Optional[str] = None
+    finish_text: Optional[str] = None  # pre-truncated text on stop-string hit
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def last_token(self) -> int:
+        return self.output_ids[-1] if self.output_ids else self.prompt_ids[-1]
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    max_num_seqs: int
+    num_pages: int
+    page_size: int
+    max_model_len: int
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.max_model_len // self.page_size)  # ceil
+
+
+class Scheduler:
+    """Slot/page bookkeeping for the continuous batch."""
+
+    def __init__(self, config: SchedulerConfig) -> None:
+        self.config = config
+        self.allocator = PageAllocator(config.num_pages)
+        self.slots: List[Optional[Sequence]] = [None] * config.max_num_seqs
+        self.waiting: Deque[Sequence] = deque()
+        self.running: Dict[str, Sequence] = {}
+        self._tick = 0
+
+    # --- queue ------------------------------------------------------------
+    def add(self, seq: Sequence) -> None:
+        # Overlong prompts are truncated to fit the context window, and
+        # generation is capped so prompt+output never exceeds max_model_len
+        # (vLLM max_model_len parity); finish_reason=length surfaces it.
+        limit = self.config.max_model_len - 1
+        if len(seq.prompt_ids) > limit:
+            seq.prompt_ids = seq.prompt_ids[:limit]
+        if seq.num_tokens + seq.params.max_tokens > self.config.max_model_len:
+            seq.params.max_tokens = max(
+                0, self.config.max_model_len - seq.num_tokens
+            )
+        if self._pages_needed(seq.num_tokens) > self.config.num_pages - 1:
+            # Even an empty pool could never hold the prompt: reject now —
+            # otherwise admit() retries forever and the engine livelocks.
+            raise ValueError(
+                f"prompt of {seq.num_tokens} tokens needs "
+                f"{self._pages_needed(seq.num_tokens)} KV pages; pool has "
+                f"{self.config.num_pages - 1}"
+            )
+        self.waiting.append(seq)
+
+    @property
+    def has_waiting(self) -> bool:
+        return bool(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def _pages_needed(self, num_tokens: int) -> int:
+        # +1 position of headroom: the decode step writes the *next* token's
+        # KV before the host learns the sequence finished.
+        return -(-(num_tokens + 1) // self.config.page_size)
+
+    # --- admission --------------------------------------------------------
+    def admit(self, max_new: Optional[int] = None) -> List[Sequence]:
+        """Move waiting sequences into free slots while pages allow.
+
+        Returns the newly admitted sequences (their ``slot`` and ``pages``
+        set); each needs a prefill pass before joining decode.
+        """
+        admitted: List[Sequence] = []
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        while self.waiting and free_slots:
+            if max_new is not None and len(admitted) >= max_new:
+                break
+            seq = self.waiting[0]
+            need = self._pages_needed(seq.num_tokens)
+            try:
+                seq.pages = self.allocator.alloc(need)
+            except OutOfPages:
+                break
+            self.waiting.popleft()
+            seq.slot = free_slots.pop(0)
+            seq.admitted_at = self._tick
+            self._tick += 1
+            self.slots[seq.slot] = seq
+            self.running[seq.rid] = seq
+            admitted.append(seq)
+        return admitted
+
+    # --- decode-step bookkeeping -----------------------------------------
+    def append_token(self, seq: Sequence, token: int) -> None:
+        """Record a generated token, growing the page map as it crosses a
+        page boundary. May preempt *other* sequences to find a page; raises
+        OutOfPages only if even preemption can't help (seq is last alive)."""
+        seq.output_ids.append(token)
+        while self._pages_needed(seq.num_tokens) > len(seq.pages):
+            try:
+                seq.pages.extend(self.allocator.alloc(1))
+            except OutOfPages:
+                victim = self._youngest_running(exclude=seq.rid)
+                if victim is None:
+                    raise
+                self.preempt(victim)
+
+    def _youngest_running(self, exclude: str) -> Optional[Sequence]:
+        candidates = [s for s in self.running.values() if s.rid != exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.admitted_at)
+
+    def preempt(self, seq: Sequence) -> None:
+        """Evict a running sequence back to the waiting queue (head, so it
+        resumes first). Its generated tokens are kept; re-admission
+        re-prefills prompt+generated to rebuild the KV cache."""
+        self._release(seq)
+        seq.preempt_count += 1
+        self.waiting.appendleft(seq)
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        seq.finish_reason = reason
+        self._release(seq)
+
+    def _release(self, seq: Sequence) -> None:
+        if seq.slot >= 0:
+            self.slots[seq.slot] = None
+            seq.slot = -1
+        self.running.pop(seq.rid, None)
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+
+    # --- introspection ----------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        total_pages = self.config.num_pages - 1
+        return {
+            "running": len(self.running),
+            "waiting": len(self.waiting),
+            "slots": self.config.max_num_seqs,
+            "batch_occupancy": len(self.running) / self.config.max_num_seqs,
+            "kv_page_utilization": (total_pages - self.allocator.available)
+            / max(1, total_pages),
+        }
+
+    def check_invariants(self) -> None:
+        """Debug/test hook: assert the documented invariants."""
+        owned: List[int] = []
+        for seq in self.running.values():
+            assert self.slots[seq.slot] is seq
+            assert self._pages_needed(seq.num_tokens) <= len(seq.pages)
+            owned.extend(seq.pages)
+        assert 0 not in owned, "scratch page handed out"
+        assert len(owned) == len(set(owned)), "page owned twice"
+        assert len(owned) + self.allocator.available == self.config.num_pages - 1

@@ -1,0 +1,25 @@
+"""Device mesh + sharding layer (TPU-native parallelism).
+
+The reference delegated tensor parallelism to vLLM's NCCL process groups
+(reference ``llmq/workers/vllm_worker.py:62-89,108``); here parallelism is
+expressed the XLA way: one SPMD program over a ``jax.sharding.Mesh``, with
+``NamedSharding`` annotations on weights/KV pages and GSPMD inserting the
+ICI collectives.
+"""
+
+from llmq_tpu.parallel.mesh import make_mesh, auto_tensor_parallel
+from llmq_tpu.parallel.sharding import (
+    kv_page_pspec,
+    param_pspecs,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "auto_tensor_parallel",
+    "param_pspecs",
+    "param_shardings",
+    "kv_page_pspec",
+    "shard_params",
+]

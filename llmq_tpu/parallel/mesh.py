@@ -1,0 +1,47 @@
+"""Mesh construction: ``(dp, tp)`` axes over the local device slice.
+
+Auto-TP parity with the reference (``vllm_worker.py:62-89``): when no
+``tensor_parallel`` is given, the worker claims *all* visible devices —
+there it was every GPU in ``CUDA_VISIBLE_DEVICES``, here every chip JAX
+exposes on the slice, divided by the requested data-parallel degree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def auto_tensor_parallel(data_parallel: int = 1, devices=None) -> int:
+    """TP degree when unspecified: all visible devices / dp."""
+    n = len(devices if devices is not None else jax.devices())
+    return max(1, n // max(1, data_parallel))
+
+
+def make_mesh(
+    tensor_parallel: Optional[int] = None,
+    data_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A ``(dp, tp)`` mesh over the first ``dp*tp`` visible devices.
+
+    The tp axis is innermost so tensor-parallel collectives ride the
+    fastest links (ICI neighbours on a TPU slice); dp is the outer axis
+    (per-replica traffic is batch-disjoint and needs no bandwidth).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    dp = max(1, data_parallel)
+    tp = tensor_parallel or auto_tensor_parallel(dp, devs)
+    if dp * tp > len(devs):
+        raise ValueError(
+            f"Mesh dp={dp} x tp={tp} needs {dp * tp} devices, "
+            f"only {len(devs)} visible"
+        )
+    grid = np.asarray(devs[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, (DP_AXIS, TP_AXIS))

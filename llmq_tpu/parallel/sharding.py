@@ -1,0 +1,136 @@
+"""NamedSharding specs for the stacked param pytree + paged KV cache.
+
+Megatron-style tensor parallelism expressed as weight shardings only —
+GSPMD propagates them through the jitted prefill/decode programs and
+inserts the ICI collectives (all-gather on the column-parallel outputs,
+reduce-scatter/psum after the row-parallel matmuls). No hand-written
+collectives in the model code.
+
+Layout (matches ``models/transformer.py::init_params``):
+
+    embed        [V, H]        vocab-sharded on tp (XLA lowers the token
+                               gather to a masked local lookup + psum)
+    lm_head      [H, V]        column-parallel → logits sharded on vocab
+    q/k/v_proj   [L, H, n*d]   column-parallel (heads split across tp)
+    o_proj       [L, n*d, H]   row-parallel
+    gate/up_proj [L, H, I]     column-parallel
+    down_proj    [L, I, H]     row-parallel
+    norms/bias   replicated (biases follow their projection's split)
+    kv pages     [L, P, page, n_kv, d]  sharded on the kv-head axis
+
+Any axis that doesn't divide the tp degree falls back to replication for
+that tensor (e.g. GQA models with fewer kv heads than tp shards keep the
+KV cache replicated; attention math still shards over query heads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.parallel.mesh import TP_AXIS
+
+Params = Dict[str, Any]
+
+
+def _tp_dim(size: int, tp: int) -> Optional[str]:
+    """Shard a dimension on tp only when it divides evenly."""
+    return TP_AXIS if tp > 1 and size % tp == 0 else None
+
+
+def param_pspecs(config: ModelConfig, tp: int) -> Params:
+    """PartitionSpec pytree matching the param layout."""
+    d = config.head_dim_
+    nh_d = config.num_heads * d
+    nkv_d = config.num_kv_heads * d
+    col_q = _tp_dim(nh_d, tp)
+    col_kv = _tp_dim(nkv_d, tp)
+    col_mlp = _tp_dim(config.intermediate_size, tp)
+    vocab = _tp_dim(config.vocab_size, tp)
+
+    layers: Params = {
+        "ln1": P(),
+        "ln2": P(),
+        "q_proj": P(None, None, col_q),
+        "k_proj": P(None, None, col_kv),
+        "v_proj": P(None, None, col_kv),
+        "o_proj": P(None, col_q, None),
+        "gate_proj": P(None, None, col_mlp),
+        "up_proj": P(None, None, col_mlp),
+        "down_proj": P(None, col_mlp, None),
+    }
+    if config.attention_bias:
+        layers["q_bias"] = P(None, col_q)
+        layers["k_bias"] = P(None, col_kv)
+        layers["v_bias"] = P(None, col_kv)
+    if config.qk_norm:
+        layers["q_norm"] = P()
+        layers["k_norm"] = P()
+    if config.post_norms:
+        layers["post_attn_norm"] = P()
+        layers["post_mlp_norm"] = P()
+    specs: Params = {
+        "embed": P(vocab, None),
+        "final_norm": P(),
+        "layers": layers,
+    }
+    if not config.tie_word_embeddings:
+        specs["lm_head"] = P(None, vocab)
+    return specs
+
+
+def kv_page_pspec(config: ModelConfig, tp: int) -> P:
+    """KV pages [L, P, page, n_kv, d]: shard the kv-head axis on tp."""
+    return P(None, None, None, _tp_dim(config.num_kv_heads, tp), None)
+
+
+def param_shardings(
+    mesh: Mesh, config: ModelConfig, *, params: Optional[Params] = None
+) -> Params:
+    """NamedSharding pytree for the full param tree.
+
+    When ``params`` is given, the spec tree is pruned to exactly the keys
+    present (e.g. a tied-embedding checkpoint without ``lm_head``).
+    """
+    tp = mesh.shape[TP_AXIS]
+    specs = param_pspecs(config, tp)
+    if params is not None:
+        specs = _prune_like(specs, params)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _prune_like(specs: Params, params: Params) -> Params:
+    out: Params = {}
+    for key, value in params.items():
+        spec = specs[key]
+        out[key] = _prune_like(spec, value) if isinstance(value, dict) else spec
+    return out
+
+
+def shard_params(params: Params, mesh: Mesh, config: ModelConfig) -> Params:
+    """Place an already-loaded param tree onto the mesh."""
+    shardings = param_shardings(mesh, config, params=params)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def checkpoint_placer(mesh: Mesh, config: ModelConfig):
+    """``put(name, array)`` callback for ``engine.weights.load_checkpoint``:
+    ships each tensor host→device with its NamedSharding as it is read, so
+    no full host-side copy of the model accumulates per device."""
+    tp = mesh.shape[TP_AXIS]
+    specs = param_pspecs(config, tp)
+
+    def put(name: str, arr):
+        node: Any = specs
+        for part in name.split("."):
+            node = node[part]
+        return jax.device_put(arr, NamedSharding(mesh, node))
+
+    return put

@@ -1,0 +1,205 @@
+"""TPU inference worker (reference: ``llmq/workers/vllm_worker.py:11-201``).
+
+Where the reference constructed a vLLM ``AsyncLLMEngine`` on CUDA GPUs,
+this worker builds the native engine on the local TPU slice:
+
+- auto-TP parity (``vllm_worker.py:62-89``): no ``-tp`` flag → the worker
+  claims every device JAX exposes, divided by the data-parallel degree;
+- model spec: a local HF checkpoint directory (safetensors), or
+  ``preset://<name>`` for a random-weight architecture preset (tests and
+  hardware benchmarks without downloads);
+- per-job sampling overrides (temperature/top_p/top_k/max_tokens/stop/seed
+  via Job extra fields) — the reference hardcoded temp 0.7;
+- engine stats ride the worker heartbeat (batch occupancy, KV-page
+  utilization, tokens/sec).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+from pathlib import Path
+from typing import Optional
+
+from llmq_tpu.core.models import Job
+from llmq_tpu.workers.base import BaseWorker
+
+PRESET_SCHEMES = ("preset://", "dummy://", "random://")
+
+
+class TPUWorker(BaseWorker):
+    def __init__(
+        self,
+        queue: str,
+        *,
+        model: str,
+        tensor_parallel: Optional[int] = None,
+        data_parallel: int = 1,
+        max_num_seqs: Optional[int] = None,
+        max_model_len: Optional[int] = None,
+        dtype: str = "bfloat16",
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        **kwargs,
+    ) -> None:
+        self.model = model
+        self.tensor_parallel = tensor_parallel
+        self.data_parallel = data_parallel
+        self._max_num_seqs = max_num_seqs
+        self._max_model_len = max_model_len
+        self._dtype = dtype
+        self._page_size = page_size
+        self._num_pages = num_pages
+        self.engine = None
+        self._usage: dict = {}
+        super().__init__(queue, **kwargs)
+
+    # --- identity (reference vllm_worker.py:39-50) ------------------------
+    def _generate_worker_id(self) -> str:
+        tp = self.tensor_parallel or "auto"
+        return (
+            f"tpu-worker-{socket.gethostname()}-{os.getpid()}"
+            f"-tp{tp}-dp{self.data_parallel}"
+        )
+
+    # --- engine lifecycle -------------------------------------------------
+    async def _initialize_processor(self) -> None:
+        # Engine construction compiles XLA programs and possibly loads a
+        # multi-GB checkpoint: run off the event loop so broker heartbeats
+        # and signals stay live.
+        loop = asyncio.get_running_loop()
+        self.engine = await loop.run_in_executor(None, self._build_engine)
+        self.logger.info("Engine ready: %s", self.engine.stats())
+
+    def _build_engine(self):
+        import jax.numpy as jnp
+
+        from llmq_tpu.engine.engine import AsyncEngine, EngineConfig, EngineCore
+        from llmq_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer
+        from llmq_tpu.models.transformer import init_params
+        from llmq_tpu.parallel import make_mesh
+
+        mesh = make_mesh(
+            tensor_parallel=self.tensor_parallel,
+            data_parallel=self.data_parallel,
+        )
+        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self._dtype]
+
+        spec = self.model
+        if spec.startswith(PRESET_SCHEMES):
+            from llmq_tpu.models.presets import get_preset
+
+            name = spec.split("://", 1)[1] or "tiny"
+            model_config = get_preset(name)
+            import jax
+
+            self.logger.info("Preset model %s (random weights)", name)
+            params = init_params(model_config, jax.random.key(0), dtype=dtype)
+            tokenizer = ByteTokenizer()
+        else:
+            from llmq_tpu.engine.weights import load_checkpoint
+            from llmq_tpu.models.config import ModelConfig
+            from llmq_tpu.parallel.sharding import checkpoint_placer
+
+            path = Path(spec)
+            model_config = ModelConfig.from_pretrained(path)
+            params = load_checkpoint(
+                path,
+                model_config,
+                dtype=dtype,
+                put=checkpoint_placer(mesh, model_config),
+            )
+            tokenizer = HFTokenizer(spec)
+
+        overrides = {}
+        if self._max_num_seqs or self.config.max_num_seqs:
+            overrides["max_num_seqs"] = self._max_num_seqs or self.config.max_num_seqs
+        max_len = self._max_model_len or self.config.max_model_len
+        if max_len:
+            overrides["max_model_len"] = min(
+                max_len, model_config.max_position_embeddings
+            )
+        else:
+            overrides["max_model_len"] = min(
+                8192, model_config.max_position_embeddings
+            )
+        if self._page_size:
+            overrides["page_size"] = self._page_size
+        if self._num_pages:
+            overrides["num_pages"] = self._num_pages
+        engine_config = EngineConfig(
+            hbm_utilization=self.config.hbm_utilization,
+            kv_dtype=dtype,
+            **overrides,
+        )
+        core = EngineCore(
+            model_config,
+            params,
+            tokenizer,
+            mesh=mesh,
+            engine_config=engine_config,
+        )
+        return AsyncEngine(core)
+
+    async def _cleanup_processor(self) -> None:
+        if self.engine is not None:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self.engine.shutdown)
+            self.engine = None
+
+    # --- per-job processing (reference vllm_worker.py:136-195) ------------
+    def _sampling_for(self, job: Job):
+        """Job → SamplingParams: structured ``job.sampling`` wins, loose
+        extra fields (``{"temperature": 0.2, ...}`` in the JSONL) fall back,
+        reference defaults otherwise (temp 0.7, vllm_worker.py:162)."""
+        from llmq_tpu.engine.sampling import SamplingParams
+
+        params = SamplingParams.from_job_extras(
+            job.extras(), default_max_tokens=self.config.max_tokens
+        )
+        if job.stop:
+            params.stop = tuple(job.stop)
+        opts = job.sampling
+        if opts is not None:
+            params.temperature = opts.temperature
+            params.top_p = opts.top_p
+            params.top_k = opts.top_k
+            params.seed = opts.seed
+            params.min_tokens = opts.min_tokens
+            if opts.max_tokens is not None:
+                params.max_tokens = opts.max_tokens
+            if opts.stop:
+                params.stop = tuple(opts.stop)
+        return params
+
+    async def _process_job(self, job: Job) -> str:
+        params = self._sampling_for(job)
+        if job.messages is not None:
+            out = await self.engine.generate(
+                rid=job.id, messages=job.messages, params=params
+            )
+        elif job.chat_mode:
+            messages = [{"role": "user", "content": job.get_formatted_prompt()}]
+            out = await self.engine.generate(
+                rid=job.id, messages=messages, params=params
+            )
+        else:
+            out = await self.engine.generate(
+                rid=job.id, prompt=job.get_formatted_prompt(), params=params
+            )
+        self._usage[job.id] = {
+            "prompt_tokens": out.prompt_tokens,
+            "completion_tokens": out.completion_tokens,
+        }
+        return out.text
+
+    def _build_result(self, job: Job, output: str, duration_ms: float):
+        result = super()._build_result(job, output, duration_ms)
+        usage = self._usage.pop(job.id, None)
+        if usage is not None:
+            result.usage = usage
+        return result
+
+    def _engine_stats(self):
+        return self.engine.stats() if self.engine is not None else None

@@ -1,0 +1,251 @@
+"""Engine end-to-end on the CPU backend: continuous batching, stops,
+preemption, and sharded (tp/dp) execution matching single-device output."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmq_tpu.engine.engine import AsyncEngine, EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+CFG = ModelConfig.tiny(vocab_size=304)
+PARAMS = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def make_core(**overrides) -> EngineCore:
+    defaults = dict(
+        max_num_seqs=4,
+        max_model_len=64,
+        page_size=8,
+        num_pages=40,
+        kv_dtype=jnp.float32,
+        min_prefill_bucket=16,
+    )
+    defaults.update(overrides.pop("engine", {}))
+    mesh = overrides.pop("mesh", None) or make_mesh(tensor_parallel=1)
+    return EngineCore(
+        CFG, PARAMS, ByteTokenizer(), mesh=mesh,
+        engine_config=EngineConfig(**defaults),
+    )
+
+
+def run_sync(core, requests):
+    """Drive the core synchronously until all requests finish."""
+    for rid, prompt, params in requests:
+        core.add_request(rid, prompt=prompt, params=params)
+    outs = {}
+    for _ in range(500):
+        for out in core.step():
+            outs[out.rid] = out
+        if not core.has_work:
+            break
+    assert len(outs) == len(requests), "engine stalled"
+    return outs
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True, **kw
+    )
+
+
+class TestEngineCore:
+    def test_single_request_generates(self):
+        outs = run_sync(make_core(), [("r0", "hello", greedy(6))])
+        out = outs["r0"]
+        assert out.completion_tokens == 6
+        assert out.finish_reason == "length"
+        assert out.prompt_tokens == 5
+
+    def test_batch_matches_solo_greedy(self):
+        """Continuous batching must not change greedy outputs."""
+        solo = run_sync(make_core(), [("a", "first prompt", greedy(8))])
+        batch = run_sync(
+            make_core(),
+            [
+                ("a", "first prompt", greedy(8)),
+                ("b", "second!", greedy(8)),
+                ("c", "third prompt here", greedy(8)),
+            ],
+        )
+        assert batch["a"].token_ids == solo["a"].token_ids
+
+    def test_more_requests_than_slots(self):
+        reqs = [(f"r{i}", f"prompt {i}", greedy(4)) for i in range(10)]
+        outs = run_sync(make_core(), reqs)  # 4 slots
+        assert len(outs) == 10
+        assert all(o.completion_tokens == 4 for o in outs.values())
+
+    def test_stop_token_ids(self):
+        core = make_core()
+        first = run_sync(core, [("probe", "hi", greedy(4))])["probe"]
+        second_token = first.token_ids[1]
+        core2 = make_core()
+        out = run_sync(
+            core2,
+            [("r", "hi", greedy(8, stop_token_ids=(second_token,)))],
+        )["r"]
+        assert out.finish_reason == "stop"
+        assert out.token_ids == first.token_ids[:1]
+
+    def test_eos_respected_unless_ignored(self):
+        # Build params whose greedy output contains EOS(0) rarely; instead
+        # force it: stop_token_ids on the first emitted token → empty output.
+        core = make_core()
+        probe = run_sync(core, [("p", "xyz", greedy(3))])["p"]
+        out = run_sync(
+            make_core(),
+            [("r", "xyz", greedy(6, stop_token_ids=(probe.token_ids[0],)))],
+        )["r"]
+        assert out.completion_tokens == 0
+        assert out.finish_reason == "stop"
+
+    def test_stop_string(self):
+        core = make_core()
+        probe = run_sync(core, [("p", "abc", greedy(6))])["p"]
+        needle = ByteTokenizer().decode(probe.token_ids[2:4])
+        if not needle:  # pragma: no cover — depends on random weights
+            pytest.skip("undecodable tokens for this seed")
+        out = run_sync(
+            make_core(), [("r", "abc", greedy(6, stop=(needle,)))]
+        )["r"]
+        assert out.finish_reason == "stop"
+        assert needle not in out.text
+
+    def test_max_model_len_truncation(self):
+        core = make_core(engine=dict(max_model_len=32))
+        long_prompt = "x" * 100
+        out = run_sync(core, [("r", long_prompt, greedy(50))])["r"]
+        assert out.prompt_tokens == 31
+        assert out.finish_reason == "length"
+        assert out.completion_tokens <= 1
+
+    def test_preemption_recovers(self):
+        """Tiny page pool forces eviction + re-prefill; everything still
+        finishes and greedy output is unaffected."""
+        roomy = run_sync(
+            make_core(),
+            [(f"r{i}", f"pr {i} " * 3, greedy(10)) for i in range(3)],
+        )
+        tight_core = make_core(engine=dict(num_pages=8, page_size=4))
+        tight = run_sync(
+            tight_core,
+            [(f"r{i}", f"pr {i} " * 3, greedy(10)) for i in range(3)],
+        )
+        for rid, out in roomy.items():
+            assert tight[rid].token_ids == out.token_ids
+        stats = tight_core.stats()
+        assert stats["prefills"] >= 3
+
+    def test_min_tokens_suppresses_stop(self):
+        core = make_core()
+        probe = run_sync(core, [("p", "hi", greedy(6))])["p"]
+        stopper = probe.token_ids[1]
+        out = run_sync(
+            make_core(),
+            [("r", "hi", greedy(6, stop_token_ids=(stopper,), min_tokens=4))],
+        )["r"]
+        assert out.completion_tokens >= 4
+
+    def test_shared_params_not_mutated(self):
+        shared = greedy(1000)
+        core = make_core(engine=dict(max_model_len=32))
+        core.add_request("a", prompt="x" * 60, params=shared)
+        assert shared.max_tokens == 1000  # engine took a copy
+
+    def test_impossible_prompt_rejected(self):
+        core = make_core(engine=dict(num_pages=3, page_size=4, max_model_len=64))
+        with pytest.raises(ValueError):
+            core.add_request("r", prompt="a" * 40, params=greedy(4))
+        assert not core.has_work
+
+    def test_seeded_sampling_reproducible(self):
+        reqs = [("r", "hello", SamplingParams(temperature=1.0, seed=42,
+                                              max_tokens=8, ignore_eos=True))]
+        a = run_sync(make_core(), reqs)["r"]
+        b = run_sync(make_core(), reqs)["r"]
+        assert a.token_ids == b.token_ids
+
+    def test_stats_counters(self):
+        core = make_core()
+        run_sync(core, [("r0", "hello", greedy(5))])
+        s = core.stats()
+        assert s["generated_tokens"] == 5
+        assert s["prefills"] == 1
+        assert s["prompt_tokens"] == 5
+
+
+class TestSharding:
+    def _golden(self):
+        return run_sync(
+            make_core(),
+            [(f"r{i}", f"hello world {i}", greedy(8)) for i in range(4)],
+        )
+
+    @pytest.mark.parametrize("tp,dp", [(2, 1), (4, 1), (1, 2), (2, 2)])
+    def test_sharded_matches_single_device(self, tp, dp):
+        golden = self._golden()
+        mesh = make_mesh(tensor_parallel=tp, data_parallel=dp)
+        outs = run_sync(
+            make_core(mesh=mesh),
+            [(f"r{i}", f"hello world {i}", greedy(8)) for i in range(4)],
+        )
+        for rid, out in golden.items():
+            assert outs[rid].token_ids == out.token_ids, f"{rid} diverged"
+
+
+class TestAsyncEngine:
+    def test_concurrent_generate(self):
+        eng = AsyncEngine(make_core())
+
+        async def main():
+            return await asyncio.gather(
+                *[
+                    eng.generate(
+                        rid=f"r{i}", prompt=f"req {i}", params=greedy(5)
+                    )
+                    for i in range(8)
+                ]
+            )
+
+        try:
+            outs = asyncio.run(main())
+            assert len(outs) == 8
+            assert all(o.completion_tokens == 5 for o in outs)
+        finally:
+            eng.shutdown()
+
+    def test_messages_path(self):
+        eng = AsyncEngine(make_core())
+
+        async def main():
+            return await eng.generate(
+                rid="chat",
+                messages=[{"role": "user", "content": "hi"}],
+                params=greedy(4),
+            )
+
+        try:
+            out = asyncio.run(main())
+            assert out.completion_tokens == 4
+        finally:
+            eng.shutdown()
+
+    def test_bad_request_raises(self):
+        eng = AsyncEngine(make_core())
+
+        async def main():
+            with pytest.raises(ValueError):
+                await eng.generate(rid="bad")
+
+        try:
+            asyncio.run(main())
+        finally:
+            eng.shutdown()

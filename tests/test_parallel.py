@@ -1,0 +1,78 @@
+"""Mesh + sharding-spec layer."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import (
+    auto_tensor_parallel,
+    kv_page_pspec,
+    make_mesh,
+    param_pspecs,
+    param_shardings,
+    shard_params,
+)
+
+
+def test_auto_tp_claims_all_devices():
+    assert auto_tensor_parallel() == len(jax.devices())
+    assert auto_tensor_parallel(data_parallel=2) == len(jax.devices()) // 2
+
+
+def test_mesh_shape_and_axis_order():
+    mesh = make_mesh(tensor_parallel=4, data_parallel=2)
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    # tp is the innermost (fastest-varying) axis → ICI neighbours.
+    grid = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    assert (mesh.devices == grid).all()
+
+
+def test_mesh_too_large_rejected():
+    with pytest.raises(ValueError):
+        make_mesh(tensor_parallel=16, data_parallel=2)
+
+
+def test_pspecs_divisible_dims_sharded():
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=2, vocab_size=256)
+    specs = param_pspecs(cfg, tp=2)
+    assert specs["layers"]["q_proj"] == P(None, None, "tp")
+    assert specs["layers"]["o_proj"] == P(None, "tp", None)
+    assert specs["layers"]["down_proj"] == P(None, "tp", None)
+    assert specs["embed"] == P("tp", None)
+    assert kv_page_pspec(cfg, 2) == P(None, None, None, "tp", None)
+
+
+def test_pspecs_indivisible_fall_back_to_replication():
+    cfg = ModelConfig.tiny(num_heads=4, num_kv_heads=1, vocab_size=256)
+    specs = param_pspecs(cfg, tp=8)
+    # kv head dim 1*16=16 divides 8 — but kv *heads* (1) don't, for pages.
+    assert kv_page_pspec(cfg, 8) == P(None, None, None, None, None)
+    # vocab 256 % 8 == 0 → sharded; q 4*16=64 % 8 == 0 → sharded.
+    assert specs["embed"] == P("tp", None)
+    assert specs["layers"]["q_proj"] == P(None, None, "tp")
+
+
+def test_shard_params_places_on_mesh():
+    cfg = ModelConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(tensor_parallel=2)
+    placed = shard_params(params, mesh, cfg)
+    q = placed["layers"]["q_proj"]
+    assert q.sharding.mesh.shape == mesh.shape
+    # Column-parallel: last dim split over 2 devices.
+    shard_shapes = {s.data.shape for s in q.addressable_shards}
+    full = params["layers"]["q_proj"].shape
+    assert shard_shapes == {(*full[:2], full[2] // 2)}
+
+
+def test_param_shardings_prunes_to_tree():
+    cfg = ModelConfig.tiny(tie_word_embeddings=True)
+    params = init_params(cfg, jax.random.key(0))
+    assert "lm_head" not in params
+    mesh = make_mesh(tensor_parallel=1)
+    sh = param_shardings(mesh, cfg, params=params)
+    assert set(sh.keys()) == set(params.keys())
+    assert set(sh["layers"].keys()) == set(params["layers"].keys())

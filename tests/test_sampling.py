@@ -1,0 +1,151 @@
+"""Sampler behavior: greedy, temperature, top-k/top-p masking, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.sampling import (
+    SamplingParams,
+    make_base_key,
+    pack_sampling_arrays,
+    sample_tokens,
+)
+
+
+def _sample(logits, temps, topks, topps, seeds=None, steps=None):
+    S = logits.shape[0]
+    seeds = seeds or [0] * S
+    keys = jnp.stack([jnp.asarray(make_base_key(s, i)) for i, s in enumerate(seeds)])
+    steps = jnp.asarray(steps if steps is not None else [0] * S, jnp.int32)
+    return np.asarray(
+        sample_tokens(
+            jnp.asarray(logits, jnp.float32),
+            keys,
+            steps,
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topks, jnp.int32),
+            jnp.asarray(topps, jnp.float32),
+        )
+    )
+
+
+def test_greedy_picks_argmax():
+    logits = np.array([[0.0, 5.0, 1.0, -2.0], [3.0, 0.0, 0.0, 0.0]])
+    out = _sample(logits, [0.0, 0.0], [0, 0], [1.0, 1.0])
+    assert out.tolist() == [1, 0]
+
+
+def test_topk_1_equals_greedy_even_with_temperature():
+    logits = np.random.default_rng(0).normal(size=(4, 16))
+    out = _sample(logits, [5.0] * 4, [1] * 4, [1.0] * 4)
+    assert out.tolist() == np.argmax(logits, -1).tolist()
+
+
+def test_topk_masks_tail():
+    # One dominant + one runner-up; k=2 can only ever pick those two.
+    logits = np.full((1, 8), -10.0)
+    logits[0, 3] = 5.0
+    logits[0, 6] = 4.0
+    for step in range(20):
+        out = _sample(logits, [10.0], [2], [1.0], steps=[step])
+        assert out[0] in (3, 6)
+
+
+def test_topp_keeps_only_head():
+    # Token 0 carries ~all probability mass; top_p=0.5 keeps just it.
+    logits = np.array([[10.0, 0.0, 0.0, 0.0]])
+    for step in range(10):
+        out = _sample(logits, [1.0], [0], [0.5], steps=[step])
+        assert out[0] == 0
+
+
+def test_topp_always_keeps_rank0():
+    # Uniform distribution with tiny p must still return something valid.
+    logits = np.zeros((1, 8))
+    out = _sample(logits, [1.0], [0], [1e-6])
+    assert 0 <= out[0] < 8
+
+
+def test_seeded_determinism_and_step_variation():
+    logits = np.random.default_rng(1).normal(size=(1, 32))
+    a = _sample(logits, [1.0], [0], [1.0], seeds=[7], steps=[3])
+    b = _sample(logits, [1.0], [0], [1.0], seeds=[7], steps=[3])
+    assert a.tolist() == b.tolist()
+    outs = {
+        _sample(logits, [1.0], [0], [1.0], seeds=[7], steps=[s])[0]
+        for s in range(30)
+    }
+    assert len(outs) > 1  # step folding actually changes the stream
+
+
+def test_mixed_batch_greedy_and_stochastic():
+    logits = np.random.default_rng(2).normal(size=(3, 16))
+    out = _sample(logits, [0.0, 1.0, 0.0], [0, 0, 0], [1.0, 1.0, 1.0])
+    assert out[0] == np.argmax(logits[0])
+    assert out[2] == np.argmax(logits[2])
+
+
+def test_temperature_distribution_shifts():
+    # With high temperature, sampling over steps hits many tokens; with a
+    # low one it should concentrate near the mode.
+    logits = np.array([[3.0, 2.0, 1.0, 0.0, -1.0, -2.0, -3.0, -4.0]])
+    hot = {
+        _sample(logits, [100.0], [0], [1.0], steps=[s])[0] for s in range(64)
+    }
+    cold = {
+        _sample(logits, [0.05], [0], [1.0], steps=[s])[0] for s in range(64)
+    }
+    assert len(hot) >= 4
+    assert cold == {0}
+
+
+def test_mode_selection():
+    from llmq_tpu.engine.sampling import join_modes, required_mode
+
+    assert required_mode(SamplingParams(temperature=0.0)) == "greedy"
+    assert required_mode(SamplingParams(temperature=1.0)) == "stochastic"
+    assert required_mode(SamplingParams(temperature=1.0, top_k=5)) == "filtered"
+    assert required_mode(SamplingParams(temperature=1.0, top_p=0.9)) == "filtered"
+    assert join_modes(["greedy", "stochastic"]) == "stochastic"
+    assert join_modes(["greedy", "filtered", "stochastic"]) == "filtered"
+    assert join_modes(["greedy"]) == "greedy"
+
+
+def test_modes_agree_for_unfiltered_slots():
+    """A seeded unfiltered slot samples identically whichever variant the
+    batch happens to compile — mode must not change results."""
+    logits = np.random.default_rng(3).normal(size=(2, 64)) * 3
+    S = logits.shape[0]
+    keys = jnp.stack([jnp.asarray(make_base_key(9, i)) for i in range(S)])
+    args = (
+        jnp.asarray(logits, jnp.float32),
+        keys,
+        jnp.asarray([4, 7], jnp.int32),
+        jnp.asarray([0.9, 1.3], jnp.float32),
+        jnp.asarray([0, 0], jnp.int32),
+        jnp.asarray([1.0, 1.0], jnp.float32),
+    )
+    stoch = np.asarray(sample_tokens(*args, mode="stochastic"))
+    filt = np.asarray(sample_tokens(*args, mode="filtered"))
+    assert stoch.tolist() == filt.tolist()
+
+
+def test_pack_sampling_arrays_handles_empty_slots():
+    temps, topks, topps = pack_sampling_arrays(
+        [SamplingParams(temperature=0.3, top_k=5, top_p=0.9), None]
+    )
+    assert temps.tolist() == [np.float32(0.3), 0.0]
+    assert topks.tolist() == [5, 0]
+    assert topps.tolist() == [np.float32(0.9), 1.0]
+
+
+def test_from_job_extras():
+    p = SamplingParams.from_job_extras(
+        {"temperature": 0, "top_k": 3, "stop": "END", "seed": 5, "x": "y"},
+        default_max_tokens=99,
+    )
+    assert p.temperature == 0.0
+    assert p.top_k == 3
+    assert p.stop == ("END",)
+    assert p.seed == 5
+    assert p.max_tokens == 99

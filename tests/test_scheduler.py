@@ -1,0 +1,177 @@
+"""Scheduler/allocator invariants (SURVEY.md §4: property tests on
+scheduler invariants replace vLLM's internal scheduler tests)."""
+
+import random
+
+import pytest
+
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.scheduler import (
+    OutOfPages,
+    PageAllocator,
+    Scheduler,
+    SchedulerConfig,
+    Sequence,
+)
+
+
+def make_seq(rid, prompt_len=10, max_tokens=100):
+    return Sequence(
+        rid=rid,
+        prompt_ids=list(range(1, prompt_len + 1)),
+        params=SamplingParams(max_tokens=max_tokens),
+    )
+
+
+def make_sched(slots=4, pages=32, page_size=4, max_len=64):
+    return Scheduler(
+        SchedulerConfig(
+            max_num_seqs=slots,
+            num_pages=pages,
+            page_size=page_size,
+            max_model_len=max_len,
+        )
+    )
+
+
+class TestPageAllocator:
+    def test_page_zero_reserved(self):
+        alloc = PageAllocator(8)
+        pages = alloc.alloc(7)
+        assert 0 not in pages
+        assert sorted(pages) == list(range(1, 8))
+
+    def test_exhaustion_is_atomic(self):
+        alloc = PageAllocator(4)
+        alloc.alloc(2)
+        with pytest.raises(OutOfPages):
+            alloc.alloc(2)  # only 1 left
+        assert alloc.available == 1
+
+    def test_free_and_reuse(self):
+        alloc = PageAllocator(4)
+        pages = alloc.alloc(3)
+        alloc.free(pages)
+        assert alloc.available == 3
+        assert sorted(alloc.alloc(3)) == sorted(pages)
+
+    def test_double_free_rejected(self):
+        alloc = PageAllocator(4)
+        pages = alloc.alloc(1)
+        alloc.free(pages)
+        with pytest.raises(ValueError):
+            alloc.free(pages)
+
+
+class TestAdmission:
+    def test_fifo_admission_fills_slots(self):
+        sched = make_sched(slots=2)
+        for i in range(3):
+            sched.add(make_seq(f"r{i}"))
+        admitted = sched.admit()
+        assert [s.rid for s in admitted] == ["r0", "r1"]
+        assert sched.num_running == 2
+        assert len(sched.waiting) == 1
+        sched.check_invariants()
+
+    def test_admission_blocked_by_pages(self):
+        # 7 usable pages, each 10-token prompt needs ceil(11/4)=3 pages.
+        sched = make_sched(slots=4, pages=8)
+        for i in range(3):
+            sched.add(make_seq(f"r{i}"))
+        admitted = sched.admit()
+        assert len(admitted) == 2  # third would need a 3rd allocation of 3
+        sched.check_invariants()
+
+    def test_prompt_truncated_to_model_len(self):
+        sched = make_sched(max_len=16)
+        seq = make_seq("r0", prompt_len=100)
+        sched.add(seq)
+        assert len(seq.prompt_ids) == 15
+        assert seq.params.max_tokens == 1
+
+    def test_max_tokens_capped(self):
+        sched = make_sched(max_len=32)
+        seq = make_seq("r0", prompt_len=10, max_tokens=1000)
+        sched.add(seq)
+        assert seq.params.max_tokens == 22
+
+
+class TestDecodeGrowth:
+    def test_page_growth_on_boundary(self):
+        sched = make_sched(page_size=4)
+        seq = make_seq("r0", prompt_len=3)
+        sched.add(seq)
+        sched.admit()
+        assert len(seq.pages) == 1  # 3+1 fits one page
+        sched.append_token(seq, 42)  # now 4+1 → needs 2 pages
+        assert len(seq.pages) == 2
+        sched.check_invariants()
+
+    def test_finish_releases_everything(self):
+        sched = make_sched()
+        seq = make_seq("r0")
+        sched.add(seq)
+        sched.admit()
+        before = sched.allocator.available
+        sched.finish(seq, "stop")
+        assert sched.num_running == 0
+        assert sched.allocator.available > before
+        assert seq.slot == -1
+        sched.check_invariants()
+
+    def test_preemption_evicts_youngest(self):
+        # Pool sized so two sequences fit, but growth forces eviction.
+        sched = make_sched(slots=2, pages=7, page_size=4, max_len=64)
+        a, b = make_seq("a", prompt_len=10), make_seq("b", prompt_len=10)
+        sched.add(a)
+        sched.add(b)
+        assert len(sched.admit()) == 2  # 3 pages each, 6 of 6 used
+        # a crosses a page boundary → must preempt b (younger).
+        for _ in range(2):
+            sched.append_token(a, 7)
+        assert "b" not in sched.running
+        assert sched.waiting[0].rid == "b"
+        assert b.preempt_count == 1
+        assert b.pages == [] and b.slot == -1
+        sched.check_invariants()
+
+    def test_out_of_pages_when_alone(self):
+        sched = make_sched(slots=1, pages=3, page_size=2, max_len=64)
+        seq = make_seq("r0", prompt_len=3)  # needs 2 pages, uses both
+        sched.add(seq)
+        sched.admit()
+        with pytest.raises(OutOfPages):
+            for _ in range(10):
+                sched.append_token(seq, 1)
+
+
+def test_randomized_invariants():
+    """Fuzz admission/growth/finish/preempt; invariants must always hold."""
+    rng = random.Random(0)
+    sched = make_sched(slots=8, pages=64, page_size=4, max_len=96)
+    next_id = 0
+    live = []
+    for _ in range(500):
+        op = rng.random()
+        if op < 0.3:
+            seq = make_seq(f"s{next_id}", prompt_len=rng.randint(1, 40))
+            next_id += 1
+            sched.add(seq)
+        elif op < 0.5:
+            for s in sched.admit():
+                live.append(s)
+        elif op < 0.85 and live:
+            seq = rng.choice(live)
+            if seq.rid in sched.running:
+                try:
+                    sched.append_token(seq, rng.randint(0, 100))
+                except OutOfPages:
+                    pass
+                live = [s for s in live if s.rid in sched.running]
+        elif live:
+            seq = rng.choice(live)
+            if seq.rid in sched.running:
+                sched.finish(seq, "stop")
+            live.remove(seq)
+        sched.check_invariants()

@@ -1,0 +1,109 @@
+"""TPUWorker end-to-end over the in-memory broker: the full
+submit→queue→engine→result path with a preset (random-weight) model —
+the suite-level analogue of the reference's DummyWorker integration tests,
+but exercising the real engine."""
+
+import asyncio
+import json
+
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.core.config import Config
+from llmq_tpu.core.models import Job, Result
+from llmq_tpu.workers.tpu_worker import TPUWorker
+
+
+def make_worker(mem_url, queue="tpu-q", **kw):
+    config = Config(broker_url=mem_url)
+    kw.setdefault("model", "preset://tiny")
+    kw.setdefault("tensor_parallel", 1)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("num_pages", 40)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_num_seqs", 4)
+    return TPUWorker(queue, config=config, concurrency=4, **kw)
+
+
+async def submit_and_collect(mem_url, queue, jobs, worker, timeout=120.0):
+    broker = BrokerManager(Config(broker_url=mem_url))
+    await broker.connect()
+    await broker.setup_queue_infrastructure(queue)
+    for job in jobs:
+        await broker.publish_job(queue, job)
+
+    task = asyncio.create_task(worker.run())
+    results = []
+    try:
+
+        async def handler(message):
+            results.append(Result.model_validate_json(message.body))
+            await message.ack()
+
+        await broker.consume_results(queue + ".results", handler)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while len(results) < len(jobs):
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"got {len(results)}/{len(jobs)} results")
+            await asyncio.sleep(0.05)
+    finally:
+        worker.request_shutdown()
+        await asyncio.wait_for(task, timeout=30)
+        await broker.disconnect()
+    return results
+
+
+async def test_tpu_worker_end_to_end(mem_url):
+    jobs = [
+        Job(
+            id=f"job-{i}",
+            prompt="say {word}",
+            word=f"w{i}",
+            temperature=0.0,
+            max_tokens=4,
+            ignore_eos=True,
+        )
+        for i in range(5)
+    ]
+    worker = make_worker(mem_url)
+    results = await submit_and_collect(mem_url, "tpu-q", jobs, worker)
+    assert {r.id for r in results} == {f"job-{i}" for i in range(5)}
+    for r in results:
+        assert r.usage == {"prompt_tokens": 6, "completion_tokens": 4}
+        assert r.worker_id.startswith("tpu-worker-")
+        assert r.duration_ms > 0
+        # extra-field passthrough
+        assert r.model_dump()["word"].startswith("w")
+
+
+async def test_tpu_worker_messages_job(mem_url):
+    jobs = [
+        Job(
+            id="chat-1",
+            messages=[{"role": "user", "content": "hello"}],
+            temperature=0.0,
+            max_tokens=3,
+            ignore_eos=True,
+        )
+    ]
+    worker = make_worker(mem_url, queue="chat-q")
+    results = await submit_and_collect(mem_url, "chat-q", jobs, worker)
+    assert results[0].usage["completion_tokens"] == 3
+
+
+async def test_tpu_worker_sampling_options_object(mem_url):
+    jobs = [
+        Job(
+            id="s-1",
+            prompt="hi",
+            sampling={"temperature": 0.0, "max_tokens": 2},
+            ignore_eos=True,
+        )
+    ]
+    worker = make_worker(mem_url, queue="s-q")
+    results = await submit_and_collect(mem_url, "s-q", jobs, worker)
+    assert results[0].usage["completion_tokens"] == 2
+
+
+def test_worker_id_encodes_topology():
+    worker = make_worker("memory://wid-test", tensor_parallel=2)
+    assert "-tp2-dp1" in worker.worker_id
