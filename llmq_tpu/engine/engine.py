@@ -5,19 +5,36 @@ This module replaces what the reference delegated to vLLM's
 engine that coalesces many in-flight requests into device batches. The
 TPU-native design differs from vLLM's CUDA core on purpose:
 
-- **Two compiled programs, fixed shapes.** A bucketed single-sequence
-  prefill and a ``max_num_seqs``-slot decode step. Requests churn; the
-  compiled programs never change, so there is no recompilation in steady
-  state (XLA caches one executable per prefill bucket + one decode).
-- **Host scheduler, device compute.** `engine/scheduler.py` owns slots and
-  KV pages in plain Python; each iteration ships a few small int arrays
-  (tokens, context lens, block tables) and gets back one token per slot.
+- **Two compiled programs, fixed shapes.** A bucketed batched prefill and
+  a ``max_num_seqs``-slot decode step. Requests churn; the compiled
+  programs never change, so there is no recompilation in steady state
+  (XLA caches one executable per prefill bucket + one decode variant).
+- **Device-resident decode state + run-ahead pipeline.** The decode
+  state (current tokens, context lengths, block tables, sampling state)
+  lives on the device and is *updated by the compiled step itself*; the
+  host dispatches step ``k`` while asynchronously fetching the sampled
+  tokens of step ``k - runahead``. Steady-state decode therefore ships
+  **zero** host→device bytes and never blocks on a device→host sync —
+  critical when dispatch latency is high (remote TPU tunnels), and it
+  removes host jitter everywhere else. Correctness pieces:
+    * *Page lookahead*: KV pages are allocated at dispatch time for every
+      position any in-flight step may write (`Scheduler.ensure_pages`),
+      so the device block tables are never stale when a sequence crosses
+      a page boundary.
+    * *Device-side stopping*: per-slot limit/min/stop-token-id arrays let
+      the compiled step deactivate finished slots itself, so EOS and
+      max-token finishes need no host round-trip and no resync. Stop
+      *strings* (host-only) mark the state dirty and force a resync.
+    * *Deferred page frees*: pages of a finished sequence return to the
+      allocator only after every dispatched step that might still write
+      them has been processed (watermark on the dispatch counter).
+- **Host scheduler, device compute.** `engine/scheduler.py` owns slots
+  and KV pages in plain Python; resyncs rebuild the device state from it.
 - **SPMD via the mesh.** Weights/KV are sharded with ``NamedSharding``
   (`parallel/sharding.py`); GSPMD inserts the ICI collectives. The same
   engine runs single-chip or tensor-parallel across a slice unchanged.
 - **Sampling on device.** Per-slot temperature/top-k/top-p/seed arrays;
-  the model step and the sampler fuse into one executable, so a decode
-  step is a single dispatch returning ``[S]`` token ids.
+  the model step and the sampler fuse into one executable.
 
 An ``AsyncEngine`` wrapper runs the step loop on a dedicated thread and
 bridges to asyncio futures, mirroring the AsyncLLMEngine surface the
@@ -31,9 +48,10 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence as Seq, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +97,8 @@ class EngineConfig:
     kv_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
+    runahead: int = 8  # decode steps dispatched ahead of result reads
+    stop_id_capacity: int = 8  # per-slot device-side stop-token ids
 
 
 def _prefill_buckets(cfg: EngineConfig) -> List[int]:
@@ -89,6 +109,11 @@ def _prefill_buckets(cfg: EngineConfig) -> List[int]:
         b *= 2
     buckets.append(cfg.max_model_len)
     return buckets
+
+
+# Pipeline entry: (dispatch index, device out-token array,
+#                  [(row-in-out, Sequence), ...] snapshot)
+_Pending = Tuple[int, jax.Array, List[Tuple[int, Sequence]]]
 
 
 class EngineCore:
@@ -107,7 +132,7 @@ class EngineCore:
         self.tokenizer = tokenizer
         self.cfg = engine_config or EngineConfig()
         self.mesh = mesh if mesh is not None else make_mesh(tensor_parallel=1)
-        self.model = Transformer(model_config)
+        self.model = Transformer(model_config, mesh=self.mesh)
 
         self._param_shardings = param_shardings(
             self.mesh, model_config, params=params
@@ -156,7 +181,11 @@ class EngineCore:
         self._buckets = _prefill_buckets(self.cfg)
         self._build_steps()
 
-        # Host-side slot arrays (numpy, shipped each step).
+        # Host-side mirrors of the device decode state, rebuilt wholesale
+        # at every resync (resyncs are rare; steady-state decode ships
+        # nothing host→device).
+        E = self.cfg.stop_id_capacity
+        key_shape = np.asarray(make_base_key(0, 0)).shape
         self._h_tokens = np.zeros((S,), np.int32)
         self._h_ctx = np.zeros((S,), np.int32)
         self._h_bt = np.zeros((S, self._pages_per_seq), np.int32)
@@ -164,9 +193,23 @@ class EngineCore:
         self._h_temp = np.zeros((S,), np.float32)
         self._h_topk = np.zeros((S,), np.int32)
         self._h_topp = np.ones((S,), np.float32)
-        key_shape = np.asarray(make_base_key(0, 0)).shape
         self._h_keys = np.zeros((S, *key_shape), np.uint32)
         self._h_steps = np.zeros((S,), np.int32)
+        self._h_limits = np.zeros((S,), np.int32)
+        self._h_mins = np.zeros((S,), np.int32)
+        self._h_stopids = np.full((S, E), -1, np.int32)
+
+        # Run-ahead pipeline state.
+        self._pending: Deque[_Pending] = deque()
+        self._deferred_pages: List[Tuple[int, List[int]]] = []
+        self._dispatch_idx = 0
+        self._processed_idx = 0
+        self._dirty = True
+        self._mode = "greedy"
+        self._dev_state: Optional[tuple] = None
+        # Requests whose stop-token set overflows the device capacity:
+        # their token-based stops are detected host-side (with a resync).
+        self._host_stop_fallback: set = set()
 
         # Counters for stats/heartbeats.
         self.total_prompt_tokens = 0
@@ -174,47 +217,135 @@ class EngineCore:
         self.decode_steps = 0
         self.prefills = 0
         self._started_at = time.monotonic()
+        self._resync()
 
     # --- compilation ------------------------------------------------------
     def _build_steps(self) -> None:
         model = self.model
+        S = self.cfg.max_num_seqs
 
-        def decode_step(params, kp, vp, tokens, ctx, bt, active, keys, steps,
-                        temps, topks, topps, *, mode):
+        # Device decode-state layout (leaf order is load-bearing):
+        # 0 tokens[S]  1 ctx[S]    2 bt[S,pps]  3 active[S]  4 keys[S,kd]
+        # 5 steps[S]   6 temps[S]  7 topks[S]   8 topps[S]   9 limits[S]
+        # 10 mins[S]   11 stop_ids[S,E]
+        def advance_state(st, out, active):
+            (tokens, ctx, bt, _, keys, steps, temps, topks, topps,
+             limits, mins, stop_ids) = st
+            new_steps = steps + active.astype(steps.dtype)
+            hit_stop = jnp.logical_and(
+                (out[:, None] == stop_ids).any(axis=1), new_steps > mins
+            )
+            hit_limit = new_steps >= limits
+            still = jnp.logical_and(
+                active,
+                jnp.logical_not(jnp.logical_or(hit_stop, hit_limit)),
+            )
+            return (
+                jnp.where(active, out, tokens),
+                ctx + active.astype(ctx.dtype),
+                bt,
+                still,
+                keys,
+                new_steps,
+                temps,
+                topks,
+                topps,
+                limits,
+                mins,
+                stop_ids,
+            )
+
+        def suppress_stops(logits, stop_ids, steps, mins):
+            """Mask stop/EOS logits while a slot is under min_tokens, so
+            the forbidden token can never be sampled (vLLM semantics)."""
+            V = logits.shape[1]
+            ids = jnp.where(stop_ids < 0, V, stop_ids)  # pad → OOB → drop
+            rows = jnp.broadcast_to(
+                jnp.arange(ids.shape[0])[:, None], ids.shape
+            )
+            masked = logits.at[rows, ids].set(
+                sampling_mod.NEG_INF, mode="drop"
+            )
+            return jnp.where((steps < mins)[:, None], masked, logits)
+
+        def decode_step(params, kp, vp, st, *, mode):
+            (tokens, ctx, bt, active, keys, steps, temps, topks,
+             topps, _limits, mins, stop_ids) = st
             logits, kp, vp = model.decode(params, tokens, ctx, kp, vp, bt, active)
+            logits = suppress_stops(logits, stop_ids, steps, mins)
             next_tokens = sample_tokens(
                 logits, keys, steps, temps, topks, topps, mode=mode
             )
-            return jnp.where(active, next_tokens, 0), kp, vp
+            out = jnp.where(active, next_tokens, 0)
+            return out, kp, vp, advance_state(st, out, active)
 
-        def prefill_step(params, kp, vp, tokens, lengths, bt, keys, steps,
-                         temps, topks, topps):
-            logits, kp, vp = model.prefill(params, tokens, lengths, kp, vp, bt)
-            next_tokens = sample_tokens(logits, keys, steps, temps, topks, topps)
-            return next_tokens, kp, vp
+        def prefill_step(params, kp, vp, p_tokens, p_lengths, p_bt, p_slots,
+                         p_keys, p_steps, p_temps, p_topks, p_topps,
+                         p_limits, p_mins, p_stopids, st):
+            logits, kp, vp = model.prefill(
+                params, p_tokens, p_lengths, kp, vp, p_bt
+            )
+            logits = suppress_stops(logits, p_stopids, p_steps, p_mins)
+            nt = sample_tokens(
+                logits, p_keys, p_steps, p_temps, p_topks, p_topps
+            )
+            valid = p_slots >= 0
+            out = jnp.where(valid, nt, 0)
+            new_steps = p_steps + 1
+            hit_stop = jnp.logical_and(
+                (out[:, None] == p_stopids).any(axis=1), new_steps > p_mins
+            )
+            alive = jnp.logical_and(
+                valid,
+                jnp.logical_not(
+                    jnp.logical_or(hit_stop, new_steps >= p_limits)
+                ),
+            )
+            # Scatter the freshly prefilled rows into the decode state;
+            # padded rows (slot -1) route out of range and are dropped.
+            idx = jnp.where(valid, p_slots, S)
+            (tokens, ctx, bt, active, keys, steps, temps, topks, topps,
+             limits, mins, stop_ids) = st
+            st = (
+                tokens.at[idx].set(out, mode="drop"),
+                ctx.at[idx].set(p_lengths, mode="drop"),
+                bt.at[idx].set(p_bt, mode="drop"),
+                active.at[idx].set(alive, mode="drop"),
+                keys.at[idx].set(p_keys, mode="drop"),
+                steps.at[idx].set(new_steps, mode="drop"),
+                temps.at[idx].set(p_temps, mode="drop"),
+                topks.at[idx].set(p_topks, mode="drop"),
+                topps.at[idx].set(p_topps, mode="drop"),
+                limits.at[idx].set(p_limits, mode="drop"),
+                mins.at[idx].set(p_mins, mode="drop"),
+                stop_ids.at[idx].set(p_stopids, mode="drop"),
+            )
+            return out, kp, vp, st
 
         repl, slot1, slot2 = self._repl, self._slot1, self._slot2
         kv = self._kv_sharding
         ps = self._param_shardings
+        st_sh = (slot1, slot1, slot2, slot1, slot2, slot1, slot1, slot1,
+                 slot1, slot1, slot1, slot2)
+        self._st_shardings = st_sh
+        self._prefill_arg_shardings = (repl,) * 12
         # One decode executable per sampler variant actually used: a greedy
         # batch must not pay the [S, V] vocab sort (sampling.required_mode).
         # jit compiles lazily, so unused variants cost nothing.
         self._decode_jits = {
             mode: jax.jit(
                 partial(decode_step, mode=mode),
-                in_shardings=(ps, kv, kv, slot1, slot1, slot2, slot1,
-                              slot2, slot1, slot1, slot1, slot1),
-                out_shardings=(slot1, kv, kv),
-                donate_argnums=(1, 2),
+                in_shardings=(ps, kv, kv, st_sh),
+                out_shardings=(slot1, kv, kv, st_sh),
+                donate_argnums=(1, 2, 3),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
         self._prefill_jit = jax.jit(
             prefill_step,
-            in_shardings=(ps, kv, kv, repl, repl, repl, repl,
-                          repl, repl, repl, repl),
-            out_shardings=(repl, kv, kv),
-            donate_argnums=(1, 2),
+            in_shardings=(ps, kv, kv) + (repl,) * 12 + (st_sh,),
+            out_shardings=(repl, kv, kv, st_sh),
+            donate_argnums=(1, 2, 15),
         )
 
     def _auto_num_pages(self) -> int:
@@ -243,7 +374,7 @@ class EngineCore:
             pass
         max_useful = (
             self.cfg.max_num_seqs
-            * -(-self.cfg.max_model_len // self.cfg.page_size)
+            * (-(-self.cfg.max_model_len // self.cfg.page_size) + 1)
             + 1
         )
         if limit is None:
@@ -285,139 +416,329 @@ class EngineCore:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.scheduler.running) or self.scheduler.has_waiting
+        return (
+            bool(self.scheduler.running)
+            or self.scheduler.has_waiting
+            or bool(self._pending)
+        )
 
     # --- one engine iteration --------------------------------------------
     def step(self) -> List[RequestOutput]:
-        """Admit + prefill new sequences, then one decode step for the
-        batch. Returns requests that finished this iteration."""
+        """Admit + prefill new sequences, dispatch one decode step for the
+        batch, process lagged results. Returns requests whose finish was
+        *observed* this iteration (results lag dispatch by ≤ runahead)."""
         finished: List[RequestOutput] = []
-        admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
-        for seq in admitted:
-            if seq.rid not in self.scheduler.running:
-                # Evicted by a preemption triggered while prefilling an
-                # earlier sequence of this same batch; it is back in the
-                # waiting queue and will be re-admitted.
-                continue
-            if seq.params.max_tokens <= 0:
-                self.scheduler.finish(seq, "length")
-                finished.append(self._output_for(seq))
-                continue
-            self._prefill(seq, finished)
+        if self.scheduler.has_waiting and any(
+            s is None for s in self.scheduler.slots
+        ):
+            admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
+            todo = []
+            for seq in admitted:
+                if seq.params.max_tokens <= 0:
+                    self.scheduler.finish(seq, "length")
+                    finished.append(self._output_for(seq))
+                    continue
+                todo.append(seq)
+            if todo:
+                self._prefill_batch(todo, finished)
         if self.scheduler.running:
-            self._decode(finished)
+            self._dispatch_decode(finished)
+        elif self._pending:
+            self._process_oldest(finished)
+        self._flush_deferred()
         return finished
 
-    def _sync_slot(self, seq: Sequence) -> None:
-        i = seq.slot
-        self._h_tokens[i] = seq.last_token
-        self._h_ctx[i] = seq.num_tokens - 1
-        row = self._h_bt[i]
-        row[:] = 0
-        row[: len(seq.pages)] = seq.pages
-        self._h_active[i] = True
-        self._h_temp[i] = seq.params.temperature
-        self._h_topk[i] = seq.params.top_k
-        self._h_topp[i] = seq.params.top_p
-        self._h_keys[i] = np.asarray(make_base_key(seq.params.seed, i))
-        self._h_steps[i] = len(seq.output_ids)
+    # --- run-ahead pipeline ----------------------------------------------
+    def _drain(self, finished: List[RequestOutput]) -> None:
+        while self._pending:
+            self._process_oldest(finished)
+        self._flush_deferred()
 
-    def _clear_slot(self, slot: int) -> None:
-        self._h_active[slot] = False
+    def _process_oldest(self, finished: List[RequestOutput]) -> None:
+        idx, out, snapshot = self._pending.popleft()
+        tokens = np.asarray(out)  # transfer started at dispatch; ~ready
+        for row, seq in snapshot:
+            if (
+                seq.finish_reason is not None
+                or seq.rid not in self.scheduler.running
+            ):
+                continue  # finished/preempted while this step was in flight
+            self._append_and_check(seq, int(tokens[row]), finished)
+        self._processed_idx = idx
 
-    def _prefill(self, seq: Sequence, finished: List[RequestOutput]) -> None:
-        """Run the bucketed prefill for one admitted sequence; samples the
-        first new token. Re-admitted (preempted) sequences re-prefill
-        prompt+generated to rebuild their KV."""
-        ids = seq.prompt_ids + seq.output_ids
-        n = len(ids)
-        bucket = next(b for b in self._buckets if b >= n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = ids
-        bt = np.zeros((1, self._pages_per_seq), np.int32)
-        bt[0, : len(seq.pages)] = seq.pages
-        keys = np.asarray(make_base_key(seq.params.seed, seq.slot))[None]
-        tok, self.k_pages, self.v_pages = self._prefill_jit(
-            self.params,
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(tokens),
-            jnp.asarray([n], jnp.int32),
-            jnp.asarray(bt),
-            jnp.asarray(keys),
-            jnp.asarray([len(seq.output_ids)], jnp.int32),
-            jnp.asarray([seq.params.temperature], jnp.float32),
-            jnp.asarray([seq.params.top_k], jnp.int32),
-            jnp.asarray([seq.params.top_p], jnp.float32),
-        )
-        self.prefills += 1
-        token = int(jax.device_get(tok)[0])
-        self._append_and_check(seq, token, finished)
-        if seq.finish_reason is None:
-            self._sync_slot(seq)
+    def _flush_deferred(self) -> None:
+        while (
+            self._deferred_pages
+            and self._deferred_pages[0][0] <= self._processed_idx
+        ):
+            _, pages = self._deferred_pages.pop(0)
+            self.scheduler.release_pages(pages)
 
-    def _decode(self, finished: List[RequestOutput]) -> None:
-        # Authoritative active sweep: preemption during this iteration's
-        # prefills may have evicted sequences after their slot was synced;
-        # a stale active flag would scatter KV into freed (re-allocatable)
-        # pages, corrupting another sequence.
-        batch = []
+    def _push_pending(
+        self, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
+    ) -> None:
+        try:
+            out.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — not all backends support it
+            pass
+        self._dispatch_idx += 1
+        self._pending.append((self._dispatch_idx, out, snapshot))
+
+    def _resync(self) -> None:
+        """Rebuild the device decode state from scheduler truth. Only valid
+        after a full drain (host state must have caught up)."""
+        assert not self._pending, "resync with in-flight steps"
+        for arr, fill in (
+            (self._h_tokens, 0), (self._h_ctx, 0), (self._h_active, False),
+            (self._h_bt, 0), (self._h_temp, 0.0), (self._h_topk, 0),
+            (self._h_topp, 1.0), (self._h_keys, 0), (self._h_steps, 0),
+            (self._h_limits, 0), (self._h_mins, 0), (self._h_stopids, -1),
+        ):
+            arr[...] = fill
+        modes = []
         for i, seq in enumerate(self.scheduler.slots):
-            self._h_active[i] = seq is not None
-            if seq is not None:
-                batch.append((i, seq))
-        mode = sampling_mod.join_modes(
-            sampling_mod.required_mode(seq.params) for _, seq in batch
+            if seq is None or not seq.prefilled:
+                continue  # unprefilled slots join via the prefill scatter
+            p = seq.params
+            self._h_tokens[i] = seq.last_token
+            self._h_ctx[i] = seq.num_tokens - 1
+            self._h_bt[i, : len(seq.pages)] = seq.pages
+            self._h_active[i] = True
+            self._h_temp[i] = p.temperature
+            self._h_topk[i] = p.top_k
+            self._h_topp[i] = p.top_p
+            self._h_keys[i] = np.asarray(make_base_key(p.seed, i))
+            self._h_steps[i] = len(seq.output_ids)
+            self._h_limits[i] = p.max_tokens
+            self._h_mins[i] = p.min_tokens
+            self._h_stopids[i] = self._stop_ids_for(seq)
+            modes.append(sampling_mod.required_mode(p))
+        self._mode = sampling_mod.join_modes(modes) if modes else "greedy"
+        # One batched transfer with the final shardings — no per-array
+        # convert programs, no resharding on first dispatch.
+        self._dev_state = jax.device_put(
+            (
+                self._h_tokens, self._h_ctx, self._h_bt, self._h_active,
+                self._h_keys, self._h_steps, self._h_temp, self._h_topk,
+                self._h_topp, self._h_limits, self._h_mins, self._h_stopids,
+            ),
+            self._st_shardings,
         )
-        out, self.k_pages, self.v_pages = self._decode_jits[mode](
-            self.params,
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(self._h_tokens),
-            jnp.asarray(self._h_ctx),
-            jnp.asarray(self._h_bt),
-            jnp.asarray(self._h_active),
-            jnp.asarray(self._h_keys),
-            jnp.asarray(self._h_steps),
-            jnp.asarray(self._h_temp),
-            jnp.asarray(self._h_topk),
-            jnp.asarray(self._h_topp),
+        self._dirty = False
+
+    def _stop_ids_for(self, seq: Sequence) -> np.ndarray:
+        """Per-slot device stop-token ids ([-1]-padded). Overflowing sets
+        degrade to host-side detection for the excess ids."""
+        E = self.cfg.stop_id_capacity
+        ids = list(seq.params.stop_token_ids)
+        if not seq.params.ignore_eos:
+            ids.extend(self._eos_ids)
+        row = np.full((E,), -1, np.int32)
+        if len(ids) > E:
+            self._host_stop_fallback.add(seq.rid)
+            ids = ids[:E]
+        row[: len(ids)] = ids
+        return row
+
+    # --- prefill ----------------------------------------------------------
+    def _prefill_batch(
+        self, seqs: List[Sequence], finished: List[RequestOutput]
+    ) -> None:
+        """Prefill admitted sequences in bucket-grouped batches; the
+        compiled step scatters each row straight into the device decode
+        state, so admission costs no pipeline drain."""
+        if self._dirty:
+            self._drain(finished)
+            self._resync()
+        by_bucket: Dict[int, List[Sequence]] = {}
+        for seq in seqs:
+            n = seq.num_tokens
+            bucket = next(b for b in self._buckets if b >= n)
+            by_bucket.setdefault(bucket, []).append(seq)
+        for bucket, group in by_bucket.items():
+            for i in range(0, len(group), self.cfg.max_prefill_batch):
+                self._prefill_chunk(group[i : i + self.cfg.max_prefill_batch],
+                                    bucket)
+
+    def _prefill_chunk(self, chunk: List[Sequence], bucket: int) -> None:
+        # Pad to {1, max_prefill_batch} rows so at most two executables
+        # exist per bucket.
+        B = 1 if len(chunk) == 1 else self.cfg.max_prefill_batch
+        E = self.cfg.stop_id_capacity
+        key_shape = self._h_keys.shape[1:]
+        tokens = np.zeros((B, bucket), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        bt = np.zeros((B, self._pages_per_seq), np.int32)
+        slots = np.full((B,), -1, np.int32)
+        keys = np.zeros((B, *key_shape), np.uint32)
+        steps = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        topks = np.zeros((B,), np.int32)
+        topps = np.ones((B,), np.float32)
+        limits = np.full((B,), 1, np.int32)
+        mins = np.zeros((B,), np.int32)
+        stopids = np.full((B, E), -1, np.int32)
+        for row, seq in enumerate(chunk):
+            ids = seq.prompt_ids + seq.output_ids
+            tokens[row, : len(ids)] = ids
+            lengths[row] = len(ids)
+            bt[row, : len(seq.pages)] = seq.pages
+            slots[row] = seq.slot
+            p = seq.params
+            keys[row] = np.asarray(make_base_key(p.seed, seq.slot))
+            steps[row] = len(seq.output_ids)
+            temps[row] = p.temperature
+            topks[row] = p.top_k
+            topps[row] = p.top_p
+            limits[row] = p.max_tokens
+            mins[row] = p.min_tokens
+            stopids[row] = self._stop_ids_for(seq)
+        args = jax.device_put(
+            (tokens, lengths, bt, slots, keys, steps, temps, topks,
+             topps, limits, mins, stopids),
+            self._prefill_arg_shardings,
         )
+        out, self.k_pages, self.v_pages, self._dev_state = self._prefill_jit(
+            self.params, self.k_pages, self.v_pages, *args, self._dev_state
+        )
+        for seq in chunk:
+            seq.prefilled = True
+        self.prefills += len(chunk)
+        self._push_pending(out, list(enumerate(chunk)))
+        # The new rows' sampler mode must be honored from the next decode.
+        self._mode = sampling_mod.join_modes(
+            [self._mode]
+            + [sampling_mod.required_mode(s.params) for s in chunk]
+        )
+
+    # --- decode -----------------------------------------------------------
+    def _dispatch_decode(self, finished: List[RequestOutput]) -> None:
+        # Page lookahead: every position an in-flight or about-to-dispatch
+        # step may write must be covered *now* — pages only ever get
+        # *added* to a block table, so the grown table can be swapped into
+        # the device state without draining the pipeline (in-flight steps
+        # only touch already-mapped positions). Demand is capped by each
+        # sequence's own remaining generation budget. Only allocator
+        # exhaustion (preemption needed) forces a drain + resync.
+        lookahead = len(self._pending) + 2
+        needs_pages = any(
+            -(-self._page_target(seq, lookahead) // self.cfg.page_size)
+            > len(seq.pages)
+            for seq in self.scheduler.running.values()
+        )
+        if needs_pages:
+            grown = False
+            for seq in list(self.scheduler.running.values()):
+                if seq.rid not in self.scheduler.running:
+                    continue  # preempted by an earlier iteration's ensure
+                try:
+                    # Amortize: top up a full page beyond the need — but
+                    # never at someone else's expense.
+                    self.scheduler.ensure_pages(
+                        seq,
+                        self._page_target(
+                            seq, lookahead + self.cfg.page_size
+                        ),
+                        allow_preempt=False,
+                    )
+                    grown = True
+                except OutOfPages:
+                    # Pool exhausted: catch the host up so deferred pages
+                    # return and preemption can free a victim safely.
+                    self._drain(finished)
+                    if seq.rid not in self.scheduler.running:
+                        continue
+                    try:  # minimal demand; preemption allowed (drained)
+                        self.scheduler.ensure_pages(
+                            seq, self._page_target(seq, lookahead)
+                        )
+                    except OutOfPages:
+                        # Alone and still short: the pool itself is the cap.
+                        self.scheduler.finish(seq, "length")
+                        finished.append(self._output_for(seq))
+                        continue
+                    self._dirty = True
+            if grown and not self._dirty:
+                self._swap_block_tables()
+        if self._dirty:
+            self._drain(finished)
+            if not self.scheduler.running:
+                return
+            self._resync()
+        out, self.k_pages, self.v_pages, self._dev_state = self._decode_jits[
+            self._mode
+        ](self.params, self.k_pages, self.v_pages, self._dev_state)
         self.decode_steps += 1
-        tokens = np.asarray(jax.device_get(out))
-        for slot, seq in batch:
-            if seq.rid not in self.scheduler.running:
-                # Preempted while an earlier sequence grabbed its pages in
-                # this very loop; its token for this step is dropped and
-                # regenerated after re-prefill.
-                self._clear_slot(slot)
-                continue
-            self._append_and_check(seq, int(tokens[slot]), finished)
-            if seq.finish_reason is None and seq.rid in self.scheduler.running:
-                self._h_tokens[slot] = seq.last_token
-                self._h_ctx[slot] = seq.num_tokens - 1
-                self._h_steps[slot] = len(seq.output_ids)
-                row = self._h_bt[slot]
-                row[: len(seq.pages)] = seq.pages
+        self._push_pending(
+            out,
+            [
+                (i, seq)
+                for i, seq in enumerate(self.scheduler.slots)
+                if seq is not None and seq.prefilled
+            ],
+        )
+        while len(self._pending) > self.cfg.runahead:
+            self._process_oldest(finished)
+
+    def _swap_block_tables(self) -> None:
+        """Ship grown block tables into the device state without draining:
+        one small h2d transfer, no dispatch, no resync."""
+        self._h_bt[...] = 0
+        for i, seq in enumerate(self.scheduler.slots):
+            if seq is not None:
+                self._h_bt[i, : len(seq.pages)] = seq.pages
+        bt_dev = jax.device_put(self._h_bt, self._st_shardings[2])
+        st = self._dev_state
+        self._dev_state = st[:2] + (bt_dev,) + st[3:]
+
+    def _page_target(self, seq: Sequence, lookahead: int) -> int:
+        """KV positions ``seq`` must have pages for, given ``lookahead``
+        in-flight/future steps — capped by its own finish horizon."""
+        horizon = len(seq.prompt_ids) + seq.params.max_tokens + 1
+        return min(seq.num_tokens + lookahead, horizon)
 
     def _append_and_check(
         self, seq: Sequence, token: int, finished: List[RequestOutput]
     ) -> None:
-        slot = seq.slot
+        seq.output_ids.append(token)
         try:
-            self.scheduler.append_token(seq, token)
+            # Pages were pre-allocated at dispatch time; this is a no-op
+            # except in pathological pool-exhaustion (no preemption here —
+            # in-flight steps forbid freeing a victim's pages).
+            self.scheduler.ensure_pages(
+                seq, seq.num_tokens + 1, allow_preempt=False
+            )
         except OutOfPages:
-            # Globally out of pages with nothing left to preempt.
-            self.scheduler.finish(seq, "length")
-            self._clear_slot(slot)
-            finished.append(self._output_for(seq))
+            self._finish_seq(seq, "length", device_detected=False,
+                             finished=finished)
             return
         self.total_generated_tokens += 1
         reason = self._stop_reason(seq, token)
         if reason is not None:
-            self.scheduler.finish(seq, reason)
-            self._clear_slot(slot)
-            finished.append(self._output_for(seq))
+            # The device detects token-based stops and length caps itself
+            # (advance_state); only host-exclusive finishes force a resync.
+            device_detected = (
+                seq.finish_text is None
+                and seq.rid not in self._host_stop_fallback
+            )
+            self._finish_seq(seq, reason, device_detected=device_detected,
+                             finished=finished)
+
+    def _finish_seq(
+        self,
+        seq: Sequence,
+        reason: str,
+        *,
+        device_detected: bool,
+        finished: List[RequestOutput],
+    ) -> None:
+        pages = self.scheduler.finish(seq, reason, defer_pages=True)
+        if pages:
+            self._deferred_pages.append((self._dispatch_idx, pages))
+        if not device_detected:
+            self._dirty = True
+        self._host_stop_fallback.discard(seq.rid)
+        finished.append(self._output_for(seq))
 
     def _stop_reason(self, seq: Sequence, token: int) -> Optional[str]:
         p = seq.params
@@ -441,12 +762,24 @@ class EngineCore:
             tail = self.tokenizer.decode(seq.output_ids[-window:])
             if any(s in tail for s in p.stop):
                 text = self.tokenizer.decode(seq.output_ids)
-                for s in p.stop:
-                    idx = text.find(s)
-                    if idx >= 0:
-                        seq.finish_text = text[:idx]
-                        return "stop"
+                hits = [i for i in (text.find(s) for s in p.stop) if i >= 0]
+                if hits:
+                    idx = min(hits)  # earliest match, not list order
+                    seq.finish_text = text[:idx]
+                    self._trim_to_match(seq, p.stop)
+                    return "stop"
         return None
+
+    def _trim_to_match(self, seq: Sequence, stops) -> None:
+        """Drop output tokens past the stop-string match so token_ids and
+        usage agree with the truncated text (bounded: only the re-decoded
+        tail window can ever be trimmed)."""
+        lo = max(0, len(seq.output_ids) - (max(len(s) for s in stops) + 8))
+        for n in range(lo, len(seq.output_ids) + 1):
+            head = self.tokenizer.decode(seq.output_ids[:n])
+            if any(s in head for s in stops):
+                seq.output_ids = seq.output_ids[:n]
+                return
 
     def _output_for(self, seq: Sequence) -> RequestOutput:
         text = seq.finish_text
@@ -465,10 +798,35 @@ class EngineCore:
         """Drop every running/waiting sequence and release their pages —
         recovery hook after a failed step, so the loop doesn't re-step a
         half-updated batch forever."""
+        if self._pending:
+            try:  # wait out in-flight steps; discard their results
+                np.asarray(self._pending[-1][1])
+            except Exception:  # noqa: BLE001 — the step itself failed
+                pass
+            self._processed_idx = self._pending[-1][0]
+            self._pending.clear()
+        self._flush_deferred()
         for seq in list(self.scheduler.running.values()):
             self.scheduler.finish(seq, note)
         self.scheduler.waiting.clear()
-        self._h_active[:] = False
+        self._dirty = True
+        # A failed step may have consumed its donated inputs (kv/state
+        # buffers deleted). KV contents are irrelevant now — every
+        # sequence is gone — but the buffers must exist for the next
+        # prefill, so rebuild any that died with the failed executable.
+        try:
+            dead = self.k_pages.is_deleted() or self.v_pages.is_deleted()
+        except Exception:  # noqa: BLE001
+            dead = True
+        if dead:
+            k_pages, v_pages = make_kv_pages(
+                self.model_config,
+                self.scheduler.config.num_pages,
+                self.cfg.page_size,
+                dtype=self.cfg.kv_dtype,
+            )
+            self.k_pages = jax.device_put(k_pages, self._kv_sharding)
+            self.v_pages = jax.device_put(v_pages, self._kv_sharding)
 
     # --- metrics ----------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -587,6 +945,14 @@ class AsyncEngine:
                 # re-stepping a half-updated batch would loop hot on the
                 # same exception. The worker requeues the jobs.
                 self.core.abort_all("error")
+                # Drain the intake queue too: those requests' futures are
+                # failed below, so adding them next iteration would
+                # generate orphaned completions nobody is awaiting.
+                while True:
+                    try:
+                        self._intake.get_nowait()
+                    except queue.Empty:
+                        break
                 for fut in list(self._futures.values()):
                     if not fut.done():
                         fut.set_exception(RuntimeError("engine step failed"))

@@ -76,6 +76,7 @@ class Sequence:
     slot: int = -1
     admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
     preempt_count: int = 0
+    prefilled: bool = False  # KV cache holds this sequence (engine sets it)
     finish_reason: Optional[str] = None
     finish_text: Optional[str] = None  # pre-truncated text on stop-string hit
 
@@ -179,10 +180,26 @@ class Scheduler:
         page boundary. May preempt *other* sequences to find a page; raises
         OutOfPages only if even preemption can't help (seq is last alive)."""
         seq.output_ids.append(token)
-        while self._pages_needed(seq.num_tokens) > len(seq.pages):
+        self.ensure_pages(seq, seq.num_tokens + 1)
+
+    def ensure_pages(
+        self, seq: Sequence, num_positions: int, *, allow_preempt: bool = True
+    ) -> None:
+        """Grow ``seq``'s page map to cover ``num_positions`` KV slots
+        (capped at the per-sequence maximum). The engine's run-ahead
+        pipeline calls this *at dispatch time* with a lookahead, so pages
+        always exist on-device before the step that writes them. May
+        preempt other sequences (unless ``allow_preempt`` is off — the
+        engine forbids it while steps are in flight, because a victim's
+        freed pages could still be written); raises OutOfPages otherwise."""
+        cap = self.config.pages_per_seq * self.config.page_size
+        num_positions = min(num_positions, cap)
+        while -(-num_positions // self.config.page_size) > len(seq.pages):
             try:
                 seq.pages.extend(self.allocator.alloc(1))
             except OutOfPages:
+                if not allow_preempt:
+                    raise
                 victim = self._youngest_running(exclude=seq.rid)
                 if victim is None:
                     raise
@@ -200,11 +217,27 @@ class Scheduler:
         re-prefills prompt+generated to rebuild the KV cache."""
         self._release(seq)
         seq.preempt_count += 1
+        seq.prefilled = False  # KV is gone; re-admission re-prefills
         self.waiting.appendleft(seq)
 
-    def finish(self, seq: Sequence, reason: str) -> None:
+    def finish(
+        self, seq: Sequence, reason: str, *, defer_pages: bool = False
+    ) -> List[int]:
+        """Finish a sequence. With ``defer_pages`` the slot is released but
+        the KV pages are detached and *returned* instead of freed — the
+        engine holds them until every in-flight device step that may still
+        write them has completed, then calls ``release_pages``."""
         seq.finish_reason = reason
+        pages = seq.pages if defer_pages else []
+        if defer_pages:
+            seq.pages = []
         self._release(seq)
+        return pages
+
+    def release_pages(self, pages: List[int]) -> None:
+        """Return deferred pages (from ``finish(defer_pages=True)``)."""
+        if pages:
+            self.allocator.free(pages)
 
     def _release(self, seq: Sequence) -> None:
         if seq.slot >= 0:

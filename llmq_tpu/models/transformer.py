@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.ops import attention as attn_ops
+from llmq_tpu.ops import dispatch as attn_dispatch
 
 Params = Dict[str, Any]
 
@@ -111,9 +112,17 @@ def _mlp(h: jnp.ndarray, lp: Params, activation: str) -> jnp.ndarray:
 
 @dataclasses.dataclass(frozen=True)
 class Transformer:
-    """Functional model: ``prefill`` and ``decode`` over a paged KV cache."""
+    """Functional model: ``prefill`` and ``decode`` over a paged KV cache.
+
+    ``mesh`` (optional) lets the attention dispatch wrap its Pallas
+    kernels in ``shard_map`` over the tp axis (ops/dispatch.py); the
+    pure-XLA fallback ignores it (GSPMD partitions it directly).
+    ``attn_backend``: "auto" | "pallas" | "xla".
+    """
 
     config: ModelConfig
+    mesh: Any = None
+    attn_backend: str = "auto"
 
     # --- shared layer body -------------------------------------------------
     def _qkv(
@@ -229,7 +238,7 @@ class Transformer:
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
             q, k, v = self._qkv(lp, x, positions, inv_freq)
             kp, vp = attn_ops.write_kv_pages(kp, vp, k, v, block_tables, positions)
-            attn_out = attn_ops.full_prefill_attention(
+            attn_out = attn_dispatch.prefill_attention(
                 q,
                 k,
                 v,
@@ -237,6 +246,8 @@ class Transformer:
                 lengths=lengths,
                 sliding_window=window,
                 softcap=cfg.attn_softcap,
+                mesh=self.mesh,
+                backend=self.attn_backend,
             )
             h = self._finish_layer(lp, h, attn_out)
             kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)
@@ -286,7 +297,7 @@ class Transformer:
             kp, vp = attn_ops.write_kv_pages(
                 kp, vp, k, v, block_tables, positions[:, None]
             )
-            attn_out = attn_ops.paged_decode_attention(
+            attn_out = attn_dispatch.decode_attention(
                 q[:, 0],
                 kp,
                 vp,
@@ -295,6 +306,8 @@ class Transformer:
                 scale=cfg.attn_scale,
                 sliding_window=window,
                 softcap=cfg.attn_softcap,
+                mesh=self.mesh,
+                backend=self.attn_backend,
             )
             h = self._finish_layer(lp, h, attn_out)
             kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)

@@ -1,0 +1,149 @@
+"""Attention backend dispatch: Pallas kernels on TPU, XLA elsewhere.
+
+The model code (``models/transformer.py``) calls these two functions; the
+backend is resolved once at trace time:
+
+- ``LLMQ_ATTN_BACKEND`` env var: ``auto`` (default) | ``pallas`` | ``xla``.
+- ``auto`` → Pallas on TPU, pure-XLA reference elsewhere.
+- ``pallas`` off-TPU runs the kernels in interpreter mode (slow, for
+  numerics tests — tests/test_pallas_attention.py).
+
+Tensor parallelism: under GSPMD a ``pallas_call`` is an opaque custom
+call XLA cannot partition, so when a mesh with a >1 ``tp`` axis is
+passed, the kernel is wrapped in ``jax.shard_map`` sharded over the
+head axes (attention is embarrassingly parallel over heads). Head counts
+that don't divide tp fall back to the XLA path, which GSPMD partitions
+however it likes — mirrors the replication fallback in
+``parallel/sharding.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llmq_tpu.ops import attention as xla_ops
+from llmq_tpu.ops import pallas_attention as pk
+from llmq_tpu.parallel.mesh import TP_AXIS
+
+_WINDOW_DISABLED = 1 << 30
+
+
+def resolve_backend() -> str:
+    env = os.environ.get("LLMQ_ATTN_BACKEND", "auto").lower()
+    if env == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if env not in ("pallas", "xla"):
+        raise ValueError(f"LLMQ_ATTN_BACKEND={env!r} (want auto|pallas|xla)")
+    return env
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _window_scalar(sliding_window) -> jnp.ndarray:
+    if sliding_window is None:
+        return jnp.asarray([_WINDOW_DISABLED], jnp.int32)
+    return jnp.asarray(sliding_window, jnp.int32).reshape(1)
+
+
+def _tp_degree(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(TP_AXIS, 1))
+
+
+def prefill_attention(
+    q: jnp.ndarray,  # [B, T, n_heads, d]
+    k: jnp.ndarray,  # [B, T, n_kv, d]
+    v: jnp.ndarray,
+    *,
+    scale: float,
+    lengths: Optional[jnp.ndarray] = None,  # [B]
+    sliding_window=None,
+    softcap: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    backend = resolve_backend() if backend == "auto" else backend
+    n_heads, n_kv = q.shape[2], k.shape[2]
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    if backend != "pallas" or not tp_ok:
+        return xla_ops.full_prefill_attention(
+            q, k, v, scale=scale, lengths=lengths,
+            sliding_window=sliding_window, softcap=softcap,
+        )
+    if lengths is None:
+        lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+    window = _window_scalar(sliding_window)
+
+    def call(q, k, v, lengths, window):
+        return pk.flash_prefill_attention_pallas(
+            q, k, v, lengths, window,
+            scale=scale, softcap=softcap, interpret=_interpret(),
+        )
+
+    if tp > 1:
+        assert mesh is not None
+        head = P(None, None, TP_AXIS, None)
+        call = jax.shard_map(
+            call,
+            mesh=mesh,
+            in_specs=(head, head, head, P(), P()),
+            out_specs=head,
+        )
+    return call(q, k, v, lengths, window)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [S, n_heads, d]
+    k_pages: jnp.ndarray,  # [Pg, page_size, n_kv, d]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [S, pages_per_seq]
+    context_lens: jnp.ndarray,  # [S] INCLUDING the new token
+    *,
+    scale: float,
+    sliding_window=None,
+    softcap: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    backend = resolve_backend() if backend == "auto" else backend
+    n_heads, n_kv = q.shape[1], k_pages.shape[2]
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    if backend != "pallas" or not tp_ok:
+        return xla_ops.paged_decode_attention(
+            q, k_pages, v_pages, block_tables, context_lens,
+            scale=scale, sliding_window=sliding_window, softcap=softcap,
+        )
+    window = _window_scalar(sliding_window)
+
+    def call(q, kp, vp, bt, cl, window):
+        return pk.paged_decode_attention_pallas(
+            q, kp, vp, bt, cl, window,
+            scale=scale, softcap=softcap, interpret=_interpret(),
+        )
+
+    if tp > 1:
+        assert mesh is not None
+        call = jax.shard_map(
+            call,
+            mesh=mesh,
+            in_specs=(
+                P(None, TP_AXIS, None),
+                P(None, None, TP_AXIS, None),
+                P(None, None, TP_AXIS, None),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=P(None, TP_AXIS, None),
+        )
+    return call(q, k_pages, v_pages, block_tables, context_lens, window)

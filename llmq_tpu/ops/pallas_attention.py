@@ -1,0 +1,398 @@
+"""Pallas TPU attention kernels: paged decode + flash prefill.
+
+These are the compiled-native counterparts of vLLM's CUDA PagedAttention
+(consumed by the reference at ``llmq/workers/vllm_worker.py:183-195`` via
+``engine.generate``) — written TPU-first with Pallas/Mosaic instead of a
+CUDA translation. Numerics are validated against the pure-XLA references
+in ``ops/attention.py`` (tests/test_pallas_attention.py, interpret mode).
+
+Design notes
+------------
+* **Paged decode** (`paged_decode_attention_pallas`): grid
+  ``(num_seqs, num_kv_heads, pages_per_seq)``. The block table and context
+  lengths ride in scalar-prefetch SMEM so each K/V page is DMA'd straight
+  from HBM by the BlockSpec index_map — the gather the XLA reference
+  materializes (``attention.py:96-97``) never exists on-chip. Online
+  (flash) softmax accumulates across pages in VMEM scratch; pages past a
+  sequence's context (or below its sliding window) are skipped via
+  ``pl.when`` — the DMA still runs (fixed schedule) but the FLOPs don't.
+* **Flash prefill** (`flash_prefill_attention_pallas`): classic
+  flash-attention tiling, grid ``(batch, q_heads, q_blocks, kv_blocks)``,
+  causal + ragged-length + sliding-window masking in-kernel, with whole
+  kv-blocks skipped when outside the causal/window/length frontier.
+  GQA is handled by the K/V index_map (``h // n_rep``) — no
+  ``repeat_kv`` materialization.
+* Sliding windows arrive as a **traced scalar** (layers are scanned, the
+  per-layer window is data — see ``models/transformer.py``), so both
+  kernels take it as a scalar-prefetch operand rather than a static.
+* Softcap/scale are static config; masks use a large negative instead of
+  ``-inf`` to keep softmax NaN-free for inactive slots (garbage rows are
+  discarded by the caller, they must not poison the batch).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # VPU lane count: scratch m/l are stored lane-replicated
+
+
+def _apply_softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    # scalar prefetch
+    bt_ref,  # [S, pages_per_seq] int32
+    cl_ref,  # [S] int32 — context length INCLUDING the new token
+    w_ref,  # [1] int32 — sliding window (huge = disabled)
+    # blocked inputs
+    q_ref,  # [1, n_heads, d]
+    k_ref,  # [1, page_size, n_kv, d] — one whole page, all kv heads
+    v_ref,  # [1, page_size, n_kv, d]
+    # output
+    o_ref,  # [1, n_heads, d]
+    # scratch
+    m_ref,  # [n_heads, LANES] f32, lane-replicated running max
+    l_ref,  # [n_heads, LANES] f32, lane-replicated running denom
+    acc_ref,  # [n_heads, d] f32
+    *,
+    scale: float,
+    page_size: int,
+    pages_per_seq: int,
+    n_kv: int,
+    softcap: Optional[float],
+):
+    # Mosaic requires the trailing two block dims be tile-aligned or span
+    # the whole array, so a page is loaded with ALL kv heads and the GQA
+    # groups are walked with a static (unrolled) loop — n_kv is small.
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    ctx = cl_ref[s]
+    window = w_ref[0]
+    start = p * page_size
+    group = q_ref.shape[1] // n_kv
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Page contributes iff it overlaps [max(0, ctx-window), ctx).
+    live = jnp.logical_and(start < ctx, start + page_size > ctx - window)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [H, d]
+        k = k_ref[0].astype(jnp.float32)  # [page, n_kv, d]
+        v = v_ref[0].astype(jnp.float32)
+        for g in range(n_kv):
+            rows = slice(g * group, (g + 1) * group)
+            scores = (
+                jax.lax.dot_general(
+                    q[rows], k[:, g, :], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [group, page]
+            scores = _apply_softcap(scores, softcap)
+            kpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            mask = jnp.logical_and(kpos < ctx, kpos >= ctx - window)
+            scores = jnp.where(mask, scores, NEG_INF)
+
+            m_prev = m_ref[rows, :1]
+            l_prev = l_ref[rows, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(scores - m_new)
+            l_ref[rows, :] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(probs, axis=1, keepdims=True),
+                (group, l_ref.shape[1]),
+            )
+            m_ref[rows, :] = jnp.broadcast_to(
+                m_new, (group, m_ref.shape[1])
+            )
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
+                probs, v[:, g, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # inactive slot: defined output
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "interpret"),
+)
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # [S, n_heads, d]
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, d]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [S, pages_per_seq] int32
+    context_lens: jnp.ndarray,  # [S] int32, INCLUDING the new token
+    sliding_window: jnp.ndarray,  # [] or [1] int32 (huge = disabled)
+    *,
+    scale: float,
+    softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    S, n_heads, d = q.shape
+    _, page_size, n_kv, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        scale=scale,
+        page_size=page_size,
+        pages_per_seq=pages_per_seq,
+        n_kv=n_kv,
+        softcap=softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, n_heads, d), lambda s, p, bt, cl, w: (s, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, n_kv, d),
+                lambda s, p, bt, cl, w: (bt[s, p], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page_size, n_kv, d),
+                lambda s, p, bt, cl, w: (bt[s, p], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_heads, d), lambda s, p, bt, cl, w: (s, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, _LANES), jnp.float32),
+            pltpu.VMEM((n_heads, _LANES), jnp.float32),
+            pltpu.VMEM((n_heads, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, n_heads, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        jnp.asarray(sliding_window, jnp.int32).reshape(1),
+        q,
+        k_pages,
+        v_pages,
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flash prefill
+# ---------------------------------------------------------------------------
+
+
+def _flash_prefill_kernel(
+    # scalar prefetch
+    len_ref,  # [B] int32 — valid prompt lengths
+    w_ref,  # [1] int32 — sliding window
+    # blocked inputs ([B, H, T, d] layouts)
+    q_ref,  # [1, 1, bq, d]
+    k_ref,  # [1, 1, bk, d]
+    v_ref,  # [1, 1, bk, d]
+    # output
+    o_ref,  # [1, 1, bq, d]
+    # scratch
+    m_ref,  # [bq, LANES] f32
+    l_ref,  # [bq, LANES] f32
+    acc_ref,  # [bq, d] f32
+    *,
+    scale: float,
+    block_q: int,
+    block_kv: int,
+    num_kv_blocks: int,
+    softcap: Optional[float],
+):
+    b = pl.program_id(0)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    length = len_ref[b]
+    window = w_ref[0]
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Block is live iff some (q, k) pair satisfies causal + length + window.
+    live = jnp.logical_and(
+        k_start <= q_start + block_q - 1,  # causal frontier
+        jnp.logical_and(
+            k_start < length,  # ragged length
+            k_start + block_kv - 1 > q_start - window,  # window frontier
+        ),
+    )
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [bq, bk]
+        scores = _apply_softcap(scores, softcap)
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        mask = jnp.logical_and(
+            kpos <= qpos,
+            jnp.logical_and(kpos < length, kpos > qpos - window),
+        )
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(probs, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_kv", "interpret"),
+)
+def flash_prefill_attention_pallas(
+    q: jnp.ndarray,  # [B, T, n_heads, d]
+    k: jnp.ndarray,  # [B, T, n_kv, d]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] int32
+    sliding_window: jnp.ndarray,  # [] or [1] int32 (huge = disabled)
+    *,
+    scale: float,
+    softcap: Optional[float] = None,
+    block_q: int = 256,
+    block_kv: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, T, n_heads, d = q.shape
+    n_kv = k.shape[2]
+    n_rep = n_heads // n_kv
+    block_q = min(block_q, max(T, 8))
+    block_kv = min(block_kv, max(T, 8))
+    t_pad = -(-T // max(block_q, block_kv)) * max(block_q, block_kv)
+
+    # [B, H, T, d] layout: T on sublanes, d on lanes, contiguous DMA tiles.
+    qt = jnp.pad(
+        q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - T), (0, 0))
+    )
+    kt = jnp.pad(
+        k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - T), (0, 0))
+    )
+    vt = jnp.pad(
+        v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - T), (0, 0))
+    )
+    nq = t_pad // block_q
+    nk = t_pad // block_kv
+
+    kernel = functools.partial(
+        _flash_prefill_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv_blocks=nk,
+        softcap=softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d),
+                lambda b, h, iq, ik, ln, w: (b, h, iq, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, d),
+                lambda b, h, iq, ik, ln, w: (b, h // n_rep, ik, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, d),
+                lambda b, h, iq, ik, ln, w: (b, h // n_rep, ik, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, iq, ik, ln, w: (b, h, iq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, n_heads, t_pad, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "arbitrary",
+                "arbitrary",
+                "arbitrary",
+                "arbitrary",
+            ),
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        jnp.asarray(sliding_window, jnp.int32).reshape(1),
+        qt,
+        kt,
+        vt,
+    )
+    return out[:, :, :T, :].transpose(0, 2, 1, 3)

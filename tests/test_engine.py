@@ -249,3 +249,63 @@ class TestAsyncEngine:
             asyncio.run(main())
         finally:
             eng.shutdown()
+
+
+class TestReviewRegressions:
+    """Regressions from the run-ahead-pipeline review."""
+
+    def test_min_tokens_token_never_emitted_early(self):
+        """min_tokens must *suppress* the stop token's logits, not just
+        ignore the stop — the id must not appear in the early output."""
+        probe = run_sync(make_core(), [("p", "hi", greedy(6))])["p"]
+        stopper = probe.token_ids[1]
+        out = run_sync(
+            make_core(),
+            [("r", "hi", greedy(6, stop_token_ids=(stopper,), min_tokens=4))],
+        )["r"]
+        assert out.completion_tokens >= 4
+        assert stopper not in out.token_ids[:4]
+
+    def test_stop_string_trims_token_ids(self):
+        """token_ids/usage must agree with the truncated text."""
+        core = make_core()
+        probe = run_sync(core, [("p", "hello", greedy(8))])["p"]
+        tok = ByteTokenizer()
+        full = probe.text
+        if len(full) < 3:
+            pytest.skip("probe output too short")
+        stop = full[2]
+        out = run_sync(
+            make_core(), [("r", "hello", greedy(8, stop=(stop,)))]
+        )["r"]
+        assert out.finish_reason == "stop"
+        assert out.completion_tokens == len(out.token_ids)
+        decoded = tok.decode(out.token_ids)
+        assert decoded.startswith(out.text)
+        # at most the matched stop itself may trail the text
+        assert len(decoded) <= len(out.text) + len(stop) + 8
+
+    def test_stop_string_earliest_match_wins(self):
+        core = make_core()
+        tok = ByteTokenizer()
+        from llmq_tpu.engine.scheduler import Sequence
+
+        seq = Sequence(
+            rid="s",
+            prompt_ids=[1],
+            params=SamplingParams(stop=("b", "ab"), max_tokens=10),
+        )
+        seq.output_ids = list(tok.encode("xab"))
+        reason = core._stop_reason(seq, seq.output_ids[-1])
+        assert reason == "stop"
+        assert seq.finish_text == "x"  # "ab" matches at 1, before "b" at 2
+
+    def test_abort_all_recovers_donated_buffers(self):
+        """After a failed step consumed the donated KV buffers, abort_all
+        must leave the engine usable."""
+        core = make_core()
+        run_sync(core, [("a", "hi", greedy(4))])
+        core.k_pages.delete()  # simulate a step that died mid-donation
+        core.abort_all("error")
+        out = run_sync(core, [("b", "still alive?", greedy(4))])["b"]
+        assert out.completion_tokens == 4

@@ -1,0 +1,169 @@
+"""Pallas attention kernels vs the pure-XLA references (interpret mode).
+
+Mirrors the reference's pattern of testing the inference backend with a
+deterministic stand-in (SURVEY.md §4) — here the stand-in is the XLA
+ground truth in ops/attention.py, and the subject is the compiled-path
+kernels in ops/pallas_attention.py run through the Pallas interpreter on
+the CPU backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmq_tpu.ops import attention as ref_ops
+from llmq_tpu.ops import pallas_attention as pk
+from llmq_tpu.ops.dispatch import _WINDOW_DISABLED
+
+pytestmark = pytest.mark.unit
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, jnp.float32) * 0.3
+
+
+def _paged_setup(key, *, S, n_kv, d, page_size, pages_per_seq, ctx_lens):
+    """Random pages + a block table that maps every live position."""
+    P = 1 + S * pages_per_seq  # page 0 reserved (scratch)
+    k1, k2 = jax.random.split(key)
+    k_pages = _rand(k1, (P, page_size, n_kv, d))
+    v_pages = _rand(k2, (P, page_size, n_kv, d))
+    bt = np.arange(1, 1 + S * pages_per_seq, dtype=np.int32).reshape(
+        S, pages_per_seq
+    )
+    return k_pages, v_pages, jnp.asarray(bt), jnp.asarray(ctx_lens, jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "n_heads,n_kv,window,softcap",
+    [
+        (4, 4, None, None),  # MHA
+        (8, 2, None, None),  # GQA
+        (8, 2, 13, None),  # sliding window (ragged vs page grid)
+        (4, 1, None, 30.0),  # softcap (gemma2-style)
+        (6, 3, 7, 20.0),  # everything at once, odd group
+    ],
+)
+def test_paged_decode_matches_reference(n_heads, n_kv, window, softcap):
+    S, d, page_size, pages_per_seq = 5, 16, 8, 4
+    ctx = [1, 7, 8, 19, 32]  # page-aligned and not, incl. full
+    key = jax.random.key(0)
+    kq, kp_ = jax.random.split(key)
+    q = _rand(kq, (S, n_heads, d))
+    k_pages, v_pages, bt, cl = _paged_setup(
+        kp_, S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=ctx,
+    )
+    scale = d**-0.5
+    win = jnp.asarray([window if window else _WINDOW_DISABLED], jnp.int32)
+    ref = ref_ops.paged_decode_attention(
+        q, k_pages, v_pages, bt, cl,
+        scale=scale, sliding_window=window, softcap=softcap,
+    )
+    out = pk.paged_decode_attention_pallas(
+        q, k_pages, v_pages, bt, cl, win,
+        scale=scale, softcap=softcap, interpret=True,
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_inactive_slot_no_nan():
+    """ctx=0 slots must produce finite garbage, not NaN."""
+    S, n_heads, n_kv, d, page_size, pages_per_seq = 2, 4, 2, 16, 8, 2
+    key = jax.random.key(1)
+    q = _rand(key, (S, n_heads, d))
+    k_pages, v_pages, bt, cl = _paged_setup(
+        key, S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=[0, 5],
+    )
+    out = pk.paged_decode_attention_pallas(
+        q, k_pages, v_pages, bt, cl,
+        jnp.asarray([_WINDOW_DISABLED], jnp.int32),
+        scale=d**-0.5, interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize(
+    "n_heads,n_kv,window,softcap,T,block",
+    [
+        (4, 4, None, None, 32, 16),  # MHA, multiple kv blocks
+        (8, 2, None, None, 48, 16),  # GQA, T not multiple of 32
+        (4, 2, 9, None, 64, 16),  # sliding window crossing blocks
+        (4, 1, None, 25.0, 32, 32),  # softcap, single block
+        (6, 3, 11, 15.0, 40, 16),  # all together, padded T
+    ],
+)
+def test_flash_prefill_matches_reference(n_heads, n_kv, window, softcap, T, block):
+    B, d = 3, 16
+    lengths = jnp.asarray([T, T // 2, 3], jnp.int32)
+    key = jax.random.key(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = _rand(kq, (B, T, n_heads, d))
+    k = _rand(kk, (B, T, n_kv, d))
+    v = _rand(kv, (B, T, n_kv, d))
+    scale = d**-0.5
+    ref = ref_ops.full_prefill_attention(
+        q, k, v, scale=scale, lengths=lengths,
+        sliding_window=window, softcap=softcap,
+    )
+    out = pk.flash_prefill_attention_pallas(
+        q, k, v, lengths,
+        jnp.asarray([window if window else _WINDOW_DISABLED], jnp.int32),
+        scale=scale, softcap=softcap,
+        block_q=block, block_kv=block, interpret=True,
+    )
+    # Rows past a sequence's length are garbage in both impls: compare
+    # only valid rows.
+    for b in range(B):
+        n = int(lengths[b])
+        np.testing.assert_allclose(
+            out[b, :n], ref[b, :n], rtol=2e-5, atol=2e-5,
+            err_msg=f"batch row {b}",
+        )
+
+
+def test_dispatch_selects_xla_off_tpu(monkeypatch):
+    from llmq_tpu.ops import dispatch
+
+    monkeypatch.delenv("LLMQ_ATTN_BACKEND", raising=False)
+    assert dispatch.resolve_backend() == "xla"
+    monkeypatch.setenv("LLMQ_ATTN_BACKEND", "pallas")
+    assert dispatch.resolve_backend() == "pallas"
+    monkeypatch.setenv("LLMQ_ATTN_BACKEND", "bogus")
+    with pytest.raises(ValueError):
+        dispatch.resolve_backend()
+
+
+def test_dispatch_pallas_path_through_model():
+    """Full tiny-model decode parity: pallas backend vs xla backend."""
+    from llmq_tpu.models.config import ModelConfig
+    from llmq_tpu.models.transformer import (
+        Transformer,
+        init_params,
+        make_kv_pages,
+    )
+
+    config = ModelConfig.tiny(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64,
+    )
+    params = init_params(config, jax.random.key(0))
+    S, page_size, num_pages, pages_per_seq = 3, 8, 16, 4
+    k_pages, v_pages = make_kv_pages(config, num_pages, page_size, jnp.float32)
+    tokens = jnp.asarray([1, 2, 3], jnp.int32)
+    ctx = jnp.asarray([3, 5, 0], jnp.int32)
+    bt = jnp.arange(1, 1 + S * pages_per_seq, dtype=jnp.int32).reshape(S, -1)
+    active = jnp.asarray([True, True, False])
+
+    outs = {}
+    for backend in ("xla", "pallas"):
+        model = Transformer(config, attn_backend=backend)
+        logits, _, _ = model.decode(
+            params, tokens, ctx, k_pages, v_pages, bt, active
+        )
+        outs[backend] = np.asarray(logits)
+    np.testing.assert_allclose(
+        outs["pallas"][:2], outs["xla"][:2], rtol=1e-4, atol=1e-4
+    )
