@@ -166,13 +166,18 @@ def worker() -> None:
               help="Tensor-parallel degree (default: all local devices)")
 @click.option("-dp", "--data-parallel", type=int, default=1, show_default=True,
               help="Data-parallel replicas within this worker")
+@click.option("-sp", "--sequence-parallel", type=int, default=1,
+              show_default=True,
+              help="Context-parallel degree (ring attention over long "
+                   "prompts)")
 @click.option("-c", "--concurrency", type=int, default=None,
               help="Override prefetch/in-flight job count")
 @click.option("--max-num-seqs", type=int, default=None, help="Engine batch slots")
 @click.option("--max-model-len", type=int, default=None, help="Context window cap")
 @click.option("--dtype", default="bfloat16", show_default=True)
-def worker_run(model, queue, tensor_parallel, data_parallel, concurrency,
-               max_num_seqs, max_model_len, dtype):
+def worker_run(model, queue, tensor_parallel, data_parallel,
+               sequence_parallel, concurrency, max_num_seqs, max_model_len,
+               dtype):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -180,6 +185,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel, concurrency,
         model, queue,
         tensor_parallel=tensor_parallel,
         data_parallel=data_parallel,
+        sequence_parallel=sequence_parallel,
         concurrency=concurrency,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,

@@ -18,6 +18,7 @@ def run_tpu_worker(
     *,
     tensor_parallel: Optional[int] = None,
     data_parallel: int = 1,
+    sequence_parallel: int = 1,
     concurrency: Optional[int] = None,
     max_num_seqs: Optional[int] = None,
     max_model_len: Optional[int] = None,
@@ -36,6 +37,7 @@ def run_tpu_worker(
         model=model,
         tensor_parallel=tensor_parallel,
         data_parallel=data_parallel,
+        sequence_parallel=sequence_parallel,
         concurrency=concurrency,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,

@@ -634,6 +634,7 @@ class EngineCore:
                 try:
                     # Amortize: top up a full page beyond the need — but
                     # never at someone else's expense.
+                    before = len(seq.pages)
                     self.scheduler.ensure_pages(
                         seq,
                         self._page_target(
@@ -641,7 +642,7 @@ class EngineCore:
                         ),
                         allow_preempt=False,
                     )
-                    grown = True
+                    grown = grown or len(seq.pages) > before
                 except OutOfPages:
                     # Pool exhausted: catch the host up so deferred pages
                     # return and preemption can free a victim safely.
@@ -653,9 +654,15 @@ class EngineCore:
                             seq, self._page_target(seq, lookahead)
                         )
                     except OutOfPages:
-                        # Alone and still short: the pool itself is the cap.
-                        self.scheduler.finish(seq, "length")
-                        finished.append(self._output_for(seq))
+                        # Alone and still short: the pool itself is the
+                        # cap. Must go through _finish_seq: pages stay
+                        # deferred while in-flight steps may write them,
+                        # and the dirty resync deactivates the device slot
+                        # (a zombie slot would keep scattering KV through
+                        # its stale block table into reallocated pages).
+                        self._finish_seq(seq, "length",
+                                         device_detected=False,
+                                         finished=finished)
                         continue
                     self._dirty = True
             if grown and not self._dirty:
@@ -693,9 +700,12 @@ class EngineCore:
 
     def _page_target(self, seq: Sequence, lookahead: int) -> int:
         """KV positions ``seq`` must have pages for, given ``lookahead``
-        in-flight/future steps — capped by its own finish horizon."""
+        in-flight/future steps — capped by its own finish horizon AND the
+        per-sequence page-map capacity (otherwise a full-budget sequence
+        would look perpetually short and churn block-table swaps)."""
         horizon = len(seq.prompt_ids) + seq.params.max_tokens + 1
-        return min(seq.num_tokens + lookahead, horizon)
+        cap = self._pages_per_seq * self.cfg.page_size
+        return min(seq.num_tokens + lookahead, horizon, cap)
 
     def _append_and_check(
         self, seq: Sequence, token: int, finished: List[RequestOutput]

@@ -28,7 +28,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from llmq_tpu.ops import attention as xla_ops
 from llmq_tpu.ops import pallas_attention as pk
-from llmq_tpu.parallel.mesh import TP_AXIS
+from llmq_tpu.ops import ring_attention as ring
+from llmq_tpu.parallel.mesh import SP_AXIS, TP_AXIS
 
 _WINDOW_DISABLED = 1 << 30
 
@@ -72,6 +73,15 @@ def prefill_attention(
 ) -> jnp.ndarray:
     backend = resolve_backend() if backend == "auto" else backend
     n_heads, n_kv = q.shape[2], k.shape[2]
+    # Context parallelism: an sp>1 mesh axis ring-shards the sequence
+    # (ops/ring_attention.py) — long-context prefill never materializes
+    # full-T activations per device.
+    sp = int(mesh.shape.get(SP_AXIS, 1)) if mesh is not None else 1
+    if sp > 1 and q.shape[1] % sp == 0:
+        return ring.ring_prefill_attention(
+            q, k, v, scale=scale, mesh=mesh, lengths=lengths,
+            sliding_window=sliding_window, softcap=softcap,
+        )
     tp = _tp_degree(mesh)
     tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
     if backend != "pallas" or not tp_ok:

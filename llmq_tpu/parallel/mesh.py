@@ -15,33 +15,40 @@ import numpy as np
 from jax.sharding import Mesh
 
 DP_AXIS = "dp"
+SP_AXIS = "sp"  # sequence/context parallel (ring attention over ICI)
 TP_AXIS = "tp"
 
 
-def auto_tensor_parallel(data_parallel: int = 1, devices=None) -> int:
-    """TP degree when unspecified: all visible devices / dp."""
+def auto_tensor_parallel(
+    data_parallel: int = 1, devices=None, sequence_parallel: int = 1
+) -> int:
+    """TP degree when unspecified: all visible devices / (dp*sp)."""
     n = len(devices if devices is not None else jax.devices())
-    return max(1, n // max(1, data_parallel))
+    return max(1, n // max(1, data_parallel * sequence_parallel))
 
 
 def make_mesh(
     tensor_parallel: Optional[int] = None,
     data_parallel: int = 1,
+    sequence_parallel: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """A ``(dp, tp)`` mesh over the first ``dp*tp`` visible devices.
+    """A ``(dp, sp, tp)`` mesh over the first ``dp*sp*tp`` visible devices.
 
     The tp axis is innermost so tensor-parallel collectives ride the
-    fastest links (ICI neighbours on a TPU slice); dp is the outer axis
-    (per-replica traffic is batch-disjoint and needs no bandwidth).
+    fastest links (ICI neighbours on a TPU slice); sp sits next to it —
+    ring-attention ppermute hops are neighbour-to-neighbour; dp is the
+    outer axis (per-replica traffic is batch-disjoint and needs no
+    bandwidth).
     """
     devs = list(devices if devices is not None else jax.devices())
     dp = max(1, data_parallel)
-    tp = tensor_parallel or auto_tensor_parallel(dp, devs)
-    if dp * tp > len(devs):
+    sp = max(1, sequence_parallel)
+    tp = tensor_parallel or auto_tensor_parallel(dp, devs, sp)
+    if dp * sp * tp > len(devs):
         raise ValueError(
-            f"Mesh dp={dp} x tp={tp} needs {dp * tp} devices, "
-            f"only {len(devs)} visible"
+            f"Mesh dp={dp} x sp={sp} x tp={tp} needs {dp * sp * tp} "
+            f"devices, only {len(devs)} visible"
         )
-    grid = np.asarray(devs[: dp * tp]).reshape(dp, tp)
-    return Mesh(grid, (DP_AXIS, TP_AXIS))
+    grid = np.asarray(devs[: dp * sp * tp]).reshape(dp, sp, tp)
+    return Mesh(grid, (DP_AXIS, SP_AXIS, TP_AXIS))

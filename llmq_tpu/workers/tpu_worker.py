@@ -36,6 +36,7 @@ class TPUWorker(BaseWorker):
         model: str,
         tensor_parallel: Optional[int] = None,
         data_parallel: int = 1,
+        sequence_parallel: int = 1,
         max_num_seqs: Optional[int] = None,
         max_model_len: Optional[int] = None,
         dtype: str = "bfloat16",
@@ -46,6 +47,7 @@ class TPUWorker(BaseWorker):
         self.model = model
         self.tensor_parallel = tensor_parallel
         self.data_parallel = data_parallel
+        self.sequence_parallel = sequence_parallel
         self._max_num_seqs = max_num_seqs
         self._max_model_len = max_model_len
         self._dtype = dtype
@@ -83,6 +85,7 @@ class TPUWorker(BaseWorker):
         mesh = make_mesh(
             tensor_parallel=self.tensor_parallel,
             data_parallel=self.data_parallel,
+            sequence_parallel=self.sequence_parallel,
         )
         dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self._dtype]
 

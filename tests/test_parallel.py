@@ -24,10 +24,12 @@ def test_auto_tp_claims_all_devices():
 
 def test_mesh_shape_and_axis_order():
     mesh = make_mesh(tensor_parallel=4, data_parallel=2)
-    assert mesh.shape == {"dp": 2, "tp": 4}
+    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
     # tp is the innermost (fastest-varying) axis → ICI neighbours.
-    grid = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    grid = np.asarray(jax.devices()[:8]).reshape(2, 1, 4)
     assert (mesh.devices == grid).all()
+    mesh3 = make_mesh(tensor_parallel=2, data_parallel=2, sequence_parallel=2)
+    assert mesh3.shape == {"dp": 2, "sp": 2, "tp": 2}
 
 
 def test_mesh_too_large_rejected():
