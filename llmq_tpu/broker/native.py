@@ -1,0 +1,67 @@
+"""Locate/build the native (C++) broker daemon.
+
+``native/broker/brokerd.cpp`` is a wire-compatible C++ implementation of
+the Python asyncio daemon in ``tcp.py`` — same frames, same journal file
+format, same queue semantics — built as a single static-ish binary with
+no dependencies (``make -C native``). The CLI's ``broker serve --native``
+exec's it; tests build it on demand and run the full client test matrix
+against it.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+BINARY_NAME = "llmq-tpu-brokerd"
+
+
+def _repo_native_dir() -> Optional[Path]:
+    # package layout: <repo>/llmq_tpu/broker/native.py → <repo>/native
+    candidate = Path(__file__).resolve().parents[2] / "native"
+    return candidate if (candidate / "Makefile").exists() else None
+
+
+def find_brokerd() -> Optional[Path]:
+    """The brokerd binary: $LLMQ_BROKERD, PATH, or the repo build dir."""
+    env = os.environ.get("LLMQ_BROKERD")
+    if env and Path(env).exists():
+        return Path(env)
+    on_path = shutil.which(BINARY_NAME)
+    if on_path:
+        return Path(on_path)
+    native = _repo_native_dir()
+    if native is not None:
+        built = native / "bin" / BINARY_NAME
+        if built.exists():
+            return built
+    return None
+
+
+def build_brokerd(quiet: bool = True) -> Optional[Path]:
+    """Build via make when the source tree is present; None on failure."""
+    native = _repo_native_dir()
+    if native is None:
+        return None
+    try:
+        subprocess.run(
+            ["make", "-C", str(native)],
+            check=True,
+            capture_output=quiet,
+            timeout=180,
+        )
+    except (subprocess.CalledProcessError, OSError,
+            subprocess.TimeoutExpired):
+        return None
+    built = native / "bin" / BINARY_NAME
+    return built if built.exists() else None
+
+
+def ensure_brokerd() -> Optional[Path]:
+    """Build first when the source tree is present (make is incremental,
+    so a fresh binary costs one stat; a stale one gets rebuilt rather
+    than silently served), falling back to $LLMQ_BROKERD / PATH."""
+    return build_brokerd() or find_brokerd()

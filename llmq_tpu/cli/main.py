@@ -13,6 +13,8 @@ reference).
 from __future__ import annotations
 
 import asyncio
+import os
+import sys
 from typing import Optional, Tuple
 
 import click
@@ -245,8 +247,25 @@ def broker() -> None:
 @click.option("--port", type=int, default=5672, show_default=True)
 @click.option("--persist-dir", default=None,
               help="Journal directory for durability across restarts")
-def broker_serve(host: str, port: int, persist_dir: Optional[str]):
+@click.option("--native/--no-native", default=False, show_default=True,
+              help="Exec the C++ daemon (native/broker; wire- and "
+                   "journal-compatible) instead of the asyncio one")
+def broker_serve(host: str, port: int, persist_dir: Optional[str],
+                 native: bool):
     """Start the llmq-tpu broker daemon (the RabbitMQ equivalent)."""
+    if native:
+        from llmq_tpu.broker.native import ensure_brokerd
+
+        binary = ensure_brokerd()
+        if binary is None:
+            click.echo("native brokerd not found and build failed "
+                       "(need g++/make + the native/ source tree)", err=True)
+            sys.exit(1)
+        argv = [str(binary), "--host", host, "--port", str(port)]
+        if persist_dir:
+            argv += ["--persist-dir", persist_dir]
+        os.execv(str(binary), argv)
+
     from llmq_tpu.broker.tcp import BrokerServer
     from llmq_tpu.utils.logging import setup_logging
 

@@ -69,7 +69,7 @@ from llmq_tpu.engine.scheduler import (
 from llmq_tpu.engine.tokenizer import Tokenizer
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.models.transformer import Params, Transformer, make_kv_pages
-from llmq_tpu.parallel.mesh import DP_AXIS, TP_AXIS, make_mesh
+from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
 from llmq_tpu.parallel.sharding import kv_page_pspec, param_shardings
 
 logger = logging.getLogger(__name__)
@@ -101,14 +101,20 @@ class EngineConfig:
     stop_id_capacity: int = 8  # per-slot device-side stop-token ids
 
 
-def _prefill_buckets(cfg: EngineConfig) -> List[int]:
+def _prefill_buckets(cfg: EngineConfig, sp: int = 1) -> List[int]:
+    """Power-of-two prompt buckets up to max_model_len. Every bucket is
+    rounded up to a multiple of the sequence-parallel degree so ring
+    attention (which shards the T axis over sp) applies to all of them —
+    notably the top bucket, which is max_model_len itself and need not be
+    a power of two."""
     buckets = []
     b = cfg.min_prefill_bucket
     while b < cfg.max_model_len:
         buckets.append(b)
         b *= 2
     buckets.append(cfg.max_model_len)
-    return buckets
+    rounded = [-(-b // sp) * sp for b in buckets]
+    return sorted(set(rounded))
 
 
 # Pipeline entry: (dispatch index, device out-token array,
@@ -178,7 +184,9 @@ class EngineCore:
         self._eos_ids = set(model_config.eos_token_ids) | set(
             tokenizer.eos_token_ids
         )
-        self._buckets = _prefill_buckets(self.cfg)
+        self._buckets = _prefill_buckets(
+            self.cfg, sp=int(self.mesh.shape.get(SP_AXIS, 1))
+        )
         self._build_steps()
 
         # Host-side mirrors of the device decode state, rebuilt wholesale

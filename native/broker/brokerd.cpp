@@ -1,0 +1,895 @@
+// llmq-tpu-brokerd — native broker daemon.
+//
+// C++ implementation of the llmq-tpu broker daemon: the role RabbitMQ (an
+// external Erlang process) plays for the reference (SURVEY.md §1 L0), here
+// a single static binary with zero dependencies. Speaks the exact wire
+// protocol of the Python asyncio daemon (llmq_tpu/broker/tcp.py — 4-byte
+// big-endian length + JSON frames), so the Python TcpBroker client, the
+// CLI, and every worker connect to either implementation unchanged.
+//
+// Semantics mirrored from llmq_tpu/broker/memory.py (BrokerCore) and
+// tcp.py (BrokerServer):
+//   - per-queue FIFO ready list + unacked map, round-robin dispatch over
+//     consumers bounded by per-consumer prefetch;
+//   - ack / reject(requeue) settlement; requeue bumps delivery_count and
+//     dead-letters to "<q>.failed" past max_redeliveries (default 3);
+//   - ".failed" queues requeue without penalty (non-destructive DLQ peeks);
+//   - consumer disconnect requeues its unacked messages (at-least-once),
+//     with the same redelivery bump / dead-letter policy;
+//   - lazy TTL expiry at dispatch time;
+//   - append-only JSONL journal (publish/ack/redeliver records) replayed
+//     on startup and compacted at startup + every 100k ops — file format
+//     is shared with the Python daemon, so a data dir can be served by
+//     either binary across restarts.
+//
+// Architecture: single-threaded epoll event loop; all queue mutations are
+// synchronous with the triggering socket event, so there is no locking.
+// Message bodies/headers are carried as opaque JSON (never inspected).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <deque>
+#include <map>
+#include <memory>
+#include <random>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "json.hpp"
+
+using j::Json;
+
+static constexpr uint32_t kMaxFrame = 64u * 1024u * 1024u;
+static constexpr int kDefaultMaxRedeliveries = 3;
+static constexpr long kJournalCompactEvery = 100000;
+static const char* kFailedSuffix = ".failed";
+
+static double now_secs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static std::string hex_id(size_t n) {
+  static std::mt19937_64 rng(std::random_device{}() ^
+                             (uint64_t)getpid() << 17);
+  static const char* digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i) out += digits[rng() & 0xF];
+  return out;
+}
+
+static bool ends_with(const std::string& s, const std::string& suffix) {
+  return s.size() >= suffix.size() &&
+         s.compare(s.size() - suffix.size(), suffix.size(), suffix) == 0;
+}
+
+// Decoded byte length of a body carried as JSON text or base64 — matches
+// len(StoredMessage.body) in the Python core for stats parity.
+static size_t body_byte_len(const Json& body, const Json& enc) {
+  const std::string& s = body.as_string();
+  if (enc.as_string() == "b64") {
+    size_t n = s.size();
+    if (n == 0) return 0;
+    size_t pad = 0;
+    if (s[n - 1] == '=') ++pad;
+    if (n > 1 && s[n - 2] == '=') ++pad;
+    return (n / 4) * 3 - pad;
+  }
+  return s.size();  // UTF-8 text: JSON string bytes == body bytes
+}
+
+// ---------------------------------------------------------------------------
+// Queue engine
+// ---------------------------------------------------------------------------
+
+struct Message {
+  std::string message_id;
+  Json body;     // JSON string value (opaque)
+  Json enc;      // "b64" or null
+  Json headers;  // JSON object (opaque except dead-letter annotations)
+  int64_t delivery_count = 0;
+  double enqueued_at = 0.0;
+
+  size_t bytes() const { return body_byte_len(body, enc); }
+};
+
+struct Consumer {
+  std::string tag;
+  std::string queue;
+  int fd = -1;       // owning connection
+  int prefetch = 1;
+  int in_flight = 0;
+  bool transient_get = false;  // one-shot `get` pseudo-consumer
+};
+
+struct Queue {
+  std::string name;
+  int64_t ttl_ms = -1;  // -1 = none
+  int max_redeliveries = kDefaultMaxRedeliveries;
+  std::deque<std::shared_ptr<Message>> ready;
+  // message_id -> (message, consumer tag)
+  std::map<std::string, std::pair<std::shared_ptr<Message>, std::string>>
+      unacked;
+  std::vector<std::string> consumer_tags;  // dispatch order (round-robin)
+  size_t rr = 0;
+
+  bool expired(const Message& m, double now) const {
+    return ttl_ms >= 0 && (now - m.enqueued_at) * 1000.0 > (double)ttl_ms;
+  }
+};
+
+class Server;  // fwd
+
+class Engine {
+ public:
+  explicit Engine(Server* server) : server_(server) {}
+
+  Queue& declare(const std::string& name) {
+    auto it = queues_.find(name);
+    if (it == queues_.end()) {
+      auto& q = queues_[name];
+      q.name = name;
+      return q;
+    }
+    return it->second;
+  }
+
+  Queue* find(const std::string& name) {
+    auto it = queues_.find(name);
+    return it == queues_.end() ? nullptr : &it->second;
+  }
+
+  std::map<std::string, Queue>& queues() { return queues_; }
+  std::unordered_map<std::string, Consumer>& consumers() {
+    return consumers_;
+  }
+
+  void publish(const std::string& queue, std::shared_ptr<Message> msg) {
+    declare(queue).ready.push_back(std::move(msg));
+    dispatch(queue);
+  }
+
+  void add_consumer(const std::string& queue, Consumer c) {
+    declare(queue).consumer_tags.push_back(c.tag);
+    consumers_[c.tag] = std::move(c);
+    dispatch(queue);
+  }
+
+  // Requeue policy shared by reject(requeue=true) and disconnect.
+  void requeue_with_penalty(Queue& q, std::shared_ptr<Message> msg);
+
+  void remove_consumer(const std::string& tag, bool requeue_in_flight);
+
+  void settle(const std::string& queue, const std::string& message_id,
+              const std::string& verb, bool requeue);
+
+  std::shared_ptr<Message> get_one(const std::string& queue,
+                                   const std::string& tag, int fd);
+
+  void dispatch(const std::string& queue);
+
+ private:
+  Server* server_;
+  std::map<std::string, Queue> queues_;
+  std::unordered_map<std::string, Consumer> consumers_;
+
+  void dead_letter(Queue& q, std::shared_ptr<Message> msg);
+};
+
+// ---------------------------------------------------------------------------
+// Server: epoll transport + journal
+// ---------------------------------------------------------------------------
+
+struct Conn {
+  int fd = -1;
+  std::string rbuf;
+  std::string wbuf;
+  std::vector<std::string> tags;  // consumers owned by this connection
+  bool dead = false;
+};
+
+class Server {
+ public:
+  Server(const std::string& host, int port, const std::string& persist_dir)
+      : host_(host), port_(port), persist_dir_(persist_dir), engine_(this) {}
+
+  int run();
+
+  // --- engine callbacks --------------------------------------------------
+  void journal_publish(const std::string& queue, const Message& m) {
+    Json rec{j::Object{}};
+    rec.set("op", "publish");
+    rec.set("queue", queue);
+    rec.set("message_id", m.message_id);
+    rec.set("body", m.body);
+    if (!m.enc.is_null()) rec.set("enc", m.enc);
+    rec.set("headers", m.headers);
+    if (m.delivery_count > 0) rec.set("delivery_count", m.delivery_count);
+    journal(rec);
+  }
+  void journal_ack(const std::string& queue, const std::string& mid) {
+    Json rec{j::Object{}};
+    rec.set("op", "ack");
+    rec.set("queue", queue);
+    rec.set("message_id", mid);
+    journal(rec);
+  }
+  void journal_redeliver(const std::string& queue, const std::string& mid) {
+    Json rec{j::Object{}};
+    rec.set("op", "redeliver");
+    rec.set("queue", queue);
+    rec.set("message_id", mid);
+    journal(rec);
+  }
+
+  void deliver(const Consumer& c, const Message& m) {
+    Json frame{j::Object{}};
+    frame.set("type", "deliver");
+    frame.set("queue", c.queue);
+    frame.set("tag", c.tag);
+    frame.set("message_id", m.message_id);
+    frame.set("body", m.body);
+    if (!m.enc.is_null()) frame.set("enc", m.enc);
+    frame.set("delivery_count", m.delivery_count);
+    frame.set("headers", m.headers);
+    send_frame(c.fd, frame);
+  }
+
+ private:
+  std::string host_;
+  int port_;
+  std::string persist_dir_;
+  Engine engine_;
+  int epfd_ = -1;
+  int listen_fd_ = -1;
+  std::unordered_map<int, Conn> conns_;
+  FILE* journal_file_ = nullptr;
+  long journal_ops_ = 0;
+
+  std::string journal_path() const { return persist_dir_ + "/journal.jsonl"; }
+
+  void journal(const Json& rec) {
+    if (persist_dir_.empty()) return;
+    if (journal_file_ == nullptr) {
+      journal_file_ = fopen(journal_path().c_str(), "a");
+      if (journal_file_ == nullptr) {
+        fprintf(stderr, "journal open failed: %s\n", strerror(errno));
+        return;
+      }
+    }
+    std::string line = rec.dump();
+    line += '\n';
+    fwrite(line.data(), 1, line.size(), journal_file_);
+    fflush(journal_file_);
+    if (++journal_ops_ >= kJournalCompactEvery) compact_journal();
+  }
+
+  void load_journal() {
+    if (persist_dir_.empty()) return;
+    mkdir(persist_dir_.c_str(), 0755);
+    FILE* f = fopen(journal_path().c_str(), "r");
+    if (f == nullptr) return;
+    // (queue, message_id) -> publish record; ack removes, redeliver bumps.
+    std::map<std::pair<std::string, std::string>, Json> live;
+    std::string line;
+    char buf[1 << 16];
+    while (fgets(buf, sizeof(buf), f) != nullptr) {
+      line += buf;
+      if (line.empty() || line.back() != '\n') continue;  // long line cont.
+      while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      if (!line.empty()) {
+        try {
+          Json rec = Json::parse(line);
+          std::string op = rec.get("op").as_string();
+          auto key = std::make_pair(rec.get("queue").as_string(),
+                                    rec.get("message_id").as_string());
+          if (op == "publish") {
+            live[key] = std::move(rec);
+          } else if (op == "ack") {
+            live.erase(key);
+          } else if (op == "redeliver") {
+            auto it = live.find(key);
+            if (it != live.end())
+              it->second.set(
+                  "delivery_count",
+                  it->second.get("delivery_count").as_int(0) + 1);
+          }
+        } catch (const std::exception&) {
+          // torn tail write or corruption: skip the record
+        }
+      }
+      line.clear();
+    }
+    fclose(f);
+    size_t restored = 0;
+    for (auto& [key, rec] : live) {
+      auto msg = std::make_shared<Message>();
+      msg->message_id = key.second;
+      msg->body = rec.get("body");
+      msg->enc = rec.get("enc");
+      msg->headers =
+          rec.has("headers") ? rec.get("headers") : Json(j::Object{});
+      msg->delivery_count = rec.get("delivery_count").as_int(0);
+      msg->enqueued_at = now_secs();
+      engine_.declare(key.first).ready.push_back(std::move(msg));
+      ++restored;
+    }
+    fprintf(stderr, "journal replay: %zu live messages restored\n", restored);
+    compact_journal();
+  }
+
+  void compact_journal() {
+    if (persist_dir_.empty()) return;
+    if (journal_file_ != nullptr) {
+      fclose(journal_file_);
+      journal_file_ = nullptr;
+    }
+    std::string tmp = journal_path() + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (f == nullptr) return;
+    for (auto& [name, q] : engine_.queues()) {
+      auto write_msg = [&](const Message& m) {
+        Json rec{j::Object{}};
+        rec.set("op", "publish");
+        rec.set("queue", name);
+        rec.set("message_id", m.message_id);
+        rec.set("body", m.body);
+        if (!m.enc.is_null()) rec.set("enc", m.enc);
+        rec.set("headers", m.headers);
+        if (m.delivery_count > 0)
+          rec.set("delivery_count", m.delivery_count);
+        std::string line = rec.dump();
+        line += '\n';
+        fwrite(line.data(), 1, line.size(), f);
+      };
+      for (const auto& m : q.ready) write_msg(*m);
+      for (const auto& [mid, entry] : q.unacked) write_msg(*entry.first);
+    }
+    fclose(f);
+    rename(tmp.c_str(), journal_path().c_str());
+    journal_ops_ = 0;
+  }
+
+  // --- socket plumbing ---------------------------------------------------
+  static int set_nonblocking(int fd) {
+    int flags = fcntl(fd, F_GETFL, 0);
+    return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  }
+
+  void send_frame(int fd, const Json& obj) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end() || it->second.dead) return;
+    Conn& c = it->second;
+    std::string payload = obj.dump();
+    uint32_t n = htonl(static_cast<uint32_t>(payload.size()));
+    c.wbuf.append(reinterpret_cast<char*>(&n), 4);
+    c.wbuf += payload;
+    flush(c);
+  }
+
+  void flush(Conn& c) {
+    while (!c.wbuf.empty()) {
+      ssize_t n = ::send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.wbuf.erase(0, static_cast<size_t>(n));
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        update_epoll(c.fd, true);
+        return;
+      } else {
+        c.dead = true;
+        return;
+      }
+    }
+    update_epoll(c.fd, false);
+  }
+
+  void update_epoll(int fd, bool want_write) {
+    struct epoll_event ev;
+    ev.events = EPOLLIN | (want_write ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void close_conn(int fd);
+  void handle_readable(Conn& c);
+  void handle_request(Conn& c, const Json& req);
+  void reply(Conn& c, const Json& req, j::Object extra,
+             bool ok = true, const std::string& error = "");
+
+  friend class Engine;
+  Engine& engine() { return engine_; }
+};
+
+// --- Engine methods needing Server ----------------------------------------
+
+void Engine::dead_letter(Queue& q, std::shared_ptr<Message> msg) {
+  msg->headers.set("x-death-queue", q.name);
+  msg->headers.set("x-delivery-count", msg->delivery_count);
+  server_->journal_ack(q.name, msg->message_id);
+  auto copy = std::make_shared<Message>(*msg);
+  copy->delivery_count = 0;
+  copy->enqueued_at = now_secs();
+  server_->journal_publish(q.name + kFailedSuffix, *copy);
+  publish(q.name + kFailedSuffix, std::move(copy));
+}
+
+void Engine::requeue_with_penalty(Queue& q, std::shared_ptr<Message> msg) {
+  if (ends_with(q.name, kFailedSuffix)) {
+    // DLQ peeks are non-destructive forever: no penalty, no cascade.
+    q.ready.push_front(std::move(msg));
+    return;
+  }
+  msg->delivery_count += 1;
+  if (msg->delivery_count > q.max_redeliveries) {
+    dead_letter(q, std::move(msg));
+  } else {
+    server_->journal_redeliver(q.name, msg->message_id);
+    q.ready.push_front(std::move(msg));
+  }
+}
+
+void Engine::remove_consumer(const std::string& tag, bool requeue_in_flight) {
+  auto it = consumers_.find(tag);
+  std::string queue_name;
+  if (it != consumers_.end()) {
+    queue_name = it->second.queue;
+    consumers_.erase(it);
+  }
+  for (auto& [name, q] : queues_) {
+    auto& tags = q.consumer_tags;
+    tags.erase(std::remove(tags.begin(), tags.end(), tag), tags.end());
+    if (requeue_in_flight) {
+      std::vector<std::string> stale;
+      for (const auto& [mid, entry] : q.unacked)
+        if (entry.second == tag) stale.push_back(mid);
+      for (const auto& mid : stale) {
+        auto msg = q.unacked[mid].first;
+        q.unacked.erase(mid);
+        // Disconnect policy (mirrors memory.py remove_consumer): bump the
+        // delivery count unconditionally — including on ".failed" queues,
+        // where only the *cascade dead-letter* is exempted. (Explicit
+        // reject(requeue) on a DLQ stays penalty-free; see settle path.)
+        msg->delivery_count += 1;
+        if (msg->delivery_count > q.max_redeliveries &&
+            !ends_with(q.name, kFailedSuffix)) {
+          dead_letter(q, std::move(msg));
+        } else {
+          server_->journal_redeliver(q.name, msg->message_id);
+          q.ready.push_front(std::move(msg));
+        }
+      }
+    }
+  }
+  if (!queue_name.empty()) dispatch(queue_name);
+}
+
+void Engine::settle(const std::string& queue, const std::string& message_id,
+                    const std::string& verb, bool requeue) {
+  Queue* q = find(queue);
+  if (q == nullptr) return;
+  auto it = q->unacked.find(message_id);
+  if (it == q->unacked.end()) return;
+  auto msg = it->second.first;
+  std::string tag = it->second.second;
+  q->unacked.erase(it);
+  auto cit = consumers_.find(tag);
+  if (cit != consumers_.end()) {
+    cit->second.in_flight =
+        cit->second.in_flight > 0 ? cit->second.in_flight - 1 : 0;
+    if (cit->second.transient_get) consumers_.erase(cit);
+  }
+  if (verb == "ack") {
+    server_->journal_ack(queue, message_id);
+  } else if (requeue) {
+    requeue_with_penalty(*q, std::move(msg));
+  } else {
+    server_->journal_ack(queue, message_id);  // dropped for good
+  }
+  dispatch(queue);
+}
+
+std::shared_ptr<Message> Engine::get_one(const std::string& queue,
+                                         const std::string& tag, int fd) {
+  Queue* q = find(queue);
+  if (q == nullptr) return nullptr;
+  double now = now_secs();
+  while (!q->ready.empty()) {
+    auto msg = q->ready.front();
+    q->ready.pop_front();
+    if (q->expired(*msg, now)) {
+      server_->journal_ack(queue, msg->message_id);
+      continue;
+    }
+    Consumer c;
+    c.tag = tag;
+    c.queue = queue;
+    c.fd = fd;
+    c.prefetch = 1;
+    c.in_flight = 1;
+    c.transient_get = true;
+    consumers_[tag] = c;
+    q->unacked[msg->message_id] = {msg, tag};
+    return msg;
+  }
+  return nullptr;
+}
+
+void Engine::dispatch(const std::string& queue) {
+  Queue* q = find(queue);
+  if (q == nullptr) return;
+  double now = now_secs();
+  while (!q->ready.empty()) {
+    if (q->expired(*q->ready.front(), now)) {
+      server_->journal_ack(queue, q->ready.front()->message_id);
+      q->ready.pop_front();
+      continue;
+    }
+    // Round-robin over consumers with prefetch headroom.
+    Consumer* picked = nullptr;
+    size_t n = q->consumer_tags.size();
+    for (size_t i = 0; i < n; ++i) {
+      const std::string& tag = q->consumer_tags[(q->rr + i) % n];
+      auto it = consumers_.find(tag);
+      if (it == consumers_.end()) continue;
+      if (it->second.in_flight < it->second.prefetch) {
+        picked = &it->second;
+        q->rr = (q->rr + i + 1) % n;
+        break;
+      }
+    }
+    if (picked == nullptr) return;
+    auto msg = q->ready.front();
+    q->ready.pop_front();
+    picked->in_flight += 1;
+    q->unacked[msg->message_id] = {msg, picked->tag};
+    server_->deliver(*picked, *msg);
+  }
+}
+
+// --- Server implementation -------------------------------------------------
+
+void Server::reply(Conn& c, const Json& req, j::Object extra, bool ok,
+                   const std::string& error) {
+  Json r{std::move(extra)};
+  r.set("type", "reply");
+  r.set("req_id", req.get("req_id"));
+  r.set("ok", ok);
+  if (!ok) r.set("error", error);
+  send_frame(c.fd, r);
+}
+
+void Server::handle_request(Conn& c, const Json& req) {
+  const std::string op = req.get("op").as_string();
+  if (op == "ping") {
+    reply(c, req, {});
+  } else if (op == "declare") {
+    Queue& q = engine_.declare(req.get("queue").as_string());
+    if (!req.get("ttl_ms").is_null()) q.ttl_ms = req.get("ttl_ms").as_int();
+    if (!req.get("max_redeliveries").is_null())
+      q.max_redeliveries = (int)req.get("max_redeliveries").as_int();
+    reply(c, req, {});
+  } else if (op == "publish") {
+    auto msg = std::make_shared<Message>();
+    std::string mid = req.get("message_id").as_string();
+    msg->message_id = mid.empty() ? hex_id(32) : mid;
+    msg->body = req.get("body");
+    msg->enc = req.get("enc");
+    msg->headers =
+        req.has("headers") ? req.get("headers") : Json(j::Object{});
+    if (!msg->headers.is_object()) msg->headers = Json(j::Object{});
+    msg->enqueued_at = now_secs();
+    std::string queue = req.get("queue").as_string();
+    journal_publish(queue, *msg);
+    j::Object extra;
+    extra["message_id"] = Json(msg->message_id);
+    engine_.publish(queue, std::move(msg));
+    reply(c, req, std::move(extra));
+  } else if (op == "consume") {
+    Consumer consumer;
+    consumer.tag = "tcp-" + hex_id(12);
+    consumer.queue = req.get("queue").as_string();
+    consumer.fd = c.fd;
+    consumer.prefetch =
+        std::max<int64_t>(1, req.get("prefetch").as_int(1));
+    c.tags.push_back(consumer.tag);
+    j::Object extra;
+    extra["tag"] = Json(consumer.tag);
+    // Reply BEFORE dispatch so the client sees the consume confirmation
+    // ahead of the first delivery (the Python client buffers early
+    // deliveries anyway, but ordering keeps traces readable).
+    reply(c, req, std::move(extra));
+    std::string qname = consumer.queue;  // read before the move below
+    engine_.add_consumer(qname, std::move(consumer));
+  } else if (op == "cancel") {
+    std::string tag = req.get("tag").as_string();
+    engine_.remove_consumer(tag, /*requeue_in_flight=*/true);
+    c.tags.erase(std::remove(c.tags.begin(), c.tags.end(), tag),
+                 c.tags.end());
+    reply(c, req, {});
+  } else if (op == "settle") {
+    std::string tag = req.get("tag").as_string();
+    std::string mid = req.get("message_id").as_string();
+    // Find the queue owning this unacked message under this tag.
+    std::string queue;
+    for (auto& [name, q] : engine_.queues()) {
+      auto it = q.unacked.find(mid);
+      if (it != q.unacked.end() && it->second.second == tag) {
+        queue = name;
+        break;
+      }
+    }
+    if (tag.rfind("get-", 0) == 0)
+      c.tags.erase(std::remove(c.tags.begin(), c.tags.end(), tag),
+                   c.tags.end());
+    if (!queue.empty())
+      engine_.settle(queue, mid, req.get("verb").as_string(),
+                     req.get("requeue").as_bool(false));
+    reply(c, req, {});
+  } else if (op == "get") {
+    std::string tag = "get-" + hex_id(12);
+    auto msg = engine_.get_one(req.get("queue").as_string(), tag, c.fd);
+    if (msg == nullptr) {
+      j::Object extra;
+      extra["empty"] = Json(true);
+      reply(c, req, std::move(extra));
+    } else {
+      c.tags.push_back(tag);
+      j::Object extra;
+      extra["empty"] = Json(false);
+      extra["tag"] = Json(tag);
+      extra["message_id"] = Json(msg->message_id);
+      extra["body"] = msg->body;
+      if (!msg->enc.is_null()) extra["enc"] = msg->enc;
+      extra["delivery_count"] = Json(msg->delivery_count);
+      extra["headers"] = msg->headers;
+      reply(c, req, std::move(extra));
+    }
+  } else if (op == "stats") {
+    std::string name = req.get("queue").as_string();
+    Queue* q = engine_.find(name);
+    j::Object stats;
+    stats["queue_name"] = Json(name);
+    if (q == nullptr) {
+      stats["stats_source"] = Json("unavailable");
+    } else {
+      size_t ready_b = 0, unacked_b = 0;
+      for (const auto& m : q->ready) ready_b += m->bytes();
+      for (const auto& [mid, e] : q->unacked) unacked_b += e.first->bytes();
+      size_t consumer_count = 0;
+      for (const auto& tag : q->consumer_tags)
+        if (engine_.consumers().count(tag)) ++consumer_count;
+      stats["message_count"] = Json(q->ready.size() + q->unacked.size());
+      stats["message_count_ready"] = Json(q->ready.size());
+      stats["message_count_unacknowledged"] = Json(q->unacked.size());
+      stats["consumer_count"] = Json(consumer_count);
+      stats["message_bytes"] = Json(ready_b + unacked_b);
+      stats["message_bytes_ready"] = Json(ready_b);
+      stats["message_bytes_unacknowledged"] = Json(unacked_b);
+      stats["stats_source"] = Json("broker_core");
+    }
+    j::Object extra;
+    extra["stats"] = Json(std::move(stats));
+    reply(c, req, std::move(extra));
+  } else if (op == "purge") {
+    Queue* q = engine_.find(req.get("queue").as_string());
+    size_t purged = 0;
+    if (q != nullptr) {
+      purged = q->ready.size();
+      for (const auto& m : q->ready) journal_ack(q->name, m->message_id);
+      q->ready.clear();
+    }
+    j::Object extra;
+    extra["purged"] = Json(purged);
+    reply(c, req, std::move(extra));
+  } else {
+    reply(c, req, {}, false, "bad op '" + op + "'");
+  }
+}
+
+void Server::handle_readable(Conn& c) {
+  char buf[1 << 16];
+  while (true) {
+    ssize_t n = ::recv(c.fd, buf, sizeof(buf), 0);
+    if (n > 0) {
+      c.rbuf.append(buf, static_cast<size_t>(n));
+    } else if (n == 0) {
+      c.dead = true;
+      break;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else {
+      c.dead = true;
+      break;
+    }
+  }
+  // Parse complete frames.
+  while (c.rbuf.size() >= 4) {
+    uint32_t size;
+    memcpy(&size, c.rbuf.data(), 4);
+    size = ntohl(size);
+    if (size > kMaxFrame) {
+      fprintf(stderr, "dropping connection fd=%d: frame too large (%u)\n",
+              c.fd, size);
+      c.dead = true;
+      return;
+    }
+    if (c.rbuf.size() < 4 + (size_t)size) break;
+    std::string payload = c.rbuf.substr(4, size);
+    c.rbuf.erase(0, 4 + (size_t)size);
+    try {
+      Json req = Json::parse(payload);
+      handle_request(c, req);
+    } catch (const std::exception& exc) {
+      // Not our protocol (or corrupt frame): drop the connection, keep
+      // serving everyone else — mirrors the Python daemon's policy.
+      fprintf(stderr, "dropping connection fd=%d on bad frame: %s\n", c.fd,
+              exc.what());
+      c.dead = true;
+      return;
+    }
+    if (c.dead) return;
+  }
+}
+
+void Server::close_conn(int fd) {
+  auto it = conns_.find(fd);
+  if (it == conns_.end()) return;
+  // A dropped connection requeues its unacked messages (at-least-once).
+  for (const auto& tag : it->second.tags)
+    engine_.remove_consumer(tag, /*requeue_in_flight=*/true);
+  epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+  ::close(fd);
+  conns_.erase(it);
+}
+
+static volatile sig_atomic_t g_stop = 0;
+static void on_signal(int) { g_stop = 1; }
+
+int Server::run() {
+  signal(SIGPIPE, SIG_IGN);
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+  load_journal();
+
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) {
+    perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port_));
+  if (host_ == "0.0.0.0" || host_.empty()) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad host %s\n", host_.c_str());
+    return 1;
+  }
+  if (bind(listen_fd_, (struct sockaddr*)&addr, sizeof(addr)) < 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listen_fd_, 128) < 0) {
+    perror("listen");
+    return 1;
+  }
+  set_nonblocking(listen_fd_);
+
+  epfd_ = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.fd = listen_fd_;
+  epoll_ctl(epfd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+
+  fprintf(stderr, "llmq-tpu-brokerd listening on %s:%d%s\n", host_.c_str(),
+          port_, persist_dir_.empty() ? "" : (" (journal: " +
+          persist_dir_ + "/journal.jsonl)").c_str());
+
+  std::vector<struct epoll_event> events(256);
+  while (!g_stop) {
+    int n = epoll_wait(epfd_, events.data(), (int)events.size(), 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("epoll_wait");
+      break;
+    }
+    std::vector<int> to_close;
+    for (int i = 0; i < n; ++i) {
+      int fd = events[i].data.fd;
+      if (fd == listen_fd_) {
+        while (true) {
+          int cfd = accept(listen_fd_, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblocking(cfd);
+          int nd = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+          Conn conn;
+          conn.fd = cfd;
+          conns_[cfd] = std::move(conn);
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          epoll_ctl(epfd_, EPOLL_CTL_ADD, cfd, &cev);
+        }
+        continue;
+      }
+      auto it = conns_.find(fd);
+      if (it == conns_.end()) continue;
+      Conn& c = it->second;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) c.dead = true;
+      if (!c.dead && (events[i].events & EPOLLOUT)) flush(c);
+      if (!c.dead && (events[i].events & EPOLLIN)) handle_readable(c);
+      if (c.dead) to_close.push_back(fd);
+    }
+    for (int fd : to_close) close_conn(fd);
+  }
+  fprintf(stderr, "llmq-tpu-brokerd shutting down\n");
+  if (journal_file_ != nullptr) fclose(journal_file_);
+  for (auto& [fd, c] : conns_) ::close(fd);
+  ::close(listen_fd_);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+
+static void usage(const char* argv0) {
+  fprintf(stderr,
+          "usage: %s [--host H] [--port P] [--persist-dir DIR]\n"
+          "llmq-tpu native broker daemon (wire-compatible with\n"
+          "`python -m llmq_tpu broker serve`).\n",
+          argv0);
+}
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 5672;
+  std::string persist;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        usage(argv[0]);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--host") {
+      host = next();
+    } else if (arg == "--port") {
+      port = atoi(next());
+    } else if (arg == "--persist-dir") {
+      persist = next();
+    } else if (arg == "-h" || arg == "--help") {
+      usage(argv[0]);
+      return 0;
+    } else {
+      usage(argv[0]);
+      return 2;
+    }
+  }
+  Server server(host, port, persist);
+  return server.run();
+}

@@ -1,0 +1,428 @@
+// Minimal JSON DOM for the llmq-tpu broker daemon.
+//
+// Parses UTF-8 JSON into a small value type and serializes it back with
+// compact separators (the framing the Python TcpBroker client emits via
+// json.dumps(separators=(",", ":")) — llmq_tpu/broker/tcp.py). Message
+// bodies and headers are carried through this DOM opaquely: the daemon
+// never needs to understand Job/Result payloads, only the control fields.
+//
+// Scope decisions (deliberate):
+//  - numbers are stored as int64 when the literal is integral, else double;
+//  - \uXXXX escapes decode to UTF-8 (incl. surrogate pairs);
+//  - output is raw UTF-8 (Python's json.loads accepts it);
+//  - no comments/trailing-comma extensions; parse errors throw.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace j {
+
+class Json;
+using Object = std::map<std::string, Json>;
+using Array = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), b_(b) {}
+  Json(int v) : type_(Type::Int), i_(v) {}
+  Json(int64_t v) : type_(Type::Int), i_(v) {}
+  Json(uint64_t v) : type_(Type::Int), i_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), d_(v) {}
+  Json(const char* s) : type_(Type::String), s_(s) {}
+  Json(std::string s) : type_(Type::String), s_(std::move(s)) {}
+  Json(Array a) : type_(Type::Array), a_(std::move(a)) {}
+  Json(Object o) : type_(Type::Object), o_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_string() const { return type_ == Type::String; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? b_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type_ == Type::Int) return i_;
+    if (type_ == Type::Double) return static_cast<int64_t>(d_);
+    return dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? s_ : empty;
+  }
+  const Object& as_object() const {
+    static const Object empty;
+    return type_ == Type::Object ? o_ : empty;
+  }
+  Object& obj() {
+    if (type_ != Type::Object) throw std::runtime_error("not an object");
+    return o_;
+  }
+
+  // Lookup that tolerates missing keys / non-objects (returns Null).
+  const Json& get(const std::string& key) const {
+    static const Json null_value;
+    if (type_ != Type::Object) return null_value;
+    auto it = o_.find(key);
+    return it == o_.end() ? null_value : it->second;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && o_.count(key) > 0;
+  }
+
+  void set(const std::string& key, Json v) {
+    if (type_ != Type::Object) {
+      type_ = Type::Object;
+      o_.clear();
+    }
+    o_[key] = std::move(v);
+  }
+
+  std::string dump() const {
+    std::string out;
+    out.reserve(64);
+    write(out);
+    return out;
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  Type type_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double d_ = 0.0;
+  std::string s_;
+  Array a_;
+  Object o_;
+
+  void write(std::string& out) const {
+    switch (type_) {
+      case Type::Null:
+        out += "null";
+        break;
+      case Type::Bool:
+        out += b_ ? "true" : "false";
+        break;
+      case Type::Int:
+        out += std::to_string(i_);
+        break;
+      case Type::Double: {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%.17g", d_);
+        out += buf;
+        break;
+      }
+      case Type::String:
+        write_string(out, s_);
+        break;
+      case Type::Array: {
+        out += '[';
+        bool first = true;
+        for (const auto& v : a_) {
+          if (!first) out += ',';
+          first = false;
+          v.write(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& [k, v] : o_) {
+          if (!first) out += ',';
+          first = false;
+          write_string(out, k);
+          out += ':';
+          v.write(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::string& out, const std::string& s) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"':
+          out += "\\\"";
+          break;
+        case '\\':
+          out += "\\\\";
+          break;
+        case '\b':
+          out += "\\b";
+          break;
+        case '\f':
+          out += "\\f";
+          break;
+        case '\n':
+          out += "\\n";
+          break;
+        case '\r':
+          out += "\\r";
+          break;
+        case '\t':
+          out += "\\t";
+          break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() &&
+           (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' || t[p] == '\r'))
+      ++p;
+  }
+
+  [[noreturn]] static void fail(const char* what, size_t p) {
+    throw std::runtime_error(std::string("JSON parse error: ") + what +
+                             " at offset " + std::to_string(p));
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) fail("unexpected end", p);
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (c == 't') {
+      expect(t, p, "true");
+      return Json(true);
+    }
+    if (c == 'f') {
+      expect(t, p, "false");
+      return Json(false);
+    }
+    if (c == 'n') {
+      expect(t, p, "null");
+      return Json();
+    }
+    return parse_number(t, p);
+  }
+
+  static void expect(const std::string& t, size_t& p, const char* word) {
+    size_t n = strlen(word);
+    if (t.compare(p, n, word) != 0) fail("bad literal", p);
+    p += n;
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    ++p;  // '{'
+    Object o;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') {
+      ++p;
+      return Json(std::move(o));
+    }
+    while (true) {
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != '"') fail("expected key", p);
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':') fail("expected ':'", p);
+      ++p;
+      o[std::move(key)] = parse_value(t, p);
+      skip_ws(t, p);
+      if (p >= t.size()) fail("unterminated object", p);
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == '}') {
+        ++p;
+        return Json(std::move(o));
+      }
+      fail("expected ',' or '}'", p);
+    }
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    ++p;  // '['
+    Array a;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') {
+      ++p;
+      return Json(std::move(a));
+    }
+    while (true) {
+      a.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) fail("unterminated array", p);
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == ']') {
+        ++p;
+        return Json(std::move(a));
+      }
+      fail("expected ',' or ']'", p);
+    }
+  }
+
+  static void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  static uint32_t parse_hex4(const std::string& t, size_t& p) {
+    if (p + 4 > t.size()) fail("bad \\u escape", p);
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = t[p + i];
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= c - '0';
+      else if (c >= 'a' && c <= 'f')
+        v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F')
+        v |= c - 'A' + 10;
+      else
+        fail("bad hex digit", p + i);
+    }
+    p += 4;
+    return v;
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    ++p;  // opening quote
+    std::string out;
+    while (true) {
+      if (p >= t.size()) fail("unterminated string", p);
+      char c = t[p];
+      if (c == '"') {
+        ++p;
+        return out;
+      }
+      if (c == '\\') {
+        ++p;
+        if (p >= t.size()) fail("bad escape", p);
+        char e = t[p++];
+        switch (e) {
+          case '"':
+            out += '"';
+            break;
+          case '\\':
+            out += '\\';
+            break;
+          case '/':
+            out += '/';
+            break;
+          case 'b':
+            out += '\b';
+            break;
+          case 'f':
+            out += '\f';
+            break;
+          case 'n':
+            out += '\n';
+            break;
+          case 'r':
+            out += '\r';
+            break;
+          case 't':
+            out += '\t';
+            break;
+          case 'u': {
+            uint32_t cp = parse_hex4(t, p);
+            if (cp >= 0xD800 && cp <= 0xDBFF && p + 1 < t.size() &&
+                t[p] == '\\' && t[p + 1] == 'u') {
+              size_t save = p;
+              p += 2;
+              uint32_t lo = parse_hex4(t, p);
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                p = save;  // lone high surrogate; emit replacement
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xD800 && cp <= 0xDFFF) {
+              cp = 0xFFFD;
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default:
+            fail("bad escape char", p - 1);
+        }
+      } else {
+        out += c;
+        ++p;
+      }
+    }
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && t[p] == '-') ++p;
+    while (p < t.size() && isdigit(static_cast<unsigned char>(t[p]))) ++p;
+    bool integral = true;
+    if (p < t.size() && t[p] == '.') {
+      integral = false;
+      ++p;
+      while (p < t.size() && isdigit(static_cast<unsigned char>(t[p]))) ++p;
+    }
+    if (p < t.size() && (t[p] == 'e' || t[p] == 'E')) {
+      integral = false;
+      ++p;
+      if (p < t.size() && (t[p] == '+' || t[p] == '-')) ++p;
+      while (p < t.size() && isdigit(static_cast<unsigned char>(t[p]))) ++p;
+    }
+    if (p == start || (p == start + 1 && t[start] == '-'))
+      fail("bad number", start);
+    std::string lit = t.substr(start, p - start);
+    if (integral) {
+      try {
+        return Json(static_cast<int64_t>(std::stoll(lit)));
+      } catch (...) {
+        // fall through to double on overflow
+      }
+    }
+    return Json(std::stod(lit));
+  }
+};
+
+}  // namespace j

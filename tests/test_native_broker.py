@@ -1,0 +1,201 @@
+"""The C++ broker daemon (native/broker) against the broker contract.
+
+Builds the binary on demand (make -C native) and runs the same semantics
+matrix the Python brokers pass (tests/test_broker.py::BrokerContract),
+plus daemon-specific probes: journal durability across restarts, journal
+interchange with the Python daemon (shared file format), client-crash
+redelivery, and garbage-on-the-wire robustness.
+"""
+
+import asyncio
+import socket
+import subprocess
+import time
+
+import pytest
+
+from llmq_tpu.broker.base import connect_broker, make_broker
+from llmq_tpu.broker.native import ensure_brokerd
+from test_broker import BrokerContract, _wait_for
+
+pytestmark = pytest.mark.unit
+
+BINARY = ensure_brokerd()
+
+if BINARY is None:  # pragma: no cover — g++/make missing
+    pytest.skip("native brokerd unavailable", allow_module_level=True)
+
+_PROCS = []
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(port: int, persist_dir=None) -> subprocess.Popen:
+    argv = [str(BINARY), "--host", "127.0.0.1", "--port", str(port)]
+    if persist_dir is not None:
+        argv += ["--persist-dir", str(persist_dir)]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+    )
+    _PROCS.append(proc)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError("brokerd exited at startup")
+            time.sleep(0.02)
+    raise RuntimeError("brokerd did not come up")
+
+
+def _stop(proc: subprocess.Popen) -> None:
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_procs():
+    yield
+    while _PROCS:
+        _stop(_PROCS.pop())
+
+
+class TestNativeBrokerContract(BrokerContract):
+    async def make(self, tmp_path, mem_url):
+        port = _free_port()
+        _spawn(port)
+        broker = make_broker(f"tcp://127.0.0.1:{port}")
+        await broker.connect()
+        return broker
+
+
+class TestNativeDaemon:
+    async def test_journal_durability_across_restart(self, tmp_path):
+        persist = tmp_path / "j"
+        port = _free_port()
+        proc = _spawn(port, persist)
+        broker = await connect_broker(f"tcp://127.0.0.1:{port}")
+        await broker.publish("q", b"survives")
+        await broker.publish("q", b"acked")
+        msg = await broker.get("q")
+        assert msg.body == b"survives"  # FIFO
+        await msg.reject(requeue=True)  # back to front, +1 delivery
+        msg = await broker.get("q")
+        await msg.ack()
+        await broker.close()
+        _stop(proc)
+
+        port2 = _free_port()
+        _spawn(port2, persist)
+        b2 = await connect_broker(f"tcp://127.0.0.1:{port2}")
+        msg = await b2.get("q")
+        assert msg is not None and msg.body == b"acked"
+        assert msg.delivery_count == 0
+        await msg.ack()
+        assert await b2.get("q") is None
+        await b2.close()
+
+    async def test_journal_interchange_with_python_daemon(self, tmp_path):
+        """A journal written by the native daemon replays in the Python
+        daemon and vice versa (shared on-disk format)."""
+        from llmq_tpu.broker.tcp import BrokerServer
+
+        persist = tmp_path / "shared"
+        # native writes...
+        port = _free_port()
+        proc = _spawn(port, persist)
+        broker = await connect_broker(f"tcp://127.0.0.1:{port}")
+        await broker.publish("q", b"from-native", headers={"k": "v"})
+        await broker.close()
+        _stop(proc)
+        # ...python replays and appends...
+        server = BrokerServer("127.0.0.1", 0, persist_dir=persist)
+        await server.start()
+        pport = server._server.sockets[0].getsockname()[1]
+        pb = await connect_broker(f"tcp://127.0.0.1:{pport}")
+        msg = await pb.get("q")
+        assert msg is not None and msg.body == b"from-native"
+        assert msg.headers == {"k": "v"}
+        await msg.reject(requeue=True)
+        await pb.publish("q", b"from-python")
+        await pb.close()
+        await server.stop()
+        # ...native replays the python-written state.
+        port3 = _free_port()
+        _spawn(port3, persist)
+        nb = await connect_broker(f"tcp://127.0.0.1:{port3}")
+        bodies = set()
+        for _ in range(2):
+            msg = await nb.get("q")
+            assert msg is not None
+            bodies.add(msg.body)
+            await msg.ack()
+        assert bodies == {b"from-native", b"from-python"}
+        await nb.close()
+
+    async def test_client_crash_redelivers_to_next_consumer(self, tmp_path):
+        port = _free_port()
+        _spawn(port)
+        url = f"tcp://127.0.0.1:{port}"
+        b1 = await connect_broker(url)
+        held = asyncio.Event()
+
+        async def stuck(msg):
+            held.set()  # never settles — simulates a crashed worker
+
+        await b1.consume("q", stuck, prefetch=1)
+        await b1.publish("q", b"job")
+        await asyncio.wait_for(held.wait(), 5)
+        await b1.close()  # drop the connection with the job unacked
+
+        b2 = await connect_broker(url)
+        got = []
+
+        async def handler(msg):
+            got.append((msg.body, msg.delivery_count))
+            await msg.ack()
+
+        await b2.consume("q", handler, prefetch=1)
+        assert await _wait_for(lambda: len(got) == 1)
+        assert got[0][0] == b"job"
+        assert got[0][1] == 1  # redelivery counted
+        await b2.close()
+
+    async def test_garbage_bytes_do_not_kill_daemon(self, tmp_path):
+        port = _free_port()
+        _spawn(port)
+        # Firehose garbage at the port: daemon must drop that connection
+        # and keep serving real clients.
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(b"\x00\x00\x00\x08notjson!")
+            s.sendall(b"\xff" * 64)
+        broker = await connect_broker(f"tcp://127.0.0.1:{port}")
+        await broker.publish("q", b"still-alive")
+        msg = await broker.get("q")
+        assert msg is not None and msg.body == b"still-alive"
+        await msg.ack()
+        await broker.close()
+
+    async def test_binary_body_roundtrip(self, tmp_path):
+        """Non-UTF-8 bodies ride base64 through the native daemon."""
+        port = _free_port()
+        _spawn(port)
+        broker = await connect_broker(f"tcp://127.0.0.1:{port}")
+        blob = bytes(range(256))
+        await broker.publish("q", blob)
+        stats = await broker.stats("q")
+        assert stats.message_bytes == len(blob)  # decoded length, not b64
+        msg = await broker.get("q")
+        assert msg.body == blob
+        await msg.ack()
+        await broker.close()
