@@ -28,7 +28,8 @@ back to CPU, and any late failure still emits the JSON line with an
 
 Env knobs: LLMQ_BENCH_PRESET, LLMQ_BENCH_REQUESTS, LLMQ_BENCH_PROMPT,
 LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS, LLMQ_BENCH_INIT_RETRIES (default 2),
-LLMQ_BENCH_INIT_TIMEOUT (seconds per backend probe, default 120).
+LLMQ_BENCH_INIT_TIMEOUT (seconds per backend probe, default 120),
+LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 1800).
 """
 
 from __future__ import annotations
@@ -134,8 +135,10 @@ def init_devices():
         os.environ.get("JAX_PLATFORMS", "") == "cpu"
         or jax.config.jax_platforms == "cpu"
     ):
+        from llmq_tpu.utils.platform import force_cpu_platform
+
         try:
-            jax.config.update("jax_platforms", "cpu")
+            force_cpu_platform()
             return jax, jax.devices(), None
         except Exception as exc:  # noqa: BLE001
             return None, [], f"cpu backend failed: {exc}"
@@ -166,8 +169,10 @@ def init_devices():
         if attempt + 1 < retries:
             time.sleep(min(2.0 * 2**attempt, 10.0))
     # Accelerator unusable: fall back to host CPU.
+    from llmq_tpu.utils.platform import force_cpu_platform
+
     try:
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_platform()
         devices = jax.devices()
         return jax, devices, f"fell back to cpu: {last_err}"
     except Exception as exc:  # noqa: BLE001
@@ -313,6 +318,13 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # Whole-run watchdog: a tunnel can also wedge *after* init (first jit
+    # compile / dispatch blocks in C). If the run exceeds the deadline,
+    # the failure JSON still gets emitted before exiting.
+    _cancel = _arm_emit_watchdog(
+        float(os.environ.get("LLMQ_BENCH_DEADLINE", 1800)),
+        "benchmark exceeded LLMQ_BENCH_DEADLINE (device dispatch hung?)",
+    )
     try:
         main()
     except Exception as exc:  # noqa: BLE001 — the JSON line must print
@@ -320,3 +332,5 @@ if __name__ == "__main__":
 
         traceback.print_exc()
         _emit_failure("failed", f"{type(exc).__name__}: {exc}")
+    finally:
+        _cancel()

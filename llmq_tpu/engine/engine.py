@@ -98,7 +98,11 @@ class EngineConfig:
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
     runahead: int = 8  # decode steps dispatched ahead of result reads
-    stop_id_capacity: int = 8  # per-slot device-side stop-token ids
+    # Per-slot device-side stop-token-id capacity. Grows automatically
+    # (drain + resync + jit retrace at the wider shape) when a request's
+    # stop set exceeds it, so min_tokens suppression always covers the
+    # full set — no silent truncation.
+    stop_id_capacity: int = 8
 
 
 def _prefill_buckets(cfg: EngineConfig, sp: int = 1) -> List[int]:
@@ -192,7 +196,8 @@ class EngineCore:
         # Host-side mirrors of the device decode state, rebuilt wholesale
         # at every resync (resyncs are rare; steady-state decode ships
         # nothing host→device).
-        E = self.cfg.stop_id_capacity
+        self._stop_capacity = self.cfg.stop_id_capacity
+        E = self._stop_capacity
         key_shape = np.asarray(make_base_key(0, 0)).shape
         self._h_tokens = np.zeros((S,), np.int32)
         self._h_ctx = np.zeros((S,), np.int32)
@@ -215,9 +220,6 @@ class EngineCore:
         self._dirty = True
         self._mode = "greedy"
         self._dev_state: Optional[tuple] = None
-        # Requests whose stop-token set overflows the device capacity:
-        # their token-based stops are detected host-side (with a resync).
-        self._host_stop_fallback: set = set()
 
         # Counters for stats/heartbeats.
         self.total_prompt_tokens = 0
@@ -413,6 +415,12 @@ class EngineCore:
         # Own copy: the scheduler caps max_tokens in place and a caller may
         # share one SamplingParams across requests.
         params = dataclasses.replace(params) if params else SamplingParams()
+        need = len(
+            set(params.stop_token_ids)
+            | (set() if params.ignore_eos else self._eos_ids)
+        )
+        if need > self._stop_capacity:
+            self._grow_stop_capacity(need)
         seq = Sequence(
             rid=rid,
             prompt_ids=list(prompt_ids),
@@ -534,17 +542,29 @@ class EngineCore:
         )
         self._dirty = False
 
+    def _grow_stop_capacity(self, need: int) -> None:
+        """Widen the per-slot stop-id arrays to the next power of two
+        >= ``need``. The device decode-state shape changes, so the state
+        is marked dirty (next dispatch drains in-flight steps and resyncs
+        at the new shape; jit retraces once). Grow-only — a rare wide
+        request costs one recompile, never a truncated stop set. The live
+        capacity is engine state (``_stop_capacity``), not a mutation of
+        the caller's EngineConfig (which may be shared across cores)."""
+        E = 1 << max(need - 1, 1).bit_length()
+        self._stop_capacity = E
+        S = self.cfg.max_num_seqs
+        self._h_stopids = np.full((S, E), -1, np.int32)
+        self._dirty = True
+
     def _stop_ids_for(self, seq: Sequence) -> np.ndarray:
-        """Per-slot device stop-token ids ([-1]-padded). Overflowing sets
-        degrade to host-side detection for the excess ids."""
-        E = self.cfg.stop_id_capacity
-        ids = list(seq.params.stop_token_ids)
+        """Per-slot device stop-token ids ([-1]-padded). Capacity has
+        already been grown by ``add_request``, so the set always fits."""
+        E = self._stop_capacity
+        ids = list(dict.fromkeys(seq.params.stop_token_ids))
         if not seq.params.ignore_eos:
-            ids.extend(self._eos_ids)
+            ids.extend(i for i in self._eos_ids if i not in ids)
+        assert len(ids) <= E, f"stop set {len(ids)} > capacity {E}"
         row = np.full((E,), -1, np.int32)
-        if len(ids) > E:
-            self._host_stop_fallback.add(seq.rid)
-            ids = ids[:E]
         row[: len(ids)] = ids
         return row
 
@@ -572,7 +592,7 @@ class EngineCore:
         # Pad to {1, max_prefill_batch} rows so at most two executables
         # exist per bucket.
         B = 1 if len(chunk) == 1 else self.cfg.max_prefill_batch
-        E = self.cfg.stop_id_capacity
+        E = self._stop_capacity
         key_shape = self._h_keys.shape[1:]
         tokens = np.zeros((B, bucket), np.int32)
         lengths = np.zeros((B,), np.int32)
@@ -734,11 +754,9 @@ class EngineCore:
         reason = self._stop_reason(seq, token)
         if reason is not None:
             # The device detects token-based stops and length caps itself
-            # (advance_state); only host-exclusive finishes force a resync.
-            device_detected = (
-                seq.finish_text is None
-                and seq.rid not in self._host_stop_fallback
-            )
+            # (advance_state); only host-exclusive finishes (stop strings)
+            # force a resync.
+            device_detected = seq.finish_text is None
             self._finish_seq(seq, reason, device_detected=device_detected,
                              finished=finished)
 
@@ -755,7 +773,6 @@ class EngineCore:
             self._deferred_pages.append((self._dispatch_idx, pages))
         if not device_detected:
             self._dirty = True
-        self._host_stop_fallback.discard(seq.rid)
         finished.append(self._output_for(seq))
 
     def _stop_reason(self, seq: Sequence, token: int) -> Optional[str]:

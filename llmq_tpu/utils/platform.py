@@ -1,0 +1,30 @@
+"""Host-platform pinning for JAX.
+
+This image's accelerator plugin ("axon") registers via a sitecustomize
+that pins ``jax_platforms`` at the *config* level at interpreter startup,
+which outranks the ``JAX_PLATFORMS`` env var. Code that must run on host
+CPU (tests, CI, virtual-device dryruns, fallbacks) therefore has to reset
+the config too — before any ``jax.devices()`` call initialises backends,
+or the first backend touch can hang on the accelerator tunnel.
+
+One shared helper so the workaround lives in exactly one place
+(tests/conftest.py, __graft_entry__.py, bench.py all use it).
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_platform() -> bool:
+    """Pin JAX to the host CPU platform at the config level.
+
+    Returns True on success; False if the config could not be updated
+    (backends already initialised) — callers should surface that, since
+    subsequent jax calls may then hit the accelerator anyway.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:  # noqa: BLE001 — backends already initialised
+        return False

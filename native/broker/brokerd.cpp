@@ -289,7 +289,15 @@ class Server {
     FILE* f = fopen(journal_path().c_str(), "r");
     if (f == nullptr) return;
     // (queue, message_id) -> publish record; ack removes, redeliver bumps.
-    std::map<std::pair<std::string, std::string>, Json> live;
+    // Live records keep *publish order* (insertion-ordered slots vector +
+    // key index), matching the Python daemon's dict semantics
+    // (tcp.py _load_journal) so per-queue FIFO survives a restart under
+    // either implementation. A re-publish of a live key overwrites in
+    // place (keeps its original position, like a dict update); an acked
+    // slot is tombstoned and a later re-publish appends fresh.
+    using Key = std::pair<std::string, std::string>;
+    std::vector<std::pair<Key, Json>> slots;
+    std::map<Key, size_t> index;  // live keys only
     std::string line;
     char buf[1 << 16];
     while (fgets(buf, sizeof(buf), f) != nullptr) {
@@ -304,15 +312,26 @@ class Server {
           auto key = std::make_pair(rec.get("queue").as_string(),
                                     rec.get("message_id").as_string());
           if (op == "publish") {
-            live[key] = std::move(rec);
+            auto it = index.find(key);
+            if (it != index.end()) {
+              slots[it->second].second = std::move(rec);
+            } else {
+              index[key] = slots.size();
+              slots.emplace_back(key, std::move(rec));
+            }
           } else if (op == "ack") {
-            live.erase(key);
+            auto it = index.find(key);
+            if (it != index.end()) {
+              slots[it->second].second = Json();  // tombstone
+              index.erase(it);
+            }
           } else if (op == "redeliver") {
-            auto it = live.find(key);
-            if (it != live.end())
-              it->second.set(
-                  "delivery_count",
-                  it->second.get("delivery_count").as_int(0) + 1);
+            auto it = index.find(key);
+            if (it != index.end()) {
+              Json& live = slots[it->second].second;
+              live.set("delivery_count",
+                       live.get("delivery_count").as_int(0) + 1);
+            }
           }
         } catch (const std::exception&) {
           // torn tail write or corruption: skip the record
@@ -322,7 +341,8 @@ class Server {
     }
     fclose(f);
     size_t restored = 0;
-    for (auto& [key, rec] : live) {
+    for (auto& [key, rec] : slots) {
+      if (rec.is_null()) continue;  // acked tombstone
       auto msg = std::make_shared<Message>();
       msg->message_id = key.second;
       msg->body = rec.get("body");

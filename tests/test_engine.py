@@ -309,3 +309,33 @@ class TestReviewRegressions:
         core.abort_all("error")
         out = run_sync(core, [("b", "still alive?", greedy(4))])["b"]
         assert out.completion_tokens == 4
+
+    def test_stop_capacity_grows_past_default(self):
+        """A stop set wider than stop_id_capacity must widen the device
+        arrays (drain + retrace), not silently truncate — every id stays
+        suppressed under min_tokens (ADVICE.md round 1, engine.py:547)."""
+        core = make_core()
+        assert core._stop_capacity == 8
+        probe = run_sync(core, [("p", "hi", greedy(8))])["p"]
+        # 12 distinct stop ids, including ones the model actually emits.
+        stops = tuple(dict.fromkeys(
+            list(probe.token_ids) + list(range(1, 13))
+        ))[:12]
+        out = run_sync(
+            core,
+            [("r", "hi", greedy(8, stop_token_ids=stops, min_tokens=5))],
+        )["r"]
+        assert core._stop_capacity >= 12
+        assert core.cfg.stop_id_capacity == 8  # shared config not mutated
+        assert out.completion_tokens >= 5
+        for tok in out.token_ids[:5]:
+            assert tok not in stops  # all 12 suppressed, not just 8
+        # Continuous batching still works after the grow (mixed widths).
+        outs = run_sync(
+            core,
+            [
+                ("a", "one", greedy(6)),
+                ("b", "two", greedy(6, stop_token_ids=stops, min_tokens=3)),
+            ],
+        )
+        assert outs["a"].completion_tokens == 6

@@ -143,6 +143,33 @@ class TestNativeDaemon:
         assert bodies == {b"from-native", b"from-python"}
         await nb.close()
 
+    async def test_journal_replay_preserves_fifo_order(self, tmp_path):
+        """Replay must restore messages in publish order, not journal-map
+        order — message ids are random hex, so with 12 messages a
+        lexicographic-id replay is essentially guaranteed to scramble the
+        queue (the bug ADVICE.md round 1 flagged)."""
+        persist = tmp_path / "ordered"
+        bodies = [f"m{i:02d}".encode() for i in range(12)]
+        port = _free_port()
+        proc = _spawn(port, persist)
+        broker = await connect_broker(f"tcp://127.0.0.1:{port}")
+        for body in bodies:
+            await broker.publish("q", body)
+        await broker.close()
+        _stop(proc)
+
+        port2 = _free_port()
+        _spawn(port2, persist)
+        nb = await connect_broker(f"tcp://127.0.0.1:{port2}")
+        got = []
+        for _ in bodies:
+            msg = await nb.get("q")
+            assert msg is not None
+            got.append(msg.body)
+            await msg.ack()
+        assert got == bodies  # exact FIFO across restart
+        await nb.close()
+
     async def test_client_crash_redelivers_to_next_consumer(self, tmp_path):
         port = _free_port()
         _spawn(port)
