@@ -1,9 +1,26 @@
 """RabbitMQ passthrough broker (optional).
 
-Kept for drop-in compatibility with reference deployments that already run a
-RabbitMQ (llmq/core/broker.py speaks AMQP via aio-pika). This module is only
-importable when ``aio_pika`` is installed; the rest of llmq-tpu never
-imports it unconditionally.
+Kept for drop-in compatibility with reference deployments that already run
+a RabbitMQ (llmq/core/broker.py speaks AMQP via aio-pika). Importable only
+when ``aio_pika`` is installed; nothing else in llmq-tpu imports it
+unconditionally.
+
+Semantics mapping — the llmq-tpu broker contract is implemented with
+RabbitMQ-native features so the dead-letter policy actually holds over
+AMQP (round-1 review: a client-side ``1 if redelivered else 0`` count
+could never reach the cap):
+
+- Queues are declared as **quorum queues** with ``x-delivery-limit`` =
+  ``max_redeliveries`` and a dead-letter route (default exchange →
+  ``<q>.failed``). RabbitMQ then tracks the per-message delivery count
+  itself, redelivers on reject-requeue, and dead-letters past the cap —
+  identical behavior to the in-tree brokers' server-side policy.
+- ``delivery_count`` surfaced to consumers comes from the broker-set
+  ``x-delivery-count`` header (quorum queues stamp it on redeliveries).
+- Dead-lettered messages carry RabbitMQ's standard ``x-death`` header;
+  it is translated to the cross-implementation ``x-death-queue`` /
+  ``x-delivery-count`` headers that ``BrokerManager.get_failed_jobs``
+  reads, so `llmq-tpu errors` works identically over AMQP.
 """
 
 from __future__ import annotations
@@ -11,6 +28,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
+from llmq_tpu.broker.memory import DEFAULT_MAX_REDELIVERIES, FAILED_SUFFIX
 from llmq_tpu.core.models import QueueStats
 
 try:
@@ -20,6 +38,48 @@ try:
 except ImportError:  # pragma: no cover - environment without aio-pika
     aio_pika = None
     HAVE_AIO_PIKA = False
+
+
+def _delivery_count(msg) -> int:
+    """Redelivery count of an incoming message.
+
+    Quorum queues stamp ``x-delivery-count`` (int) on every redelivery;
+    a first delivery has no header. Classic queues (no header support)
+    degrade to the boolean ``redelivered`` flag — still monotone, just
+    capped at 1, which is why quorum queues are the declared default.
+    """
+    headers = msg.headers or {}
+    try:
+        return int(headers.get("x-delivery-count", 1 if msg.redelivered else 0))
+    except (TypeError, ValueError):
+        return 1 if msg.redelivered else 0
+
+
+def _translate_headers(msg) -> Dict[str, object]:
+    """Map RabbitMQ's ``x-death`` bookkeeping onto the cross-broker
+    ``x-death-queue`` header the monitor CLI reads."""
+    headers = dict(msg.headers or {})
+    death = headers.get("x-death")
+    if "x-death-queue" not in headers and isinstance(death, (list, tuple)):
+        for entry in death:
+            if isinstance(entry, dict) and entry.get("queue"):
+                headers["x-death-queue"] = entry["queue"]
+                break
+    if "x-delivery-count" not in headers:
+        count = _delivery_count(msg)
+        if count:
+            headers["x-delivery-count"] = count
+    return headers
+
+
+def _delivered(msg) -> DeliveredMessage:
+    return DeliveredMessage(
+        msg.body,
+        msg.message_id or "",
+        delivery_count=_delivery_count(msg),
+        headers=_translate_headers(msg),
+        _settle=_settler(msg),
+    )
 
 
 class AmqpBroker(Broker):
@@ -36,15 +96,17 @@ class AmqpBroker(Broker):
         self._queues: Dict[str, object] = {}
         self._consumers: Dict[str, object] = {}
 
-    async def connect(self) -> None:  # pragma: no cover - needs live RabbitMQ
+    async def connect(self) -> None:
         self._conn = await aio_pika.connect_robust(self.url)
         self._channel = await self._conn.channel()
 
-    async def close(self) -> None:  # pragma: no cover
+    async def close(self) -> None:
         if self._conn is not None:
             await self._conn.close()
         self._conn = None
         self._channel = None
+        self._queues.clear()
+        self._consumers.clear()
 
     async def declare_queue(
         self,
@@ -53,13 +115,53 @@ class AmqpBroker(Broker):
         durable: bool = True,
         ttl_ms: Optional[int] = None,
         max_redeliveries: Optional[int] = None,
-    ) -> None:  # pragma: no cover
-        args = {}
+    ) -> None:
+        self._queues[name] = await self._declare(
+            name,
+            durable=durable,
+            ttl_ms=ttl_ms,
+            max_redeliveries=max_redeliveries,
+        )
+
+    async def _declare(
+        self,
+        name: str,
+        *,
+        durable: bool = True,
+        ttl_ms: Optional[int] = None,
+        max_redeliveries: Optional[int] = None,
+    ):
+        args: Dict[str, object] = {"x-queue-type": "quorum"}
         if ttl_ms is not None:
             args["x-message-ttl"] = ttl_ms
-        self._queues[name] = await self._channel.declare_queue(
-            name, durable=durable, arguments=args or None
+        if not name.endswith(FAILED_SUFFIX):
+            # Broker-side dead-letter policy: past the delivery limit the
+            # message routes through the default exchange to <q>.failed.
+            limit = (
+                max_redeliveries
+                if max_redeliveries is not None
+                else DEFAULT_MAX_REDELIVERIES
+            )
+            args["x-delivery-limit"] = limit
+            args["x-dead-letter-exchange"] = ""
+            args["x-dead-letter-routing-key"] = name + FAILED_SUFFIX
+            failed = name + FAILED_SUFFIX
+            if failed not in self._queues:
+                self._queues[failed] = await self._channel.declare_queue(
+                    failed,
+                    durable=durable,
+                    arguments={"x-queue-type": "quorum"},
+                )
+        return await self._channel.declare_queue(
+            name, durable=durable, arguments=args
         )
+
+    async def _ensure(self, name: str):
+        q = self._queues.get(name)
+        if q is None:
+            q = await self._declare(name)
+            self._queues[name] = q
+        return q
 
     async def publish(
         self,
@@ -68,7 +170,7 @@ class AmqpBroker(Broker):
         *,
         message_id: Optional[str] = None,
         headers: Optional[Dict[str, object]] = None,
-    ) -> None:  # pragma: no cover
+    ) -> None:
         message = aio_pika.Message(
             body=body,
             message_id=message_id,
@@ -79,47 +181,35 @@ class AmqpBroker(Broker):
 
     async def consume(
         self, queue: str, handler: MessageHandler, *, prefetch: int = 1
-    ) -> str:  # pragma: no cover
+    ) -> str:
         await self._channel.set_qos(prefetch_count=prefetch)
-        q = self._queues.get(queue) or await self._channel.declare_queue(
-            queue, durable=True
-        )
+        q = await self._ensure(queue)
 
         async def on_message(msg) -> None:
-            delivered = DeliveredMessage(
-                msg.body,
-                msg.message_id or "",
-                delivery_count=1 if msg.redelivered else 0,
-                headers=dict(msg.headers or {}),
-                _settle=_settler(msg),
-            )
-            await handler(delivered)
+            await handler(_delivered(msg))
 
         tag = await q.consume(on_message)
         self._consumers[tag] = q
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:  # pragma: no cover
+    async def cancel(self, consumer_tag: str) -> None:
         q = self._consumers.pop(consumer_tag, None)
         if q is not None:
             await q.cancel(consumer_tag)
 
-    async def get(self, queue: str):  # pragma: no cover
-        q = self._queues.get(queue) or await self._channel.declare_queue(
-            queue, durable=True
-        )
+    async def get(self, queue: str):
+        q = await self._ensure(queue)
         msg = await q.get(fail=False)
         if msg is None:
             return None
-        return DeliveredMessage(
-            msg.body,
-            msg.message_id or "",
-            delivery_count=1 if msg.redelivered else 0,
-            headers=dict(msg.headers or {}),
-            _settle=_settler(msg),
-        )
+        return _delivered(msg)
 
-    async def stats(self, queue: str) -> QueueStats:  # pragma: no cover
+    async def stats(self, queue: str) -> QueueStats:
+        """Management HTTP API first (byte-level depth, rates — reference
+        broker.py:222-289), AMQP passive declare as the fallback."""
+        via_mgmt = await self._stats_via_management(queue)
+        if via_mgmt is not None:
+            return via_mgmt
         # Passive declare raises (and poisons the channel) for a missing
         # queue; use a throwaway channel and map the failure onto the
         # cross-implementation 'unavailable' contract.
@@ -127,9 +217,11 @@ class AmqpBroker(Broker):
             channel = await self._conn.channel()
             try:
                 q = await channel.declare_queue(queue, durable=True, passive=True)
+                ready = q.declaration_result.message_count
                 return QueueStats(
                     queue_name=queue,
-                    message_count=q.declaration_result.message_count,
+                    message_count=ready,
+                    message_count_ready=ready,
                     consumer_count=q.declaration_result.consumer_count,
                     stats_source="amqp_fallback",
                 )
@@ -138,15 +230,74 @@ class AmqpBroker(Broker):
         except Exception:  # noqa: BLE001 — queue missing / channel error
             return QueueStats(queue_name=queue, stats_source="unavailable")
 
-    async def purge(self, queue: str) -> int:  # pragma: no cover
-        q = self._queues.get(queue) or await self._channel.declare_queue(
-            queue, durable=True
+    def _management_url(self, queue: str) -> Optional[str]:
+        """RabbitMQ Management API endpoint for a queue, derived from the
+        AMQP URL (host, credentials, vhost); port via LLMQ_AMQP_MGMT_PORT
+        (default 15672), or a full base via LLMQ_AMQP_MGMT_URL."""
+        import os
+        from urllib.parse import quote, urlsplit
+
+        parts = urlsplit(self.url)
+        vhost = parts.path.lstrip("/") or "/"
+        base = os.environ.get("LLMQ_AMQP_MGMT_URL")
+        if base is None:
+            if not parts.hostname:
+                return None
+            port = os.environ.get("LLMQ_AMQP_MGMT_PORT", "15672")
+            scheme = "https" if parts.scheme == "amqps" else "http"
+            base = f"{scheme}://{parts.hostname}:{port}"
+        return (
+            f"{base.rstrip('/')}/api/queues/"
+            f"{quote(vhost, safe='')}/{quote(queue, safe='')}"
         )
+
+    async def _stats_via_management(self, queue: str) -> Optional[QueueStats]:
+        try:
+            import httpx
+        except ImportError:  # pragma: no cover
+            return None
+        from urllib.parse import urlsplit
+
+        url = self._management_url(queue)
+        if url is None:
+            return None
+        parts = urlsplit(self.url)
+        auth = (parts.username or "guest", parts.password or "guest")
+        try:
+            async with httpx.AsyncClient(timeout=5.0) as client:
+                resp = await client.get(url, auth=auth)
+            if resp.status_code != 200:
+                return None
+            data = resp.json()
+            rate = (data.get("message_stats") or {}).get(
+                "deliver_get_details", {}
+            ).get("rate")
+            return QueueStats(
+                queue_name=queue,
+                message_count=data.get("messages", 0),
+                message_count_ready=data.get("messages_ready"),
+                message_count_unacknowledged=data.get(
+                    "messages_unacknowledged"
+                ),
+                consumer_count=data.get("consumers"),
+                message_bytes=data.get("message_bytes"),
+                message_bytes_ready=data.get("message_bytes_ready"),
+                message_bytes_unacknowledged=data.get(
+                    "message_bytes_unacknowledged"
+                ),
+                processing_rate=rate,
+                stats_source="management_api",
+            )
+        except Exception:  # noqa: BLE001 — mgmt API absent/unreachable
+            return None
+
+    async def purge(self, queue: str) -> int:
+        q = await self._ensure(queue)
         result = await q.purge()
         return getattr(result, "message_count", 0)
 
 
-def _settler(msg):  # pragma: no cover
+def _settler(msg):
     async def settle(verb: str, requeue: bool) -> None:
         if verb == "ack":
             await msg.ack()

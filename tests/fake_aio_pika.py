@@ -1,0 +1,306 @@
+"""In-memory fake of the aio-pika API surface AmqpBroker consumes.
+
+Lets the full BrokerContract matrix run against ``AmqpBroker`` without a
+live RabbitMQ (the reference tested its broker against mocked aio_pika the
+same way, reference tests/test_broker.py:27-43). The fake emulates the
+RabbitMQ behaviors the mapping relies on:
+
+- per-channel QoS (``prefetch_count`` bounds unacked messages in flight),
+- reject-requeue redelivery with quorum-queue ``x-delivery-count``
+  stamping,
+- ``x-delivery-limit`` + dead-letter-exchange routing (default exchange →
+  routing key), with the standard ``x-death`` header on the dead copy,
+- passive declare raising for missing queues,
+- FIFO ready queues, requeue-to-front on reject.
+
+State is namespaced per connection URL so each test gets a fresh vhost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Optional
+
+
+class DeliveryMode:
+    PERSISTENT = 2
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Message:
+    def __init__(
+        self,
+        body: bytes,
+        message_id: Optional[str] = None,
+        headers: Optional[Dict[str, Any]] = None,
+        delivery_mode: Any = None,
+        **_: Any,
+    ) -> None:
+        self.body = body
+        self.message_id = message_id or uuid.uuid4().hex
+        self.headers = dict(headers or {})
+        self.delivery_mode = delivery_mode
+
+
+@dataclass
+class _Stored:
+    body: bytes
+    message_id: str
+    headers: Dict[str, Any] = field(default_factory=dict)
+    delivery_count: int = 0
+
+
+@dataclass
+class _QueueState:
+    name: str
+    arguments: Dict[str, Any] = field(default_factory=dict)
+    ready: Deque[_Stored] = field(default_factory=deque)
+    consumers: Dict[str, tuple] = field(default_factory=dict)  # tag -> (cb, chan)
+
+
+class _Vhost:
+    def __init__(self) -> None:
+        self.queues: Dict[str, _QueueState] = {}
+        self._dispatching: set = set()
+
+    def declare(self, name: str, arguments: Optional[Dict[str, Any]]) -> _QueueState:
+        q = self.queues.get(name)
+        if q is None:
+            q = _QueueState(name, dict(arguments or {}))
+            self.queues[name] = q
+        return q
+
+    # --- delivery engine --------------------------------------------------
+    def kick(self, name: str) -> None:
+        if name in self._dispatching or name not in self.queues:
+            return
+        self._dispatching.add(name)
+        asyncio.get_running_loop().call_soon(self._dispatch, name)
+
+    def _dispatch(self, name: str) -> None:
+        self._dispatching.discard(name)
+        q = self.queues.get(name)
+        if q is None:
+            return
+        progressed = True
+        while progressed and q.ready:
+            progressed = False
+            for tag, (cb, chan) in list(q.consumers.items()):
+                if not q.ready:
+                    break
+                if chan.closed or len(chan.unacked) >= chan.prefetch:
+                    continue
+                stored = q.ready.popleft()
+                incoming = IncomingMessage(self, q, stored, chan)
+                chan.unacked[incoming] = None
+                asyncio.ensure_future(cb(incoming))
+                progressed = True
+
+    def settle(
+        self, q: _QueueState, stored: _Stored, verb: str, requeue: bool
+    ) -> None:
+        if verb == "ack" or not requeue:
+            return
+        stored.delivery_count += 1
+        limit = q.arguments.get("x-delivery-limit")
+        if limit is not None and stored.delivery_count > limit:
+            dlq_name = q.arguments.get("x-dead-letter-routing-key")
+            if dlq_name and dlq_name in self.queues:
+                dead = _Stored(
+                    body=stored.body,
+                    message_id=stored.message_id,
+                    headers={
+                        **stored.headers,
+                        "x-death": [
+                            {
+                                "queue": q.name,
+                                "reason": "delivery_limit",
+                                "count": stored.delivery_count,
+                            }
+                        ],
+                        "x-delivery-count": stored.delivery_count,
+                    },
+                )
+                self.queues[dlq_name].ready.append(dead)
+                self.kick(dlq_name)
+            return  # past the limit: never back to the source queue
+        q.ready.appendleft(stored)
+        self.kick(q.name)
+
+
+_VHOSTS: Dict[str, _Vhost] = {}
+
+
+class _DeclarationResult:
+    def __init__(self, message_count: int, consumer_count: int) -> None:
+        self.message_count = message_count
+        self.consumer_count = consumer_count
+
+
+class IncomingMessage:
+    def __init__(
+        self,
+        vhost: _Vhost,
+        q: _QueueState,
+        stored: _Stored,
+        channel: Optional["Channel"],
+    ) -> None:
+        self._vhost = vhost
+        self._q = q
+        self._stored = stored
+        self._channel = channel
+        self.body = stored.body
+        self.message_id = stored.message_id
+        self.redelivered = stored.delivery_count > 0
+        self.headers = dict(stored.headers)
+        if stored.delivery_count > 0:
+            # Quorum queues stamp the count on redeliveries.
+            self.headers["x-delivery-count"] = stored.delivery_count
+        self._settled = False
+
+    async def ack(self) -> None:
+        self._finish("ack", False)
+
+    async def reject(self, requeue: bool = False) -> None:
+        self._finish("reject", requeue)
+
+    def _finish(self, verb: str, requeue: bool) -> None:
+        if self._settled:
+            return
+        self._settled = True
+        if self._channel is not None:
+            self._channel.unacked.pop(self, None)
+        self._vhost.settle(self._q, self._stored, verb, requeue)
+        if self._channel is not None:
+            self._vhost.kick(self._q.name)
+
+
+class Queue:
+    """Channel-bound view of a queue (what declare_queue returns)."""
+
+    _tags = itertools.count()
+
+    def __init__(self, channel: "Channel", state: _QueueState) -> None:
+        self._channel = channel
+        self._state = state
+        self.name = state.name
+        self.declaration_result = _DeclarationResult(
+            len(state.ready), len(state.consumers)
+        )
+
+    async def consume(self, callback: Callable) -> str:
+        tag = f"ctag-{next(self._tags)}"
+        self._state.consumers[tag] = (callback, self._channel)
+        self._channel.vhost.kick(self.name)
+        return tag
+
+    async def cancel(self, tag: str) -> None:
+        self._state.consumers.pop(tag, None)
+
+    async def get(self, fail: bool = True):
+        if not self._state.ready:
+            if fail:
+                raise ChannelClosed(f"no message in {self.name}")
+            return None
+        stored = self._state.ready.popleft()
+        # basic_get is not subject to consumer QoS; settle still routes
+        # through the vhost for requeue/dead-letter semantics.
+        return IncomingMessage(self._channel.vhost, self._state, stored, None)
+
+    async def purge(self):
+        n = len(self._state.ready)
+        self._state.ready.clear()
+        return _DeclarationResult(n, len(self._state.consumers))
+
+
+class Channel:
+    def __init__(self, connection: "Connection") -> None:
+        self._connection = connection
+        self.vhost = connection.vhost
+        self.prefetch = 0x7FFFFFFF  # AMQP default: unlimited until set_qos
+        self.unacked: Dict[IncomingMessage, None] = {}
+        self.closed = False
+
+    async def set_qos(self, prefetch_count: int = 0) -> None:
+        self.prefetch = prefetch_count or 0x7FFFFFFF
+        for name in list(self.vhost.queues):
+            self.vhost.kick(name)
+
+    async def declare_queue(
+        self,
+        name: str,
+        durable: bool = True,
+        arguments: Optional[Dict[str, Any]] = None,
+        passive: bool = False,
+        **_: Any,
+    ) -> Queue:
+        if passive:
+            state = self.vhost.queues.get(name)
+            if state is None:
+                self.closed = True
+                raise ChannelClosed(f"NOT_FOUND - no queue '{name}'")
+            return Queue(self, state)
+        state = self.vhost.declare(name, arguments)
+        return Queue(self, state)
+
+    @property
+    def default_exchange(self) -> "_DefaultExchange":
+        return _DefaultExchange(self)
+
+    async def close(self) -> None:
+        self.closed = True
+
+
+class _DefaultExchange:
+    def __init__(self, channel: Channel) -> None:
+        self._channel = channel
+
+    async def publish(self, message: Message, routing_key: str) -> None:
+        vhost = self._channel.vhost
+        state = vhost.queues.get(routing_key)
+        if state is None:
+            return  # unroutable via default exchange: dropped (no mandatory)
+        state.ready.append(
+            _Stored(
+                body=message.body,
+                message_id=message.message_id,
+                headers=dict(message.headers),
+            )
+        )
+        vhost.kick(routing_key)
+
+
+class Connection:
+    def __init__(self, url: str) -> None:
+        self.url = url
+        self.vhost = _VHOSTS.setdefault(url, _Vhost())
+        self._channels = []
+
+    async def channel(self) -> Channel:
+        ch = Channel(self)
+        self._channels.append(ch)
+        return ch
+
+    async def close(self) -> None:
+        # Connection drop: every unacked message on every channel is
+        # redelivered (count bumped — quorum-queue behavior).
+        for ch in self._channels:
+            ch.closed = True
+            for incoming in list(ch.unacked):
+                incoming._finish("reject", True)
+            for q in self.vhost.queues.values():
+                for tag, (cb, chan) in list(q.consumers.items()):
+                    if chan is ch:
+                        q.consumers.pop(tag, None)
+        self._channels.clear()
+
+
+async def connect_robust(url: str, **_: Any) -> Connection:
+    return Connection(url)
