@@ -248,10 +248,10 @@ def main() -> None:
     preset = os.environ.get("LLMQ_BENCH_PRESET") or pick_preset(limit, platform)
     on_cpu = platform == "cpu"
 
-    n_requests = int(os.environ.get("LLMQ_BENCH_REQUESTS", 8 if on_cpu else 96))
+    n_requests = int(os.environ.get("LLMQ_BENCH_REQUESTS", 8 if on_cpu else 384))
     prompt_len = int(os.environ.get("LLMQ_BENCH_PROMPT", 16 if on_cpu else 200))
     gen_len = int(os.environ.get("LLMQ_BENCH_GEN", 16 if on_cpu else 128))
-    max_seqs = int(os.environ.get("LLMQ_BENCH_SEQS", 4 if on_cpu else 64))
+    max_seqs = int(os.environ.get("LLMQ_BENCH_SEQS", 4 if on_cpu else 128))
 
     config = get_preset(preset)
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
@@ -273,7 +273,11 @@ def main() -> None:
             max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
             kv_dtype=dtype,
             num_pages=256 if on_cpu else None,
-            page_size=8 if on_cpu else 32,
+            # 128-token pages: the decode kernel DMAs one page per grid
+            # step, and 16 KB transfers are latency-bound on the order of
+            # 6x the bandwidth floor (measured round 2); 128-token pages
+            # make the transfers 64 KB and quarter the grid.
+            page_size=8 if on_cpu else 128,
         ),
     )
 
@@ -294,7 +298,12 @@ def main() -> None:
         assert done == n, f"{done}/{n} finished"
         return elapsed
 
-    run(min(2, n_requests), "warmup")  # compile prefill bucket + decode
+    # Compile every executable the timed run will hit: the B=1 prefill
+    # variant (singleton admissions as slots trickle free), the padded
+    # max_prefill_batch variant, and the decode step. A mid-run jit trace
+    # would otherwise eat tens of seconds of the measured window.
+    run(1, "warmup-single")
+    run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
     gen_before = core.total_generated_tokens
     elapsed = run(n_requests, "bench")
     out_tokens = core.total_generated_tokens - gen_before

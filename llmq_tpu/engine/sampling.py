@@ -16,6 +16,7 @@ round-trip, no dynamic shapes.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -179,14 +180,33 @@ def sample_tokens(
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
-def make_base_key(seed: Optional[int], slot: int) -> jnp.ndarray:
-    """Key data for one slot, computed once at admission.
+@functools.lru_cache(maxsize=8192)
+def _key_data_host(eff_seed: int) -> "np.ndarray":
+    """Key data for ``eff_seed``, computed on the host CPU backend.
+
+    This runs per admitted request on the engine's hot path. Letting the
+    eager ops land on the default accelerator is catastrophic behind a
+    remote-TPU tunnel: the ``np.asarray`` sync waits for the whole
+    run-ahead dispatch queue plus a network round trip (~300 ms per
+    prefill chunk, measured round 2). Pinning to the CPU backend makes it
+    microseconds; the cache makes repeat slots/seeds free.
+    """
+    import numpy as np
+
+    try:
+        dev = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(dev):
+            return np.asarray(jax.random.key_data(jax.random.key(eff_seed)))
+    except RuntimeError:  # no cpu backend registered (unusual)
+        return np.asarray(jax.random.key_data(jax.random.key(eff_seed)))
+
+
+def make_base_key(seed: Optional[int], slot: int) -> "np.ndarray":
+    """Key data for one slot, computed once at admission (host-side).
 
     Seeded requests are reproducible across runs; unseeded ones derive
     from the slot index (distinct streams, arbitrary — vLLM semantics).
     """
-    return jax.random.key_data(
-        jax.random.key(seed if seed is not None else 0x5EED ^ slot)
-    )
+    return _key_data_host(seed if seed is not None else 0x5EED ^ slot)
 
 

@@ -228,16 +228,17 @@ class Transformer:
         one_plus = cfg.model_type.startswith("gemma")
 
         def layer_fn(carry, xs):
-            # KV pages ride in the carry and are updated one layer-slice at
-            # a time: with donated buffers XLA aliases the whole stack
-            # in-place (scan ys would allocate a second full KV cache).
+            # KV pages ride in the carry as the full [L, ...] stack and are
+            # written via a layer-indexed scatter: slicing the per-layer
+            # pool out (and re-inserting it) forces XLA to materialize
+            # full-pool copies around the attention custom call.
             h, kps, vps = carry
             lp, window, li = xs
-            kp = kps[li]
-            vp = vps[li]
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
             q, k, v = self._qkv(lp, x, positions, inv_freq)
-            kp, vp = attn_ops.write_kv_pages(kp, vp, k, v, block_tables, positions)
+            kps, vps = attn_ops.write_kv_pages(
+                kps, vps, k, v, block_tables, positions, layer=li
+            )
             attn_out = attn_dispatch.prefill_attention(
                 q,
                 k,
@@ -250,8 +251,6 @@ class Transformer:
                 backend=self.attn_backend,
             )
             h = self._finish_layer(lp, h, attn_out)
-            kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)
-            vps = jax.lax.dynamic_update_index_in_dim(vps, vp, li, 0)
             return (h, kps, vps), None
 
         layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -289,18 +288,17 @@ class Transformer:
         def layer_fn(carry, xs):
             h, kps, vps = carry
             lp, window, li = xs
-            kp = kps[li]
-            vp = vps[li]
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
             q, k, v = self._qkv(lp, x[:, None, :], positions[:, None], inv_freq)
-            # q/k/v: [S, 1, heads, d]
-            kp, vp = attn_ops.write_kv_pages(
-                kp, vp, k, v, block_tables, positions[:, None]
+            # q/k/v: [S, 1, heads, d]. The KV stack is written and read
+            # in place via the layer index — see prefill's layer_fn.
+            kps, vps = attn_ops.write_kv_pages(
+                kps, vps, k, v, block_tables, positions[:, None], layer=li
             )
             attn_out = attn_dispatch.decode_attention(
                 q[:, 0],
-                kp,
-                vp,
+                kps,
+                vps,
                 block_tables,
                 ctx_incl,
                 scale=cfg.attn_scale,
@@ -308,10 +306,9 @@ class Transformer:
                 softcap=cfg.attn_softcap,
                 mesh=self.mesh,
                 backend=self.attn_backend,
+                layer=li,
             )
             h = self._finish_layer(lp, h, attn_out)
-            kps = jax.lax.dynamic_update_index_in_dim(kps, kp, li, 0)
-            vps = jax.lax.dynamic_update_index_in_dim(vps, vp, li, 0)
             return (h, kps, vps), None
 
         layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)

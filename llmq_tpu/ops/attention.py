@@ -70,21 +70,31 @@ def full_prefill_attention(
 
 def paged_decode_attention(
     q: jnp.ndarray,  # [S, n_heads, head_dim] — one new token per sequence
-    k_pages: jnp.ndarray,  # [P, page_size, n_kv, head_dim]
-    v_pages: jnp.ndarray,  # [P, page_size, n_kv, head_dim]
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, head_dim] or [L, P, ...]
+    v_pages: jnp.ndarray,  # same shape as k_pages
     block_tables: jnp.ndarray,  # [S, pages_per_seq] int32
     context_lens: jnp.ndarray,  # [S] int32 — INCLUDING the new token
     *,
     scale: float,
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
+    layer: Optional[jnp.ndarray] = None,  # required when pages are stacked
 ) -> jnp.ndarray:
     """Decode-step attention reading K/V through the page table.
 
     Reference implementation: gathers each sequence's pages into a
     contiguous [S, max_ctx] view and does a masked softmax. The Pallas
     kernel computes the same thing without materializing the gather.
+
+    Pages may arrive stacked over layers ([L, P, page, n_kv, d], with a
+    traced ``layer`` index) so the model's layer scan never slices the
+    pool; this XLA reference simply indexes (the Pallas kernel addresses
+    the stack directly in its DMA index_map — that is the whole point).
     """
+    if k_pages.ndim == 5:
+        assert layer is not None, "stacked pages need a layer index"
+        k_pages = k_pages[layer]
+        v_pages = v_pages[layer]
     S, n_heads, head_dim = q.shape
     page_size = k_pages.shape[1]
     pages_per_seq = block_tables.shape[1]
@@ -110,21 +120,27 @@ def paged_decode_attention(
 
 
 def write_kv_pages(
-    k_pages: jnp.ndarray,  # [P, page_size, n_kv, d]
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, d] or [L, P, ...]
     v_pages: jnp.ndarray,
     k_new: jnp.ndarray,  # [B, T, n_kv, d]
     v_new: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, pages_per_seq]
     positions: jnp.ndarray,  # [B, T] absolute token positions (−1 = skip)
+    layer: Optional[jnp.ndarray] = None,  # required when pages are stacked
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter fresh K/V into their pages.
 
     Padded/inactive entries use position −1 and are routed to a reserved
     scratch page (physical page 0 by convention) so the scatter stays
     fixed-shape with no conditionals. The allocator never hands out page 0.
+
+    With layer-stacked pages ([L, P, page, n_kv, d]) the scatter targets
+    ``[layer, page, offset]`` directly — the layer scan never slices out
+    and re-inserts the per-layer pool (which XLA materializes as two
+    full-pool copies per layer around any opaque consumer).
     """
     B, T, n_kv, d = k_new.shape
-    page_size = k_pages.shape[1]
+    page_size = k_pages.shape[-3]
     pos = positions.reshape(B * T)
     valid = pos >= 0
     logical_page = jnp.where(valid, pos // page_size, 0)
@@ -134,6 +150,15 @@ def write_kv_pages(
     physical_page = jnp.where(valid, physical_page, 0)  # scratch page
     k_flat = k_new.reshape(B * T, n_kv, d)
     v_flat = v_new.reshape(B * T, n_kv, d)
-    k_pages = k_pages.at[physical_page, offset].set(k_flat, mode="drop")
-    v_pages = v_pages.at[physical_page, offset].set(v_flat, mode="drop")
+    if k_pages.ndim == 5:
+        assert layer is not None, "stacked pages need a layer index"
+        k_pages = k_pages.at[layer, physical_page, offset].set(
+            k_flat, mode="drop"
+        )
+        v_pages = v_pages.at[layer, physical_page, offset].set(
+            v_flat, mode="drop"
+        )
+    else:
+        k_pages = k_pages.at[physical_page, offset].set(k_flat, mode="drop")
+        v_pages = v_pages.at[physical_page, offset].set(v_flat, mode="drop")
     return k_pages, v_pages

@@ -113,7 +113,7 @@ def prefill_attention(
 
 def decode_attention(
     q: jnp.ndarray,  # [S, n_heads, d]
-    k_pages: jnp.ndarray,  # [Pg, page_size, n_kv, d]
+    k_pages: jnp.ndarray,  # [Pg, page_size, n_kv, d] or [L, Pg, ...]
     v_pages: jnp.ndarray,
     block_tables: jnp.ndarray,  # [S, pages_per_seq]
     context_lens: jnp.ndarray,  # [S] INCLUDING the new token
@@ -123,37 +123,51 @@ def decode_attention(
     softcap: Optional[float] = None,
     mesh: Optional[Mesh] = None,
     backend: str = "auto",
+    layer: Optional[jnp.ndarray] = None,  # required when pages are stacked
 ) -> jnp.ndarray:
     backend = resolve_backend() if backend == "auto" else backend
-    n_heads, n_kv = q.shape[1], k_pages.shape[2]
+    stacked = k_pages.ndim == 5
+    n_heads, n_kv = q.shape[1], k_pages.shape[-2]
     tp = _tp_degree(mesh)
     tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
     if backend != "pallas" or not tp_ok:
         return xla_ops.paged_decode_attention(
             q, k_pages, v_pages, block_tables, context_lens,
             scale=scale, sliding_window=sliding_window, softcap=softcap,
+            layer=layer,
         )
     window = _window_scalar(sliding_window)
+    li = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if layer is not None
+        else jnp.zeros((1,), jnp.int32)
+    )
 
-    def call(q, kp, vp, bt, cl, window):
+    def call(q, kp, vp, bt, cl, window, li):
         return pk.paged_decode_attention_pallas(
-            q, kp, vp, bt, cl, window,
+            q, kp, vp, bt, cl, window, li,
             scale=scale, softcap=softcap, interpret=_interpret(),
         )
 
     if tp > 1:
         assert mesh is not None
+        kv_spec = (
+            P(None, None, None, TP_AXIS, None)
+            if stacked
+            else P(None, None, TP_AXIS, None)
+        )
         call = jax.shard_map(
             call,
             mesh=mesh,
             in_specs=(
                 P(None, TP_AXIS, None),
-                P(None, None, TP_AXIS, None),
-                P(None, None, TP_AXIS, None),
+                kv_spec,
+                kv_spec,
+                P(),
                 P(),
                 P(),
                 P(),
             ),
             out_specs=P(None, TP_AXIS, None),
         )
-    return call(q, k_pages, v_pages, block_tables, context_lens, window)
+    return call(q, k_pages, v_pages, block_tables, context_lens, window, li)
