@@ -455,7 +455,11 @@ class EngineCore:
         finished: List[RequestOutput] = []
         free = sum(s is None for s in self.scheduler.slots)
         want = (
-            min(self.cfg.max_prefill_batch, len(self.scheduler.waiting))
+            min(
+                self.cfg.max_prefill_batch,
+                len(self.scheduler.waiting),
+                len(self.scheduler.slots),  # a chunk can't exceed the slots
+            )
             if self.scheduler.has_waiting
             else 0
         )

@@ -1,12 +1,22 @@
-"""HF checkpoint loading: safetensors → stacked param pytree.
+"""HF checkpoint loading: safetensors → stacked param pytree, streamed.
 
 The reference inherited weight loading from vLLM; here it's native. Reads a
 HuggingFace model directory (config.json + *.safetensors), maps tensor names
 onto the ``models/transformer.py`` layout, stacks per-layer weights on a
-leading [L, ...] axis (for the scanned layer body), and places shards
-directly onto devices with the engine's NamedShardings — each tensor is
-loaded once and shipped to its device placement without a full host-side
-model copy per device.
+leading [L, ...] axis (for the scanned layer body), and **streams** them
+onto the devices:
+
+- Each stacked parameter is allocated directly on device (with its
+  NamedSharding when a mesh is given) and filled one layer at a time via a
+  donated ``dynamic_update_index_in_dim`` jit — host memory never holds
+  more than one layer's tensor of one parameter.
+- Large 2-D tensors (embeddings, lm_head) are read in bounded row chunks
+  through safetensors' lazy ``get_slice`` and written into the device
+  buffer the same way.
+
+Peak host RSS during a load is therefore ~max(single tensor, chunk)
+instead of the full checkpoint — the difference between a 72B bf16 load
+needing ~145 GB of host RAM and needing well under 1 GB.
 
 Name mapping (HF → ours):
     model.embed_tokens.weight            embed                 [V, H]
@@ -27,21 +37,26 @@ from __future__ import annotations
 
 import json
 import logging
+from functools import partial
 from pathlib import Path
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.models.transformer import Params
 
 logger = logging.getLogger(__name__)
 
+# Row-chunk budget for streaming large 2-D tensors (bytes of source data).
+_CHUNK_BYTES = 256 * 2**20
+
 
 def _open_checkpoint(model_path: Path) -> Dict[str, Any]:
-    """Map tensor name → (file, loader) across all safetensors shards."""
+    """Map tensor name → shard file across all safetensors shards."""
     from safetensors import safe_open
 
     index: Dict[str, Path] = {}
@@ -71,26 +86,94 @@ class _TensorReader:
         self.index = _open_checkpoint(model_path)
         self._handles: Dict[Path, Any] = {}
 
-    def names(self) -> List[str]:
-        return list(self.index.keys())
-
-    def get(self, name: str) -> np.ndarray:
+    def _handle(self, name: str):
         path = self.index[name]
         handle = self._handles.get(path)
         if handle is None:
             handle = self._safe_open(path, framework="np")
             self._handles[path] = handle
-        tensor = handle.get_tensor(name)
-        return tensor
+        return handle
+
+    def get(self, name: str) -> np.ndarray:
+        return self._handle(name).get_tensor(name)
+
+    def get_rows(self, name: str, lo: int, hi: int) -> np.ndarray:
+        """Read rows [lo:hi) of a tensor without materializing the rest."""
+        return self._handle(name).get_slice(name)[lo:hi]
+
+    def shape(self, name: str) -> tuple:
+        return tuple(self._handle(name).get_slice(name).get_shape())
 
     def close(self) -> None:
         self._handles.clear()
 
 
-def _to_jnp(x: np.ndarray, dtype) -> jnp.ndarray:
-    # Some checkpoints store bf16, which numpy renders via ml_dtypes; view
-    # through jnp handles both.
-    return jnp.asarray(x).astype(dtype)
+def _np_dtype(dtype) -> np.dtype:
+    return jnp.dtype(dtype)  # ml_dtypes covers bf16
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("axis",))
+def _write_block(buf: jnp.ndarray, block: jnp.ndarray, start, *, axis: int):
+    idx = [0] * buf.ndim
+    idx[axis] = start
+    return jax.lax.dynamic_update_slice(buf, block, tuple(idx))
+
+
+class _Streamer:
+    """Allocates device buffers and fills them block-by-block in place."""
+
+    def __init__(self, mesh: Optional[Mesh], specs: Optional[Params]) -> None:
+        self.mesh = mesh
+        self.specs = specs
+
+    def _sharding(self, name: str) -> Optional[NamedSharding]:
+        if self.mesh is None or self.specs is None:
+            return None
+        node: Any = self.specs
+        for part in name.split("."):
+            node = node[part]
+        return NamedSharding(self.mesh, node)
+
+    def _alloc(self, shape, dtype, sharding) -> jnp.ndarray:
+        fn = jax.jit(
+            lambda: jnp.zeros(shape, dtype),
+            out_shardings=sharding,
+        )
+        return fn()
+
+    def _block_sharding(self, sharding, axis: int):
+        """The full-buffer sharding with the streamed axis unsharded (a
+        block spans only part of that axis, so it can't keep a sharded
+        spec there; every other axis keeps its placement)."""
+        if sharding is None:
+            return None
+        parts = list(sharding.spec) + [None] * 8
+        parts[axis] = None
+        return NamedSharding(self.mesh, P(*parts[: len(sharding.spec)]))
+
+    def stream(
+        self,
+        name: str,
+        shape: tuple,
+        dtype,
+        blocks,  # iterable of (start, np.ndarray) along `axis`
+        *,
+        axis: int = 0,
+    ) -> jnp.ndarray:
+        sharding = self._sharding(name)
+        buf = self._alloc(shape, dtype, sharding)
+        bsh = self._block_sharding(sharding, axis)
+        for start, block in blocks:
+            host = np.ascontiguousarray(block).astype(
+                _np_dtype(dtype), copy=False
+            )
+            dev = (
+                jax.device_put(host, bsh)
+                if bsh is not None
+                else jax.device_put(host)
+            )
+            buf = _write_block(buf, dev, start, axis=axis)
+        return buf
 
 
 def load_checkpoint(
@@ -98,53 +181,81 @@ def load_checkpoint(
     config: Optional[ModelConfig] = None,
     *,
     dtype=jnp.bfloat16,
-    put: Optional[Callable[[str, jnp.ndarray], jnp.ndarray]] = None,
+    mesh: Optional[Mesh] = None,
 ) -> Params:
     """Load an HF checkpoint directory into the stacked param layout.
 
-    ``put(param_name, array)`` lets the caller apply device placement /
-    sharding per parameter (engine passes a NamedSharding-aware placer);
-    default is plain host→default-device transfer.
+    ``mesh`` enables sharded streaming: every parameter is allocated on
+    the mesh with its ``parallel/sharding.py`` NamedSharding and filled
+    in place, so neither the host nor any single device ever holds an
+    unsharded copy. Without a mesh, buffers stream onto the default
+    device (single-device use; tests).
     """
     model_path = Path(model_path)
     if config is None:
         config = ModelConfig.from_pretrained(model_path)
     reader = _TensorReader(model_path)
-    place = put or (lambda name, arr: jax.device_put(arr))
     L = config.num_layers
+    np_dtype = _np_dtype(dtype)
 
-    def tensor(name: str) -> np.ndarray:
-        return reader.get(name)
+    specs = None
+    if mesh is not None:
+        from llmq_tpu.parallel.mesh import TP_AXIS
+        from llmq_tpu.parallel.sharding import param_pspecs
 
-    def stacked(fmt: str, *, transpose: bool = False) -> jnp.ndarray:
-        parts = []
-        for i in range(L):
-            arr = np.asarray(tensor(fmt.format(i=i)))
-            if transpose:
-                arr = arr.T
-            parts.append(arr)
-        return np.stack(parts)
+        specs = param_pspecs(config, int(mesh.shape.get(TP_AXIS, 1)))
+    streamer = _Streamer(mesh, specs)
+
+    def stacked(our_name: str, fmt: str, *, transpose: bool = False):
+        """Stream layer tensors into a [L, ...] device stack."""
+        shape0 = reader.shape(fmt.format(i=0))
+        if transpose:
+            shape0 = shape0[::-1]
+        full = (L, *shape0)
+
+        def blocks():
+            for i in range(L):
+                arr = reader.get(fmt.format(i=i))
+                if transpose:
+                    arr = arr.T
+                yield i, arr[None]
+
+        return streamer.stream(f"layers.{our_name}", full, dtype, blocks())
+
+    def big2d(our_name: str, hf_name: str, *, transpose: bool = False):
+        """Stream a large 2-D tensor in bounded row chunks."""
+        rows, cols = reader.shape(hf_name)
+        itemsize = np.dtype(np_dtype).itemsize
+        chunk = max(1, _CHUNK_BYTES // max(1, cols * itemsize))
+        shape = (cols, rows) if transpose else (rows, cols)
+        axis = 1 if transpose else 0
+
+        def blocks():
+            for lo in range(0, rows, chunk):
+                hi = min(rows, lo + chunk)
+                arr = reader.get_rows(hf_name, lo, hi)
+                yield lo, arr.T if transpose else arr
+
+        return streamer.stream(our_name, shape, dtype, blocks(), axis=axis)
 
     def has(name: str) -> bool:
         return name in reader.index
 
     layers: Params = {}
-    layers["ln1"] = _to_jnp(
-        stacked("model.layers.{i}.input_layernorm.weight"), dtype
-    )
+    layers["ln1"] = stacked("ln1", "model.layers.{i}.input_layernorm.weight")
     if config.post_norms:  # gemma2 4-norm layout
-        layers["post_attn_norm"] = _to_jnp(
-            stacked("model.layers.{i}.post_attention_layernorm.weight"), dtype
+        layers["post_attn_norm"] = stacked(
+            "post_attn_norm", "model.layers.{i}.post_attention_layernorm.weight"
         )
-        layers["ln2"] = _to_jnp(
-            stacked("model.layers.{i}.pre_feedforward_layernorm.weight"), dtype
+        layers["ln2"] = stacked(
+            "ln2", "model.layers.{i}.pre_feedforward_layernorm.weight"
         )
-        layers["post_mlp_norm"] = _to_jnp(
-            stacked("model.layers.{i}.post_feedforward_layernorm.weight"), dtype
+        layers["post_mlp_norm"] = stacked(
+            "post_mlp_norm", "model.layers.{i}.post_feedforward_layernorm.weight"
         )
     else:
-        layers["ln2"] = _to_jnp(
-            stacked("model.layers.{i}.post_attention_layernorm.weight"), dtype
+        layers["ln2"] = stacked(
+            "ln2", "model.layers.{i}.post_attention_layernorm.weight"
         )
     for ours, theirs in (
         ("q_proj", "self_attn.q_proj"),
@@ -155,8 +266,8 @@ def load_checkpoint(
         ("up_proj", "mlp.up_proj"),
         ("down_proj", "mlp.down_proj"),
     ):
-        layers[ours] = _to_jnp(
-            stacked(f"model.layers.{{i}}.{theirs}.weight", transpose=True), dtype
+        layers[ours] = stacked(
+            ours, f"model.layers.{{i}}.{theirs}.weight", transpose=True
         )
     if config.attention_bias:
         for ours, theirs in (
@@ -164,37 +275,33 @@ def load_checkpoint(
             ("k_bias", "self_attn.k_proj"),
             ("v_bias", "self_attn.v_proj"),
         ):
-            layers[ours] = _to_jnp(
-                stacked(f"model.layers.{{i}}.{theirs}.bias"), dtype
+            layers[ours] = stacked(
+                ours, f"model.layers.{{i}}.{theirs}.bias"
             )
     if config.qk_norm:
-        layers["q_norm"] = _to_jnp(
-            stacked("model.layers.{i}.self_attn.q_norm.weight"), dtype
+        layers["q_norm"] = stacked(
+            "q_norm", "model.layers.{i}.self_attn.q_norm.weight"
         )
-        layers["k_norm"] = _to_jnp(
-            stacked("model.layers.{i}.self_attn.k_norm.weight"), dtype
+        layers["k_norm"] = stacked(
+            "k_norm", "model.layers.{i}.self_attn.k_norm.weight"
         )
 
     params: Params = {
-        "embed": _to_jnp(np.asarray(tensor("model.embed_tokens.weight")), dtype),
-        "final_norm": _to_jnp(np.asarray(tensor("model.norm.weight")), dtype),
+        "embed": big2d("embed", "model.embed_tokens.weight"),
+        "final_norm": streamer.stream(
+            "final_norm",
+            reader.shape("model.norm.weight"),
+            dtype,
+            [(0, reader.get("model.norm.weight"))],
+        ),
         "layers": layers,
     }
     if not config.tie_word_embeddings and has("lm_head.weight"):
-        params["lm_head"] = _to_jnp(np.asarray(tensor("lm_head.weight")).T, dtype)
+        params["lm_head"] = big2d("lm_head", "lm_head.weight", transpose=True)
 
-    placed = {
-        "embed": place("embed", params["embed"]),
-        "final_norm": place("final_norm", params["final_norm"]),
-        "layers": {
-            k: place(f"layers.{k}", v) for k, v in params["layers"].items()
-        },
-    }
-    if "lm_head" in params:
-        placed["lm_head"] = place("lm_head", params["lm_head"])
     reader.close()
-    n_params = sum(x.size for x in jax.tree.leaves(placed))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
     logger.info(
         "Loaded %s: %.2fB params as %s", model_path, n_params / 1e9, dtype
     )
-    return placed
+    return params

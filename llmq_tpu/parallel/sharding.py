@@ -120,17 +120,3 @@ def shard_params(params: Params, mesh: Mesh, config: ModelConfig) -> Params:
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def checkpoint_placer(mesh: Mesh, config: ModelConfig):
-    """``put(name, array)`` callback for ``engine.weights.load_checkpoint``:
-    ships each tensor host→device with its NamedSharding as it is read, so
-    no full host-side copy of the model accumulates per device."""
-    tp = mesh.shape[TP_AXIS]
-    specs = param_pspecs(config, tp)
-
-    def put(name: str, arr):
-        node: Any = specs
-        for part in name.split("."):
-            node = node[part]
-        return jax.device_put(arr, NamedSharding(mesh, node))
-
-    return put

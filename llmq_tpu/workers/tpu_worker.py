@@ -103,15 +103,16 @@ class TPUWorker(BaseWorker):
         else:
             from llmq_tpu.engine.weights import load_checkpoint
             from llmq_tpu.models.config import ModelConfig
-            from llmq_tpu.parallel.sharding import checkpoint_placer
 
             path = Path(spec)
             model_config = ModelConfig.from_pretrained(path)
+            # mesh-aware streaming: each tensor lands on its shards
+            # directly; host RSS stays ~one tensor (weights.py docstring).
             params = load_checkpoint(
                 path,
                 model_config,
                 dtype=dtype,
-                put=checkpoint_placer(mesh, model_config),
+                mesh=mesh,
             )
             tokenizer = HFTokenizer(spec)
 

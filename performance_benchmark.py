@@ -204,7 +204,7 @@ class PerformanceBenchmark:
         from llmq_tpu.broker.manager import BrokerManager
         from llmq_tpu.core.models import Job, Result
 
-        manager = BrokerManager(url)
+        manager = BrokerManager(url=url)
         await manager.connect()
         await manager.setup_queue_infrastructure(self.queue)
         self.start_worker(url, batch_size)

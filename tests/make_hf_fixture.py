@@ -1,0 +1,82 @@
+"""Build a small but fully genuine HF checkpoint directory offline.
+
+Produces everything a real hub download has: config.json, sharded
+safetensors with index, a trained BPE tokenizer (tokenizer.json), and a
+chat template — so the HFTokenizer + load_checkpoint + chat-template path
+is exercised exactly as it would be with a hub model, without network.
+
+Usable as a pytest helper and as a CLI:
+    python tests/make_hf_fixture.py /tmp/qwen2-micro
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] }}"
+    "{{ '<|im_end|>' + '\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}{% endif %}"
+)
+
+SAMPLE_TEXT = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Message queues decouple producers from consumers.",
+    "Tensor processing units excel at dense linear algebra.",
+    "Translate the following sentence into German.",
+    "Continuous batching keeps the accelerator busy.",
+    "Paged attention stores the KV cache in fixed-size blocks.",
+] * 50
+
+
+def build(out_dir: str | Path, *, vocab_size: int = 512) -> Path:
+    import torch
+    import transformers
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size - 4,
+        special_tokens=["<|endoftext|>", "<|im_start|>", "<|im_end|>", "<|pad|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(SAMPLE_TEXT, trainer=trainer)
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        eos_token="<|im_end|>",
+        pad_token="<|pad|>",
+        bos_token=None,
+        chat_template=CHAT_TEMPLATE,
+    )
+    fast.save_pretrained(out)
+
+    true_vocab = fast.vocab_size
+    torch.manual_seed(0)
+    cfg = transformers.Qwen2Config(
+        vocab_size=max(true_vocab, vocab_size),
+        hidden_size=128,
+        intermediate_size=256,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=1024,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        eos_token_id=fast.eos_token_id,
+    )
+    model = transformers.Qwen2ForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(out, safe_serialization=True, max_shard_size="500KB")
+    return out
+
+
+if __name__ == "__main__":
+    dest = build(sys.argv[1] if len(sys.argv) > 1 else "/tmp/qwen2-micro")
+    print(dest)
