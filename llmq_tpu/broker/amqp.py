@@ -14,7 +14,19 @@ could never reach the cap):
   ``max_redeliveries`` and a dead-letter route (default exchange →
   ``<q>.failed``). RabbitMQ then tracks the per-message delivery count
   itself, redelivers on reject-requeue, and dead-letters past the cap —
-  identical behavior to the in-tree brokers' server-side policy.
+  identical behavior to the in-tree brokers' server-side policy. The
+  ``<q>.failed`` queues set ``x-delivery-limit: -1`` explicitly: on
+  RabbitMQ 4.x the unset default is 20, which would silently delete
+  failed-job records after repeated non-destructive ``errors`` peeks.
+- **Existing queues are used as-is** (passive-first declare). RabbitMQ
+  rejects re-declares with inequivalent arguments (406), so a deployment
+  whose queues were created by the reference llmq (classic queues, no
+  delivery limit) keeps working — with the reference's requeue-forever
+  semantics on those queues. Only queues this broker creates get the
+  quorum/dead-letter policy. ``LLMQ_AMQP_QUEUE_TYPE=classic`` opts new
+  declares out of quorum queues entirely (delivery counts then degrade
+  to the boolean ``redelivered`` flag, so the DLQ cap cannot fire —
+  reference behavior).
 - ``delivery_count`` surfaced to consumers comes from the broker-set
   ``x-delivery-count`` header (quorum queues stamp it on redeliveries).
 - Dead-lettered messages carry RabbitMQ's standard ``x-death`` header;
@@ -108,6 +120,37 @@ class AmqpBroker(Broker):
         self._queues.clear()
         self._consumers.clear()
 
+    @staticmethod
+    def _queue_type() -> str:
+        import os
+
+        return os.environ.get("LLMQ_AMQP_QUEUE_TYPE", "quorum")
+
+    async def _passive(self, name: str):
+        """Bind to ``name`` if it already exists, else return None.
+
+        A passive declare for a missing queue raises AND poisons its
+        channel, so the existence probe runs on a throwaway channel; only
+        a confirmed-existing queue is passively re-bound on the main one.
+        RabbitMQ rejects *active* re-declares whose arguments differ from
+        the live queue's (406 PRECONDITION_FAILED), so using existing
+        queues as-is — whatever their type/TTL/limits — is the only
+        drop-in-compatible behavior.
+        """
+        probe = await self._conn.channel()
+        try:
+            await probe.declare_queue(name, durable=True, passive=True)
+        except Exception:  # noqa: BLE001 — NOT_FOUND (channel now dead)
+            return None
+        finally:
+            try:
+                await probe.close()
+            except Exception:  # noqa: BLE001 — already closed by the error
+                pass
+        return await self._channel.declare_queue(
+            name, durable=True, passive=True
+        )
+
     async def declare_queue(
         self,
         name: str,
@@ -116,12 +159,15 @@ class AmqpBroker(Broker):
         ttl_ms: Optional[int] = None,
         max_redeliveries: Optional[int] = None,
     ) -> None:
-        self._queues[name] = await self._declare(
-            name,
-            durable=durable,
-            ttl_ms=ttl_ms,
-            max_redeliveries=max_redeliveries,
-        )
+        q = await self._passive(name)
+        if q is None:
+            q = await self._declare(
+                name,
+                durable=durable,
+                ttl_ms=ttl_ms,
+                max_redeliveries=max_redeliveries,
+            )
+        self._queues[name] = q
 
     async def _declare(
         self,
@@ -131,10 +177,18 @@ class AmqpBroker(Broker):
         ttl_ms: Optional[int] = None,
         max_redeliveries: Optional[int] = None,
     ):
-        args: Dict[str, object] = {"x-queue-type": "quorum"}
+        qtype = self._queue_type()
+        quorum = qtype == "quorum"
+        args: Dict[str, object] = {"x-queue-type": qtype}
         if ttl_ms is not None:
             args["x-message-ttl"] = ttl_ms
-        if not name.endswith(FAILED_SUFFIX):
+        if name.endswith(FAILED_SUFFIX):
+            if quorum:
+                # Unlimited: RabbitMQ 4.x defaults an unset quorum
+                # delivery limit to 20, and `errors` peeks via
+                # get+requeue — failed jobs must survive arbitrary peeks.
+                args["x-delivery-limit"] = -1
+        elif quorum:
             # Broker-side dead-letter policy: past the delivery limit the
             # message routes through the default exchange to <q>.failed.
             limit = (
@@ -145,13 +199,13 @@ class AmqpBroker(Broker):
             args["x-delivery-limit"] = limit
             args["x-dead-letter-exchange"] = ""
             args["x-dead-letter-routing-key"] = name + FAILED_SUFFIX
+        if not name.endswith(FAILED_SUFFIX):
             failed = name + FAILED_SUFFIX
             if failed not in self._queues:
-                self._queues[failed] = await self._channel.declare_queue(
-                    failed,
-                    durable=durable,
-                    arguments={"x-queue-type": "quorum"},
-                )
+                fq = await self._passive(failed)
+                if fq is None:
+                    fq = await self._declare(failed, durable=durable)
+                self._queues[failed] = fq
         return await self._channel.declare_queue(
             name, durable=durable, arguments=args
         )
@@ -159,7 +213,9 @@ class AmqpBroker(Broker):
     async def _ensure(self, name: str):
         q = self._queues.get(name)
         if q is None:
-            q = await self._declare(name)
+            q = await self._passive(name)
+            if q is None:
+                q = await self._declare(name)
             self._queues[name] = q
         return q
 
@@ -233,13 +289,19 @@ class AmqpBroker(Broker):
     def _management_url(self, queue: str) -> Optional[str]:
         """RabbitMQ Management API endpoint for a queue, derived from the
         AMQP URL (host, credentials, vhost); port via LLMQ_AMQP_MGMT_PORT
-        (default 15672), or a full base via LLMQ_AMQP_MGMT_URL."""
+        (default 15672), a full base via LLMQ_AMQP_MGMT_URL, or disabled
+        outright with LLMQ_AMQP_MGMT_URL=off (AMQP fallback only)."""
         import os
-        from urllib.parse import quote, urlsplit
+        from urllib.parse import quote, unquote, urlsplit
 
-        parts = urlsplit(self.url)
-        vhost = parts.path.lstrip("/") or "/"
         base = os.environ.get("LLMQ_AMQP_MGMT_URL")
+        if base is not None and base.lower() in ("off", "none", ""):
+            return None
+        parts = urlsplit(self.url)
+        # The AMQP path segment is percent-encoded (vhost "/" rides as
+        # %2F); decode before re-encoding for the HTTP path, or the API
+        # sees a double-encoded %252F and 404s.
+        vhost = unquote(parts.path.lstrip("/")) or "/"
         if base is None:
             if not parts.hostname:
                 return None
@@ -256,13 +318,18 @@ class AmqpBroker(Broker):
             import httpx
         except ImportError:  # pragma: no cover
             return None
-        from urllib.parse import urlsplit
+        from urllib.parse import unquote, urlsplit
 
         url = self._management_url(queue)
         if url is None:
             return None
         parts = urlsplit(self.url)
-        auth = (parts.username or "guest", parts.password or "guest")
+        # urlsplit leaves userinfo percent-encoded; the AMQP layer (yarl)
+        # decodes it, so Basic auth must too or user%40corp 401s.
+        auth = (
+            unquote(parts.username) if parts.username else "guest",
+            unquote(parts.password) if parts.password else "guest",
+        )
         try:
             async with httpx.AsyncClient(timeout=5.0) as client:
                 resp = await client.get(url, auth=auth)

@@ -1,0 +1,30 @@
+"""Parse a jax.profiler xplane.pb: aggregate device-plane op durations."""
+import sys
+from collections import defaultdict
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+path = sys.argv[1]
+space = xplane_pb2.XSpace()
+space.ParseFromString(open(path, "rb").read())
+
+for plane in space.planes:
+    if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+        continue
+    stats_meta = {k: v.name for k, v in plane.stat_metadata.items()}
+    ev_meta = {k: v.name for k, v in plane.event_metadata.items()}
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for line in plane.lines:
+        if "XLA Ops" not in line.name and "xla op" not in line.name.lower():
+            continue
+        for ev in line.events:
+            name = ev_meta.get(ev.metadata_id, "?")
+            totals[name] += ev.duration_ps / 1e9  # ms
+            counts[name] += 1
+    if totals:
+        print(f"== plane {plane.name}")
+        top = sorted(totals.items(), key=lambda kv: -kv[1])[:30]
+        for name, ms in top:
+            print(f"{ms:9.3f} ms  x{counts[name]:5d}  {name[:100]}")
+        print(f"total: {sum(totals.values()):.1f} ms")

@@ -154,8 +154,13 @@ class PerformanceBenchmark:
 
     # --- worker -----------------------------------------------------------
     def start_worker(self, url: str, batch_size: int) -> None:
+        # Prepend (never replace) PYTHONPATH: site dirs already on it may
+        # register accelerator plugins the worker needs (dropping them
+        # makes jax fail to init the TPU backend in the subprocess).
+        pypath = os.environ.get("PYTHONPATH", "")
+        pypath = _repo_root() + (os.pathsep + pypath if pypath else "")
         env = dict(os.environ, LLMQ_BROKER_URL=url,
-                   PYTHONPATH=_repo_root(),
+                   PYTHONPATH=pypath,
                    LLMQ_QUEUE_PREFETCH=str(self.args.prefetch or batch_size * 2))
         if self.args.worker == "dummy":
             cmd = [sys.executable, "-m", "llmq_tpu", "worker", "dummy",
@@ -294,7 +299,7 @@ class PerformanceBenchmark:
             self.stop_worker()
             await manager.broker.purge(self.queue)
             await manager.broker.purge(f"{self.queue}.results")
-            await manager.close()
+            await manager.disconnect()
 
     # --- orchestration ----------------------------------------------------
     async def run(self) -> Dict[str, object]:
@@ -307,7 +312,16 @@ class PerformanceBenchmark:
                     f"{self.args.samples} jobs ===",
                     file=sys.stderr,
                 )
-                point = await self.run_point(url, batch_size)
+                try:
+                    point = await self.run_point(url, batch_size)
+                except Exception as exc:  # noqa: BLE001 — next point may work
+                    print(
+                        f"point batch_size={batch_size} FAILED: "
+                        f"{type(exc).__name__}: {exc}",
+                        file=sys.stderr,
+                    )
+                    self.stop_worker()
+                    continue
                 results.append(point)
                 print(json.dumps(asdict(point)), file=sys.stderr)
         finally:

@@ -1,0 +1,111 @@
+"""Ablate decode-step components to find the 17ms gap.
+
+Variants (monkeypatched before jit):
+  full        — as shipped
+  no-attn     — decode_attention returns zeros (KV write + matmuls remain)
+  no-kvwrite  — write_kv_pages identity (attention reads stale pages)
+  no-both     — only the dense matmul path
+  no-logits   — full but last-hidden only (skip LM head)
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import llmq_tpu.ops.attention as attn_ops
+import llmq_tpu.ops.dispatch as attn_dispatch
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import Transformer, init_params, make_kv_pages
+from llmq_tpu.parallel import make_mesh
+
+S = 64
+PAGE = 32
+PPS = 17
+P = 1089
+
+config = get_preset("qwen2.5-3b")
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+mesh = make_mesh(devices=jax.devices())
+model = Transformer(config, mesh=mesh)
+
+rng = np.random.default_rng(0)
+tokens = jnp.asarray(rng.integers(1, 1000, size=S), jnp.int32)
+ctx = jnp.full((S,), 330, jnp.int32)
+bt = jnp.asarray(rng.integers(0, P, size=(S, PPS)), jnp.int32)
+active = jnp.ones((S,), bool)
+
+orig_attn = attn_dispatch.decode_attention
+orig_write = attn_ops.write_kv_pages
+
+
+def stub_attn(q, kp, vp, *a, **k):
+    return jnp.zeros_like(q)[:, None, :].reshape(q.shape[0], 1, *q.shape[1:])[:, 0]
+
+
+def stub_write(kp, vp, k, v, *a, **kw):
+    return kp, vp
+
+
+def bench(name, attn, write, n=30):
+    attn_dispatch.decode_attention = attn
+    attn_ops.write_kv_pages = write
+    try:
+        fn = jax.jit(
+            lambda p, kp, vp: model.decode(p, tokens, ctx, kp, vp, bt, active),
+            donate_argnums=(1, 2),
+        )
+        kp, vp = make_kv_pages(config, P, PAGE, dtype=jnp.bfloat16)
+        out, kp, vp = fn(params, kp, vp)
+        jax.block_until_ready(out)
+        t0 = time.monotonic()
+        for _ in range(n):
+            out, kp, vp = fn(params, kp, vp)
+        jax.block_until_ready(out)
+        ms = (time.monotonic() - t0) / n * 1000
+        print(f"{name:12s}: {ms:7.2f} ms")
+        return ms
+    finally:
+        attn_dispatch.decode_attention = orig_attn
+        attn_ops.write_kv_pages = orig_write
+
+
+bench("full", orig_attn, orig_write)
+bench("no-attn", stub_attn, orig_write)
+bench("no-kvwrite", orig_attn, stub_write)
+bench("no-both", stub_attn, stub_write)
+
+# matmul-only: no KV arrays in the graph at all
+import llmq_tpu.models.transformer as T
+
+
+def bench_dense(n=30):
+    cfg = config
+    inv_freq = T.compute_rope_inv_freq(cfg)
+    positions = ctx
+
+    def dense(p, toks):
+        h = model._embed(p, toks)
+        one_plus = False
+
+        def layer_fn(h, lp):
+            x = T.rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+            q, k, v = model._qkv(lp, x[:, None, :], positions[:, None], inv_freq)
+            attn_out = jnp.zeros_like(q)
+            h = model._finish_layer(lp, h, attn_out[:, 0])
+            return h, None
+
+        h, _ = jax.lax.scan(layer_fn, h, p["layers"])
+        return model._logits(p, h)
+
+    fn = jax.jit(dense)
+    out = fn(params, tokens)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn(params, tokens)
+    jax.block_until_ready(out)
+    print(f"{'dense-only':12s}: {(time.monotonic()-t0)/n*1000:7.2f} ms")
+
+
+bench_dense()

@@ -1,0 +1,38 @@
+"""Check XLA's bytes-accessed estimate for the decode step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+config = get_preset("qwen2.5-3b")
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+core = EngineCore(
+    config, params, ByteTokenizer(), mesh=make_mesh(devices=jax.devices()),
+    engine_config=EngineConfig(max_num_seqs=64, max_model_len=512,
+                               kv_dtype=jnp.bfloat16, page_size=32),
+)
+rng = np.random.default_rng(0)
+for i in range(8):
+    core.add_request(f"p-{i}",
+                     prompt_ids=rng.integers(1, 1000, size=200).tolist(),
+                     params=SamplingParams(temperature=0.0, max_tokens=8,
+                                           ignore_eos=True))
+core.step()
+fn = core._decode_jits["greedy"]
+lowered = fn.lower(core.params, core.k_pages, core.v_pages, core._dev_state)
+comp = lowered.compile()
+ca = comp.cost_analysis()
+if isinstance(ca, list):
+    ca = ca[0]
+print("flops:", ca.get("flops"))
+print("bytes accessed GB:", ca.get("bytes accessed", 0) / 1e9)
+for k, v in sorted(ca.items()):
+    if "bytes accessed" in k and isinstance(v, float) and v > 1e8:
+        print(f"  {k}: {v/1e9:.2f} GB")
+print("num_pages:", core.scheduler.config.num_pages)

@@ -1,0 +1,92 @@
+"""Ad-hoc perf triage: raw compiled decode-step time vs engine.step() time.
+
+Usage: python profile_decode.py [preset]
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+preset = sys.argv[1] if len(sys.argv) > 1 else "qwen2.5-3b"
+max_seqs = int(os.environ.get("SEQS", 64))
+prompt_len = int(os.environ.get("PROMPT", 200))
+gen_len = int(os.environ.get("GEN", 128))
+
+config = get_preset(preset)
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+mesh = make_mesh(devices=jax.devices())
+core = EngineCore(
+    config, params, ByteTokenizer(), mesh=mesh,
+    engine_config=EngineConfig(
+        max_num_seqs=max_seqs,
+        max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
+        kv_dtype=jnp.bfloat16,
+        page_size=32,
+    ),
+)
+rng = np.random.default_rng(0)
+sp = lambda: SamplingParams(temperature=0.0, max_tokens=gen_len, ignore_eos=True)
+
+# Fill all slots.
+for i in range(max_seqs):
+    ids = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+    core.add_request(f"p-{i}", prompt_ids=ids, params=sp())
+
+# Run a few engine steps so prefill is done and decode state is live.
+t0 = time.monotonic()
+while core.scheduler.has_waiting:
+    core.step()
+print(f"prefill phase: {time.monotonic() - t0:.2f}s, prefills={core.prefills}")
+
+# Warm the decode executable.
+for _ in range(3):
+    core.step()
+
+# --- raw decode step timing (no engine bookkeeping) ---
+fn = core._decode_jits[core._mode]
+if core._dirty:
+    core._drain([])
+    core._resync()
+st = core._dev_state
+kp, vp = core.k_pages, core.v_pages
+# donate-safe: run once to get fresh buffers
+out, kp, vp, st = fn(core.params, kp, vp, st)
+jax.block_until_ready(out)
+N = 20
+t0 = time.monotonic()
+for _ in range(N):
+    out, kp, vp, st = fn(core.params, kp, vp, st)
+jax.block_until_ready(out)
+raw_ms = (time.monotonic() - t0) / N * 1000
+print(f"raw decode step: {raw_ms:.2f} ms  -> {max_seqs / (raw_ms/1e3):.0f} tok/s at batch {max_seqs}")
+core.k_pages, core.v_pages, core._dev_state = kp, vp, st
+# account for the N raw steps the scheduler never saw: resync
+core._pending.clear()
+core._processed_idx = core._dispatch_idx
+core._resync()
+
+# --- engine.step() loop timing ---
+N = 20
+t0 = time.monotonic()
+tok0 = core.total_generated_tokens
+for _ in range(N):
+    core.step()
+# pipeline lags; drain to count tokens honestly
+core._drain([])
+dt = time.monotonic() - t0
+toks = core.total_generated_tokens - tok0
+print(f"engine loop: {dt/N*1000:.2f} ms/step, {toks/dt:.0f} tok/s observed")
+
+# weight-read floor
+wbytes = config.num_params() * 2
+print(f"weights {wbytes/2**30:.2f} GiB; floor @819GB/s = {wbytes/819e9*1000:.2f} ms/step")

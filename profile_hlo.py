@@ -1,0 +1,46 @@
+"""Dump optimized HLO of the decode step; look for full-pool copies."""
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+preset = sys.argv[1] if len(sys.argv) > 1 else "qwen2.5-0.5b"
+config = get_preset(preset)
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+core = EngineCore(
+    config, params, ByteTokenizer(), mesh=make_mesh(devices=jax.devices()),
+    engine_config=EngineConfig(max_num_seqs=64, max_model_len=512,
+                               kv_dtype=jnp.bfloat16, page_size=32),
+)
+rng = np.random.default_rng(0)
+for i in range(4):
+    core.add_request(f"p-{i}",
+                     prompt_ids=rng.integers(1, 1000, size=64).tolist(),
+                     params=SamplingParams(temperature=0.0, max_tokens=4,
+                                           ignore_eos=True))
+core.step()
+fn = core._decode_jits["greedy"]
+comp = fn.lower(core.params, core.k_pages, core.v_pages, core._dev_state).compile()
+txt = comp.as_text()
+print("HLO lines:", len(txt.splitlines()), flush=True)
+# find copies / bitcasts of big buffers and the custom calls
+pat = re.compile(r"(copy|custom-call|dynamic-update-slice|dynamic-slice|scatter|fusion)")
+for line in txt.splitlines():
+    s = line.strip()
+    if "copy(" in s or "custom-call" in s:
+        # only show ops on KV-pool-sized arrays
+        if re.search(r"bf16\[\d+,\d+,32,\d+,64\]|bf16\[\d+,32,\d+,64\]|bf16\[24,", s) or "custom-call" in s:
+            print(s[:220])
+
+with open("/tmp/full_hlo.txt", "w") as f:
+    f.write(txt)
+print("wrote /tmp/full_hlo.txt")

@@ -1,0 +1,69 @@
+"""Time steady-state prefill chunks and trace per-op cost."""
+import glob
+import os
+import shutil
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+page = int(os.environ.get("PAGE", 128))
+mpb = int(os.environ.get("MPB", 4))
+config = get_preset("qwen2.5-3b")
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+core = EngineCore(
+    get_preset("qwen2.5-3b"), params, ByteTokenizer(),
+    mesh=make_mesh(devices=jax.devices()),
+    engine_config=EngineConfig(max_num_seqs=64, max_model_len=512,
+                               kv_dtype=jnp.bfloat16, page_size=page,
+                               max_prefill_batch=mpb),
+)
+rng = np.random.default_rng(0)
+sp = lambda: SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+
+def add(n):
+    for i in range(n):
+        core.add_request(f"p-{rng.integers(1<<30)}",
+                         prompt_ids=rng.integers(1, 1000, size=200).tolist(),
+                         params=sp())
+
+# compile: one full chunk + drain
+add(mpb)
+while core.has_work:
+    core.step()
+print("compiled", flush=True)
+
+# steady-state: time prefill chunks only
+N = 12
+add(N * mpb)
+t0 = time.monotonic()
+while core.scheduler.has_waiting:
+    core.step()
+core._drain([])
+dt = time.monotonic() - t0
+toks = N * mpb * 200
+print(f"prefill steady: {dt/N*1000:.1f} ms/chunk(B={mpb}), "
+      f"{toks/dt:.0f} prompt tok/s", flush=True)
+
+while core.has_work:
+    core.step()
+
+tdir = "/tmp/jaxtrace_pf"
+shutil.rmtree(tdir, ignore_errors=True)
+add(4 * mpb)
+with jax.profiler.trace(tdir):
+    while core.scheduler.has_waiting:
+        core.step()
+    core._drain([])
+print("traced", flush=True)
+x = glob.glob(os.path.join(tdir, "**", "*.xplane.pb"), recursive=True)
+print(x[0] if x else "no xplane")

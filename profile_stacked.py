@@ -1,0 +1,63 @@
+"""Micro-bench stacked 5-D pallas decode kernel, standalone and in a scan."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas
+
+S, H, NKV, D = 64, 16, 2, 128
+PAGE, PPS, P, L = 32, 17, 1089, 36
+
+
+@jax.jit
+def setup(key):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (S, H, D), jnp.bfloat16)
+    kp = jax.random.normal(kk, (L, P, PAGE, NKV, D), jnp.bfloat16)
+    vp = jax.random.normal(kv, (L, P, PAGE, NKV, D), jnp.bfloat16)
+    return q, kp, vp
+
+
+print("setup...", flush=True)
+q, kp, vp = setup(jax.random.key(0))
+jax.block_until_ready(q)
+print("setup done", flush=True)
+bt = jnp.asarray(np.random.default_rng(0).integers(0, P, size=(S, PPS)), jnp.int32)
+cl = jnp.full((S,), 330, jnp.int32)
+w = jnp.asarray([1 << 30], jnp.int32)
+li = jnp.asarray([7], jnp.int32)
+
+f1 = jax.jit(lambda li: paged_decode_attention_pallas(
+    q, kp, vp, bt, cl, w, li, scale=D ** -0.5))
+t0 = time.monotonic()
+jax.block_until_ready(f1(li))
+print(f"compile+run {time.monotonic()-t0:.1f}s", flush=True)
+t0 = time.monotonic()
+for _ in range(50):
+    r = f1(li)
+jax.block_until_ready(r)
+print(f"steady single: {(time.monotonic()-t0)/50*1000:.3f} ms", flush=True)
+
+
+def scan_all(q, kp, vp):
+    def body(c, li):
+        o = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, li,
+                                          scale=D ** -0.5)
+        return c + o.astype(jnp.float32), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros(q.shape, jnp.float32),
+                          jnp.arange(L, dtype=jnp.int32))
+    return out
+
+
+f2 = jax.jit(scan_all)
+jax.block_until_ready(f2(q, kp, vp))
+print("scan compiled", flush=True)
+t0 = time.monotonic()
+for _ in range(20):
+    r = f2(q, kp, vp)
+jax.block_until_ready(r)
+ms = (time.monotonic() - t0) / 20 * 1000
+print(f"scan {L} layers: {ms:.3f} ms = {ms/L:.4f} ms/layer", flush=True)

@@ -1,0 +1,53 @@
+"""jax.profiler trace of engine decode steps; parse xplane for op times."""
+import glob
+import os
+import shutil
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+preset = sys.argv[1] if len(sys.argv) > 1 else "qwen2.5-3b"
+config = get_preset(preset)
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+core = EngineCore(
+    config, params, ByteTokenizer(), mesh=make_mesh(devices=jax.devices()),
+    engine_config=EngineConfig(max_num_seqs=64, max_model_len=512,
+                               kv_dtype=jnp.bfloat16, page_size=32),
+)
+rng = np.random.default_rng(0)
+for i in range(64):
+    core.add_request(f"p-{i}",
+                     prompt_ids=rng.integers(1, 1000, size=200).tolist(),
+                     params=SamplingParams(temperature=0.0, max_tokens=120,
+                                           ignore_eos=True))
+while core.scheduler.has_waiting:
+    core.step()
+for _ in range(5):
+    core.step()
+print("tracing...", flush=True)
+tdir = "/tmp/jaxtrace"
+shutil.rmtree(tdir, ignore_errors=True)
+with jax.profiler.trace(tdir):
+    for _ in range(10):
+        core.step()
+    core._drain([])
+print("trace done", flush=True)
+
+# parse
+from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+
+xplanes = glob.glob(os.path.join(tdir, "**", "*.xplane.pb"), recursive=True)
+print(xplanes, flush=True)
+data, _ = rtd.xspace_to_tool_data(xplanes, "hlo_op_profile", {})
+open("/tmp/opprofile.json", "wb").write(
+    data if isinstance(data, bytes) else data.encode())
+print("wrote /tmp/opprofile.json")

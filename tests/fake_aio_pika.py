@@ -75,6 +75,14 @@ class _Vhost:
         if q is None:
             q = _QueueState(name, dict(arguments or {}))
             self.queues[name] = q
+        elif q.arguments != dict(arguments or {}):
+            # RabbitMQ enforces argument equivalence on active declares:
+            # an existing queue re-declared with different x-arguments is
+            # a channel error, not a silent no-op.
+            raise ChannelClosed(
+                f"PRECONDITION_FAILED - inequivalent arg for queue '{name}': "
+                f"have {q.arguments}, got {arguments}"
+            )
         return q
 
     # --- delivery engine --------------------------------------------------
