@@ -119,6 +119,41 @@ def paged_decode_attention(
     return jnp.einsum("shk,skhd->shd", weights, v)
 
 
+def write_prompt_kv_pages(
+    k_pages: jnp.ndarray,  # [L, P, page_size, n_kv, d] (stacked only)
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, T, n_kv, d] — positions 0..T-1 per row
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, pages_per_seq]
+    layer: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Page-granular prefill KV write (whole pages, not token rows).
+
+    Prefill always covers positions ``[0, T)`` of each row, so when the
+    bucket ``T`` is a page multiple the scatter can write whole
+    ``[page_size, n_kv, d]`` blocks — one scatter row per *page* instead
+    of per *token*. Measured on v5e at 3B/8x256: the token scatter costs
+    ~10.5 ms per prefill chunk (2048 rows x 512 B); this page form is
+    ~64 KB per row and drops it to noise.
+
+    Rows shorter than ``T`` write garbage into the tail of their last
+    page(s); that space is never read (attention masks by context length)
+    and is overwritten token-by-token as decode extends the sequence.
+    Padded rows carry an all-zero block table and land on the reserved
+    scratch page 0 (same convention as ``write_kv_pages``).
+    """
+    B, T, n_kv, d = k_new.shape
+    page_size = k_pages.shape[-3]
+    assert T % page_size == 0, "bucket must be page-aligned for page writes"
+    n_lp = T // page_size
+    phys = block_tables[:, :n_lp].reshape(B * n_lp)
+    k_blocks = k_new.reshape(B * n_lp, page_size, n_kv, d)
+    v_blocks = v_new.reshape(B * n_lp, page_size, n_kv, d)
+    k_pages = k_pages.at[layer, phys].set(k_blocks, mode="drop")
+    v_pages = v_pages.at[layer, phys].set(v_blocks, mode="drop")
+    return k_pages, v_pages
+
+
 def write_kv_pages(
     k_pages: jnp.ndarray,  # [P, page_size, n_kv, d] or [L, P, ...]
     v_pages: jnp.ndarray,

@@ -31,7 +31,8 @@ core = EngineCore(
         max_num_seqs=max_seqs,
         max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
         kv_dtype=jnp.bfloat16,
-        page_size=32,
+        page_size=int(os.environ.get("PAGE", 128)),
+        max_prefill_batch=int(os.environ.get("PREFILL_BATCH", 8)),
     ),
 )
 rng = np.random.default_rng(0)

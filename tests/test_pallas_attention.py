@@ -167,3 +167,43 @@ def test_dispatch_pallas_path_through_model():
     np.testing.assert_allclose(
         outs["pallas"][:2], outs["xla"][:2], rtol=1e-4, atol=1e-4
     )
+
+
+def test_write_prompt_kv_pages_matches_token_scatter():
+    """Page-granular prefill write == token scatter on page-aligned buckets
+    (positions 0..T-1 per row, zero-padded block tables → scratch page 0)."""
+    L, Pp, page, n_kv, d = 3, 9, 8, 2, 16
+    B, T = 2, 16  # two pages per row
+    key = jax.random.key(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    k_new = _rand(k1, (B, T, n_kv, d))
+    v_new = _rand(k2, (B, T, n_kv, d))
+    base_k = _rand(k3, (L, Pp, page, n_kv, d))
+    base_v = base_k + 1.0
+    # row 0: full-length prompt; row 1: short (12 of 16) — garbage tail
+    lengths = jnp.asarray([16, 12], jnp.int32)
+    bt = jnp.zeros((B, 4), jnp.int32)
+    bt = bt.at[0, :2].set(jnp.asarray([3, 5]))
+    bt = bt.at[1, :2].set(jnp.asarray([7, 2]))
+    pos_grid = jnp.arange(T)[None, :].astype(jnp.int32)
+    positions = jnp.where(pos_grid < lengths[:, None], pos_grid, -1)
+    li = jnp.asarray(1, jnp.int32)
+
+    tok_k, tok_v = ref_ops.write_kv_pages(
+        base_k, base_v, k_new, v_new, bt, positions, layer=li
+    )
+    pg_k, pg_v = ref_ops.write_prompt_kv_pages(
+        base_k, base_v, k_new, v_new, bt, li
+    )
+    # Every position the token scatter wrote must match; the page path may
+    # additionally fill the dead tail of row 1's last page (never read) and
+    # the scratch page 0 — exclude both.
+    np.testing.assert_allclose(pg_k[1, 3], tok_k[1, 3])
+    np.testing.assert_allclose(pg_k[1, 5], tok_k[1, 5])
+    np.testing.assert_allclose(pg_v[1, 7, :4], tok_v[1, 7, :4])
+    np.testing.assert_allclose(pg_k[1, 7, :8], tok_k[1, 7, :8])
+    np.testing.assert_allclose(pg_k[1, 2, :4], tok_k[1, 2, :4])
+    # untouched layers and pages stay untouched
+    np.testing.assert_allclose(pg_k[0], base_k[0])
+    np.testing.assert_allclose(pg_k[2], base_k[2])
+    np.testing.assert_allclose(pg_v[1, 4], base_v[1, 4])
