@@ -143,8 +143,14 @@ def decode_attention(
         else jnp.zeros((1,), jnp.int32)
     )
 
+    kern = (
+        pk.paged_decode_attention_pallas_v2
+        if os.environ.get("LLMQ_DECODE_KERNEL", "v1") == "v2"
+        else pk.paged_decode_attention_pallas
+    )
+
     def call(q, kp, vp, bt, cl, window, li):
-        return pk.paged_decode_attention_pallas(
+        return kern(
             q, kp, vp, bt, cl, window, li,
             scale=scale, softcap=softcap, interpret=_interpret(),
         )

@@ -1,0 +1,15 @@
+import time, jax, jax.numpy as jnp, numpy as np
+x = jnp.asarray(np.random.default_rng(0).standard_normal((1<<28,)), jnp.bfloat16)  # 512 MiB
+g = jax.jit(lambda x: x * jnp.bfloat16(1.0000001))
+x = g(x); jax.block_until_ready(x)
+t0 = time.monotonic()
+for _ in range(20): x = g(x)   # chained: args differ every call
+jax.block_until_ready(x); dt = (time.monotonic()-t0)/20
+print(f"chained copy 512MiB: {dt*1e3:.2f} ms -> {2*x.nbytes/dt/1e9:.0f} GB/s r+w")
+# chained sum-ish read: keep array changing cheaply
+h = jax.jit(lambda x, s: (x + jnp.bfloat16(1e-8), jnp.sum(x.astype(jnp.float32))))
+x, s = h(x, 0.0); jax.block_until_ready(s)
+t0 = time.monotonic()
+for _ in range(20): x, s = h(x, s)
+jax.block_until_ready(s); dt = (time.monotonic()-t0)/20
+print(f"chained r+w pass: {dt*1e3:.2f} ms -> {2*x.nbytes/dt/1e9:.0f} GB/s")

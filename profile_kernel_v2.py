@@ -1,0 +1,87 @@
+"""Micro-bench decode-attention kernels at REAL pool size (HBM-resident).
+
+The round-3 finding: a small test pool fits in VMEM and makes any kernel
+look infinitely fast — benchmark only with the full stacked [L,P,...]
+pool (2.3 GiB per K and V at the 3B bench config).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas
+
+# bench config shapes: qwen2.5-3b, S=192, page 128, max_model_len 512
+S = 192
+H, NKV, D = 16, 2, 128
+PAGE = 128
+PPS = 4
+L = 36
+P = 961  # pool pages per layer (auto-sized in the engine at this config)
+CTX = 330
+
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+print(f"pool: {L*P*PAGE*NKV*D*2/2**30:.2f} GiB per side", flush=True)
+kp = jnp.asarray(rng.standard_normal((L, P, PAGE, NKV, D)), jnp.bfloat16)
+vp = jnp.asarray(rng.standard_normal((L, P, PAGE, NKV, D)), jnp.bfloat16)
+# distinct pages per seq, like the real allocator
+bt_np = np.zeros((S, PPS), np.int32)
+perm = np.arange(P)
+rng.shuffle(perm)
+for s in range(S):
+    bt_np[s] = perm[(s * PPS) % (P - PPS):(s * PPS) % (P - PPS) + PPS]
+bt = jnp.asarray(bt_np)
+cl = jnp.full((S,), CTX, jnp.int32)
+w = jnp.asarray([1 << 30], jnp.int32)
+scale = D ** -0.5
+
+
+def timeit_layers(f, n=3):
+    """Run over all L layers per iteration (different li -> different pages,
+    defeats any caching; matches the engine's access pattern)."""
+    outs = [f(jnp.int32(li)) for li in range(L)]
+    jax.block_until_ready(outs[-1])
+    t0 = time.monotonic()
+    for _ in range(n):
+        outs = [f(jnp.int32(li)) for li in range(L)]
+    jax.block_until_ready(outs)
+    return (time.monotonic() - t0) / (n * L) * 1000
+
+
+live_pages = -(-CTX // PAGE)
+kv_bytes = S * live_pages * PAGE * NKV * D * 2 * 2
+tot_bytes = S * PPS * PAGE * NKV * D * 2 * 2
+print(f"live KV/layer: {kv_bytes/2**20:.1f} MiB (floor@819GB/s "
+      f"{kv_bytes/819e9*1e3:.3f} ms); with dead pages: {tot_bytes/2**20:.1f} MiB")
+
+ms = timeit_layers(
+    lambda li: paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=li,
+                                             scale=scale))
+print(f"current: {ms:.3f} ms/layer -> x{L}: {ms*L:.1f} ms/step  "
+      f"({tot_bytes/ms*1e3/2**30:.0f} GiB/s eff)")
+
+from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas_v2
+
+ms = timeit_layers(
+    lambda li: paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=li,
+                                                scale=scale))
+print(f"v2 manual-DMA: {ms:.3f} ms/layer -> x{L}: {ms*L:.1f} ms/step  "
+      f"({kv_bytes/ms*1e3/2**30:.0f} GiB/s live-eff)")
+
+a = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
+b = paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
+print("max|diff| v2 vs v1 on TPU:", float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))))
+
+# partial-occupancy case: half the slots empty (bench tail / mixed load)
+cl_half = jnp.where(jnp.arange(S) % 2 == 0, CTX, 0)
+ms = timeit_layers(
+    lambda li: paged_decode_attention_pallas_v2(q, kp, vp, bt, cl_half, w, layer=li,
+                                                scale=scale))
+print(f"v2 half-empty: {ms:.3f} ms/layer (dead-slot skipping)")
+ms = timeit_layers(
+    lambda li: paged_decode_attention_pallas(q, kp, vp, bt, cl_half, w, layer=li,
+                                             scale=scale))
+print(f"v1 half-empty: {ms:.3f} ms/layer (fixed schedule)")

@@ -1,0 +1,83 @@
+"""Per-op breakdown of the decode step at the bench config (S=192, 3B).
+
+Traces N pure decode steps, aggregates the TPU device-plane op durations
+into buckets (matmul / attention kernel / KV write / sampler / other), and
+prints a ms/step table. This is the evidence artifact for the round-3
+perf work; run on the real chip.
+"""
+import glob
+import os
+import shutil
+import sys
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.presets import get_preset
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+preset = sys.argv[1] if len(sys.argv) > 1 else "qwen2.5-3b"
+S = int(os.environ.get("SEQS", 192))
+PROMPT = int(os.environ.get("PROMPT", 200))
+N = int(os.environ.get("STEPS", 20))
+
+config = get_preset(preset)
+params = init_params(config, jax.random.key(0), dtype=jnp.bfloat16)
+core = EngineCore(
+    config, params, ByteTokenizer(), mesh=make_mesh(devices=jax.devices()),
+    engine_config=EngineConfig(
+        max_num_seqs=S, max_model_len=512, kv_dtype=jnp.bfloat16,
+        page_size=128, max_prefill_batch=8,
+    ),
+)
+rng = np.random.default_rng(0)
+for i in range(S):
+    core.add_request(
+        f"p-{i}", prompt_ids=rng.integers(1, config.vocab_size, size=PROMPT).tolist(),
+        params=SamplingParams(temperature=0.0, max_tokens=10**6, ignore_eos=True),
+    )
+while core.scheduler.has_waiting:
+    core.step()
+for _ in range(5):
+    core.step()
+
+tdir = "/tmp/jaxtrace_step"
+shutil.rmtree(tdir, ignore_errors=True)
+import time
+t0 = time.monotonic()
+with jax.profiler.trace(tdir):
+    for _ in range(N):
+        core.step()
+    core._drain([])
+wall_ms = (time.monotonic() - t0) / N * 1000
+print(f"wall: {wall_ms:.2f} ms/step over {N} steps", flush=True)
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+totals = defaultdict(float)
+counts = defaultdict(int)
+for path in glob.glob(os.path.join(tdir, "**", "*.xplane.pb"), recursive=True):
+    space = xplane_pb2.XSpace()
+    space.ParseFromString(open(path, "rb").read())
+    for plane in space.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        ev_meta = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "xla op" not in line.name.lower():
+                continue
+            for ev in line.events:
+                name = ev_meta.get(ev.metadata_id, "?")
+                totals[name] += ev.duration_ps / 1e9  # ms
+                counts[name] += 1
+
+top = sorted(totals.items(), key=lambda kv: -kv[1])[:40]
+for name, ms in top:
+    print(f"{ms / N:9.4f} ms/step  x{counts[name]:5d}  {name[:110]}")
+print(f"device total: {sum(totals.values()) / N:.2f} ms/step")
