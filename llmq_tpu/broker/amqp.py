@@ -167,7 +167,29 @@ class AmqpBroker(Broker):
                 ttl_ms=ttl_ms,
                 max_redeliveries=max_redeliveries,
             )
+        elif not name.endswith(FAILED_SUFFIX):
+            # A pre-existing queue may carry a dead-letter policy routing
+            # to ``<q>.failed`` (e.g. created by an earlier llmq run) —
+            # bind the companion if it exists so failed-job peeks see it.
+            # Never *create* queues on an externally-managed topology: a
+            # configure-restricted attach must keep working (an active
+            # declare would raise ACCESS_REFUSED and poison the channel),
+            # and a DLX-less external queue should not grow a spurious
+            # ``.failed``.
+            await self._ensure_failed(name + FAILED_SUFFIX, create=False)
         self._queues[name] = q
+
+    async def _ensure_failed(
+        self, failed: str, *, durable: bool = True, create: bool = True
+    ) -> None:
+        if failed in self._queues:
+            return
+        fq = await self._passive(failed)
+        if fq is None:
+            if not create:
+                return
+            fq = await self._declare(failed, durable=durable)
+        self._queues[failed] = fq
 
     async def _declare(
         self,
@@ -200,12 +222,7 @@ class AmqpBroker(Broker):
             args["x-dead-letter-exchange"] = ""
             args["x-dead-letter-routing-key"] = name + FAILED_SUFFIX
         if not name.endswith(FAILED_SUFFIX):
-            failed = name + FAILED_SUFFIX
-            if failed not in self._queues:
-                fq = await self._passive(failed)
-                if fq is None:
-                    fq = await self._declare(failed, durable=durable)
-                self._queues[failed] = fq
+            await self._ensure_failed(name + FAILED_SUFFIX, durable=durable)
         return await self._channel.declare_queue(
             name, durable=durable, arguments=args
         )

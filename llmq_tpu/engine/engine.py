@@ -98,6 +98,11 @@ class EngineConfig:
     kv_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
+    # Admission deferral waits for a full prefill chunk's worth of free
+    # slots (throughput), but never keeps *deferring admissible work* for
+    # longer than this (latency floor for trickle arrivals; the clock
+    # starts at the first deferred step, not at enqueue).
+    admit_max_wait_s: float = 0.5
     runahead: int = 8  # decode steps dispatched ahead of result reads
     # Per-slot device-side stop-token-id capacity. Grows automatically
     # (drain + resync + jit retrace at the wider shape) when a request's
@@ -223,6 +228,7 @@ class EngineCore:
 
         # Run-ahead pipeline state.
         self._pending: Deque[_Pending] = deque()
+        self._defer_since: Optional[float] = None  # admission-deferral start
         self._deferred_pages: List[Tuple[int, List[int]]] = []
         self._dispatch_idx = 0
         self._processed_idx = 0
@@ -481,8 +487,25 @@ class EngineCore:
         # Batch admission: wait for enough free slots to fill a prefill
         # chunk rather than prefilling singletons as slots trickle free —
         # a B=1 chunk costs nearly a full weight pass for 1/B the tokens.
-        # Never defer when nothing is running (no progress to wait for).
-        if want and free >= (want if self.scheduler.running else 1):
+        # Never defer when nothing is running (no progress to wait for),
+        # and never keep deferring past admit_max_wait_s. The clock starts
+        # when work first *could* be admitted (waiting + a free slot) but
+        # was deferred — NOT at enqueue: under a sustained backlog every
+        # request is already "old" at head-of-line, which would turn every
+        # freed slot into a B=1 prefill and defeat the deferral entirely.
+        can_admit = bool(want) and free > 0
+        full = free >= (want if self.scheduler.running else 1)
+        if not can_admit or full:
+            self._defer_since = None
+        elif self._defer_since is None:
+            self._defer_since = time.monotonic()
+        overdue = (
+            self._defer_since is not None
+            and time.monotonic() - self._defer_since
+            > self.cfg.admit_max_wait_s
+        )
+        if can_admit and (full or overdue):
+            self._defer_since = None
             admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
             todo = []
             for seq in admitted:

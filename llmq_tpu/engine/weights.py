@@ -101,6 +101,20 @@ class _TensorReader:
         """Read rows [lo:hi) of a tensor without materializing the rest."""
         return self._handle(name).get_slice(name)[lo:hi]
 
+    def itemsize(self, name: str) -> int:
+        """Bytes per element *as stored* (an fp32 checkpoint loaded as
+        bf16 still costs 4 host bytes per element while in flight)."""
+        st_sizes = {
+            "F64": 8, "F32": 4, "F16": 2, "BF16": 2, "F8_E4M3": 1,
+            "F8_E5M2": 1, "I64": 8, "I32": 4, "I16": 2, "I8": 1,
+            "U8": 1, "BOOL": 1,
+        }
+        try:
+            dt = str(self._handle(name).get_slice(name).get_dtype()).upper()
+            return st_sizes.get(dt, 4)
+        except Exception:  # noqa: BLE001 — older safetensors: assume fp32
+            return 4
+
     def shape(self, name: str) -> tuple:
         return tuple(self._handle(name).get_slice(name).get_shape())
 
@@ -225,7 +239,13 @@ def load_checkpoint(
     def big2d(our_name: str, hf_name: str, *, transpose: bool = False):
         """Stream a large 2-D tensor in bounded row chunks."""
         rows, cols = reader.shape(hf_name)
-        itemsize = np.dtype(np_dtype).itemsize
+        # Budget by stored + target element sizes when they differ: an
+        # fp32→bf16 load briefly holds BOTH the stored fp32 rows and the
+        # converted bf16 copy, so chunking by either size alone overshoots
+        # the documented _CHUNK_BYTES peak.
+        stored = reader.itemsize(hf_name)
+        target = np.dtype(np_dtype).itemsize
+        itemsize = stored + target if stored != target else target
         chunk = max(1, _CHUNK_BYTES // max(1, cols * itemsize))
         shape = (cols, rows) if transpose else (rows, cols)
         axis = 1 if transpose else 0

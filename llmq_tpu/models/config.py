@@ -23,6 +23,15 @@ from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
 
+def _as_id_list(ids: Any) -> list:
+    """Normalize an HF token-id field: int, list, or absent → list[int]."""
+    if ids is None:
+        return []
+    if isinstance(ids, int):
+        return [ids]
+    return list(ids)
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     vocab_size: int
@@ -76,11 +85,7 @@ class ModelConfig:
     def from_hf_config(cls, hf: Dict[str, Any]) -> "ModelConfig":
         """Map a HuggingFace config.json dict (llama/qwen2/gemma2/mistral)."""
         mt = hf.get("model_type", "llama")
-        eos = hf.get("eos_token_id", [])
-        if isinstance(eos, int):
-            eos = [eos]
-        elif eos is None:
-            eos = []
+        eos = _as_id_list(hf.get("eos_token_id"))
         common = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -131,9 +136,32 @@ class ModelConfig:
 
     @classmethod
     def from_pretrained(cls, model_path: str | Path) -> "ModelConfig":
-        """Load from a local HF checkpoint directory's config.json."""
-        path = Path(model_path) / "config.json"
-        return cls.from_hf_config(json.loads(path.read_text()))
+        """Load from a local HF checkpoint directory's config.json.
+
+        ``generation_config.json``'s EOS set is unioned in: Llama-3-style
+        checkpoints list the extra stop ids (e.g. ``<|eot_id|>``) *only*
+        there, and a model that never stops on its chat-turn terminator
+        generates garbage tails (reference parity: vLLM reads the
+        generation config, ``llmq/workers/vllm_worker.py:148-165``).
+        """
+        base = Path(model_path)
+        hf = json.loads((base / "config.json").read_text())
+        gen_path = base / "generation_config.json"
+        if gen_path.exists():
+            try:
+                gen = json.loads(gen_path.read_text())
+            except (OSError, json.JSONDecodeError):
+                gen = None
+            # Tolerate any malformed shape, not just broken syntax.
+            gen_eos = gen.get("eos_token_id") if isinstance(gen, dict) else None
+            if gen_eos is not None:
+                hf["eos_token_id"] = list(
+                    dict.fromkeys(  # ordered union
+                        _as_id_list(hf.get("eos_token_id"))
+                        + _as_id_list(gen_eos)
+                    )
+                )
+        return cls.from_hf_config(hf)
 
     # --- handy test configs ------------------------------------------------
     @classmethod

@@ -143,9 +143,13 @@ def decode_attention(
         else jnp.zeros((1,), jnp.int32)
     )
 
+    # Empty string = unset (the `VAR= cmd` shell idiom must mean default).
+    kern_name = (os.environ.get("LLMQ_DECODE_KERNEL") or "v1").lower()
+    if kern_name not in ("v1", "v2"):
+        raise ValueError(f"LLMQ_DECODE_KERNEL={kern_name!r} (want v1|v2)")
     kern = (
         pk.paged_decode_attention_pallas_v2
-        if os.environ.get("LLMQ_DECODE_KERNEL", "v1") == "v2"
+        if kern_name == "v2"
         else pk.paged_decode_attention_pallas
     )
 

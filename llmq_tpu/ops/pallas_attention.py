@@ -291,15 +291,10 @@ def _paged_decode_kernel_v2(
     group = q_ref.shape[1] // n_kv
     t = s * NC + c  # flattened grid step; buffer parity = t % 2
 
-    def page_live(seq, page):
-        """Page overlaps the attended span [ctx - window, ctx)."""
-        ctx = cl_ref[seq]
-        start = page * page_size
-        return jnp.logical_and(start < ctx, start + page_size > ctx - window)
-
     def chunk_bounds(seq, chunk):
         """(first, last+1) live page indices within the chunk (may be
-        empty). Live pages are a contiguous page range per sequence."""
+        empty; a page is live iff it overlaps the attended span
+        [ctx - window, ctx), which is contiguous per sequence)."""
         ctx = cl_ref[seq]
         lo = jnp.maximum(chunk * C, (ctx - window) // page_size)
         hi = jnp.minimum((chunk + 1) * C, (ctx + page_size - 1) // page_size)
@@ -496,8 +491,8 @@ def paged_decode_attention_pallas_v2(
         grid=(S, pages_per_seq // C),
         in_specs=[
             pl.BlockSpec((1, n_heads, d), lambda s, c, *_: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, n_heads, d), lambda s, c, *_: (s, 0, 0)),
         scratch_shapes=[

@@ -25,8 +25,12 @@ CTX = 330
 rng = np.random.default_rng(0)
 q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
 print(f"pool: {L*P*PAGE*NKV*D*2/2**30:.2f} GiB per side", flush=True)
-kp = jnp.asarray(rng.standard_normal((L, P, PAGE, NKV, D)), jnp.bfloat16)
-vp = jnp.asarray(rng.standard_normal((L, P, PAGE, NKV, D)), jnp.bfloat16)
+# Generate the pools ON DEVICE: a host float64 standard_normal at this
+# shape is ~9 GiB and swaps the machine before the TPU is ever touched.
+kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
+vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
+jax.block_until_ready((kp, vp))
+print("pool ready on device", flush=True)
 # distinct pages per seq, like the real allocator
 bt_np = np.zeros((S, PPS), np.int32)
 perm = np.arange(P)

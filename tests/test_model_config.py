@@ -1,0 +1,77 @@
+"""ModelConfig loading from HF checkpoint directories.
+
+EOS parity note: Llama-3-style checkpoints list the chat-turn stop ids
+(e.g. ``<|eot_id|>``) only in ``generation_config.json`` — the reference
+inherited multi-EOS stopping from vLLM's generation-config read
+(``llmq/workers/vllm_worker.py:148-165``); here ``from_pretrained`` must
+union both files' EOS sets so those models stop at turn boundaries.
+"""
+
+import json
+
+import pytest
+
+from llmq_tpu.models.config import ModelConfig
+
+pytestmark = pytest.mark.unit
+
+
+def _write_checkpoint_configs(path, config, generation_config=None):
+    base = dict(
+        model_type="llama",
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+    )
+    base.update(config)
+    (path / "config.json").write_text(json.dumps(base))
+    if generation_config is not None:
+        (path / "generation_config.json").write_text(
+            json.dumps(generation_config)
+        )
+
+
+def test_eos_only_in_generation_config(tmp_path):
+    """Extra EOS ids living only in generation_config.json are picked up."""
+    _write_checkpoint_configs(
+        tmp_path,
+        {"eos_token_id": 100},
+        {"eos_token_id": [100, 107, 109]},  # llama-3 style list
+    )
+    cfg = ModelConfig.from_pretrained(tmp_path)
+    assert cfg.eos_token_ids == (100, 107, 109)
+
+
+def test_eos_union_preserves_config_json_ids(tmp_path):
+    """Neither file's set is dropped; duplicates collapse, order stable."""
+    _write_checkpoint_configs(
+        tmp_path,
+        {"eos_token_id": [100, 101]},
+        {"eos_token_id": 101},
+    )
+    cfg = ModelConfig.from_pretrained(tmp_path)
+    assert cfg.eos_token_ids == (100, 101)
+
+
+def test_no_generation_config(tmp_path):
+    _write_checkpoint_configs(tmp_path, {"eos_token_id": 7})
+    cfg = ModelConfig.from_pretrained(tmp_path)
+    assert cfg.eos_token_ids == (7,)
+
+
+def test_generation_config_without_eos(tmp_path):
+    _write_checkpoint_configs(
+        tmp_path, {"eos_token_id": 7}, {"max_new_tokens": 3}
+    )
+    cfg = ModelConfig.from_pretrained(tmp_path)
+    assert cfg.eos_token_ids == (7,)
+
+
+def test_malformed_generation_config_ignored(tmp_path):
+    _write_checkpoint_configs(tmp_path, {"eos_token_id": 7})
+    (tmp_path / "generation_config.json").write_text("{not json")
+    cfg = ModelConfig.from_pretrained(tmp_path)
+    assert cfg.eos_token_ids == (7,)

@@ -18,6 +18,13 @@ from llmq_tpu.ops.dispatch import _WINDOW_DISABLED
 
 pytestmark = pytest.mark.unit
 
+# Both decode kernels share one contract; every decode test runs against
+# each. v2 additionally takes a chunk size — exercised separately below.
+DECODE_KERNELS = {
+    "v1": pk.paged_decode_attention_pallas,
+    "v2": pk.paged_decode_attention_pallas_v2,
+}
+
 
 def _rand(key, shape):
     return jax.random.normal(key, shape, jnp.float32) * 0.3
@@ -35,6 +42,7 @@ def _paged_setup(key, *, S, n_kv, d, page_size, pages_per_seq, ctx_lens):
     return k_pages, v_pages, jnp.asarray(bt), jnp.asarray(ctx_lens, jnp.int32)
 
 
+@pytest.mark.parametrize("kernel", DECODE_KERNELS.values(), ids=DECODE_KERNELS)
 @pytest.mark.parametrize(
     "n_heads,n_kv,window,softcap",
     [
@@ -45,7 +53,7 @@ def _paged_setup(key, *, S, n_kv, d, page_size, pages_per_seq, ctx_lens):
         (6, 3, 7, 20.0),  # everything at once, odd group
     ],
 )
-def test_paged_decode_matches_reference(n_heads, n_kv, window, softcap):
+def test_paged_decode_matches_reference(kernel, n_heads, n_kv, window, softcap):
     S, d, page_size, pages_per_seq = 5, 16, 8, 4
     ctx = [1, 7, 8, 19, 32]  # page-aligned and not, incl. full
     key = jax.random.key(0)
@@ -61,14 +69,15 @@ def test_paged_decode_matches_reference(n_heads, n_kv, window, softcap):
         q, k_pages, v_pages, bt, cl,
         scale=scale, sliding_window=window, softcap=softcap,
     )
-    out = pk.paged_decode_attention_pallas(
+    out = kernel(
         q, k_pages, v_pages, bt, cl, win,
         scale=scale, softcap=softcap, interpret=True,
     )
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
-def test_paged_decode_inactive_slot_no_nan():
+@pytest.mark.parametrize("kernel", DECODE_KERNELS.values(), ids=DECODE_KERNELS)
+def test_paged_decode_inactive_slot_no_nan(kernel):
     """ctx=0 slots must produce finite garbage, not NaN."""
     S, n_heads, n_kv, d, page_size, pages_per_seq = 2, 4, 2, 16, 8, 2
     key = jax.random.key(1)
@@ -77,12 +86,96 @@ def test_paged_decode_inactive_slot_no_nan():
         key, S=S, n_kv=n_kv, d=d, page_size=page_size,
         pages_per_seq=pages_per_seq, ctx_lens=[0, 5],
     )
-    out = pk.paged_decode_attention_pallas(
+    out = kernel(
         q, k_pages, v_pages, bt, cl,
         jnp.asarray([_WINDOW_DISABLED], jnp.int32),
         scale=d**-0.5, interpret=True,
     )
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("kernel", DECODE_KERNELS.values(), ids=DECODE_KERNELS)
+def test_paged_decode_stacked_layer_index(kernel):
+    """Layer-stacked pool + traced layer index addresses the right layer."""
+    S, n_heads, n_kv, d, page_size, pages_per_seq, L = 3, 4, 2, 16, 8, 3, 4
+    key = jax.random.key(3)
+    kq, kp_ = jax.random.split(key)
+    q = _rand(kq, (S, n_heads, d))
+    P = 1 + S * pages_per_seq
+    k_pages = _rand(kp_, (L, P, page_size, n_kv, d))
+    v_pages = _rand(jax.random.key(4), (L, P, page_size, n_kv, d))
+    bt = jnp.arange(1, 1 + S * pages_per_seq, dtype=jnp.int32).reshape(S, -1)
+    cl = jnp.asarray([5, 17, 24], jnp.int32)
+    scale = d**-0.5
+    win = jnp.asarray([_WINDOW_DISABLED], jnp.int32)
+    for li in (0, 2, L - 1):
+        ref = ref_ops.paged_decode_attention(
+            q, k_pages, v_pages, bt, cl, scale=scale,
+            layer=jnp.asarray(li, jnp.int32),
+        )
+        out = kernel(
+            q, k_pages, v_pages, bt, cl, win,
+            jnp.asarray(li, jnp.int32), scale=scale, interpret=True,
+        )
+        np.testing.assert_allclose(
+            out, ref, rtol=2e-5, atol=2e-5, err_msg=f"layer {li}"
+        )
+
+
+@pytest.mark.parametrize("pages_per_chunk", [1, 2, 3, 4])
+def test_paged_decode_v2_chunk_padding(pages_per_chunk):
+    """pages_per_seq % pages_per_chunk != 0 pads the block table with
+    never-live page-0 slots; results must be unaffected."""
+    S, n_heads, n_kv, d, page_size, pages_per_seq = 4, 8, 2, 16, 8, 5
+    ctx = [3, 8, 27, 40]  # last one spans all 5 real pages
+    key = jax.random.key(5)
+    kq, kp_ = jax.random.split(key)
+    q = _rand(kq, (S, n_heads, d))
+    k_pages, v_pages, bt, cl = _paged_setup(
+        kp_, S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=ctx,
+    )
+    scale = d**-0.5
+    ref = ref_ops.paged_decode_attention(
+        q, k_pages, v_pages, bt, cl, scale=scale
+    )
+    out = pk.paged_decode_attention_pallas_v2(
+        q, k_pages, v_pages, bt, cl,
+        jnp.asarray([_WINDOW_DISABLED], jnp.int32),
+        scale=scale, pages_per_chunk=pages_per_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_v2_dead_chunk_then_live():
+    """A narrow sliding window makes whole leading chunks dead; the first
+    *live* chunk must reset the accumulators (prev_dead logic), and a dead
+    chunk sandwiched after live ones must emit from the last live chunk."""
+    S, n_heads, n_kv, d, page_size = 3, 4, 2, 16, 8
+    pages_per_seq, C = 8, 2  # 4 chunks of 2 pages
+    # window 10 over ctx 60: live span [50, 60) → pages 6-7 → only the
+    # final chunk is live; chunks 0-2 are all dead (prev_dead must fire on
+    # chunk 3). ctx 20 w/ window 10 → span [10,20) → pages 1-2 → chunks
+    # 0 and 1 live, chunks 2-3 dead (nxt_dead must emit at chunk 1).
+    ctx = [60, 20, 9]
+    window = 10
+    key = jax.random.key(6)
+    kq, kp_ = jax.random.split(key)
+    q = _rand(kq, (S, n_heads, d))
+    k_pages, v_pages, bt, cl = _paged_setup(
+        kp_, S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=ctx,
+    )
+    scale = d**-0.5
+    ref = ref_ops.paged_decode_attention(
+        q, k_pages, v_pages, bt, cl, scale=scale, sliding_window=window
+    )
+    out = pk.paged_decode_attention_pallas_v2(
+        q, k_pages, v_pages, bt, cl,
+        jnp.asarray([window], jnp.int32),
+        scale=scale, pages_per_chunk=C, interpret=True,
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
 @pytest.mark.parametrize(
