@@ -12,7 +12,7 @@ import sys
 import time
 from typing import Optional
 
-from llmq_tpu.broker.manager import BrokerManager, results_queue_name
+from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import Result
 from llmq_tpu.core.pipeline import load_pipeline_config

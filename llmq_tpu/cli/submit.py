@@ -18,13 +18,13 @@ import logging
 import sys
 import time
 from pathlib import Path
-from typing import Any, AsyncIterator, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
 
 from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import Job, Result
 from llmq_tpu.core.pipeline import PipelineConfig, load_pipeline_config
-from llmq_tpu.core.template import create_job_from_row, resolve_template_value
+from llmq_tpu.core.template import create_job_from_row
 
 logger = logging.getLogger(__name__)
 

@@ -54,6 +54,14 @@ logger = logging.getLogger(__name__)
 # Row-chunk budget for streaming large 2-D tensors (bytes of source data).
 _CHUNK_BYTES = 256 * 2**20
 
+# numpy dtype name → safetensors storage tag (for detecting converting
+# loads in big2d's chunk budget).
+_NP_TO_ST_TAG = {
+    "float64": "F64", "float32": "F32", "float16": "F16",
+    "bfloat16": "BF16", "int64": "I64", "int32": "I32",
+    "int16": "I16", "int8": "I8", "uint8": "U8", "bool": "BOOL",
+}
+
 
 def _open_checkpoint(model_path: Path) -> Dict[str, Any]:
     """Map tensor name → shard file across all safetensors shards."""
@@ -101,9 +109,10 @@ class _TensorReader:
         """Read rows [lo:hi) of a tensor without materializing the rest."""
         return self._handle(name).get_slice(name)[lo:hi]
 
-    def itemsize(self, name: str) -> int:
-        """Bytes per element *as stored* (an fp32 checkpoint loaded as
-        bf16 still costs 4 host bytes per element while in flight)."""
+    def dtype_info(self, name: str) -> tuple:
+        """(itemsize, tag) of the tensor *as stored* — e.g. ``(4, "F32")``.
+        An fp32 checkpoint loaded as bf16 still costs 4 host bytes per
+        element while in flight. Unknown/old safetensors: assume fp32."""
         st_sizes = {
             "F64": 8, "F32": 4, "F16": 2, "BF16": 2, "F8_E4M3": 1,
             "F8_E5M2": 1, "I64": 8, "I32": 4, "I16": 2, "I8": 1,
@@ -111,9 +120,9 @@ class _TensorReader:
         }
         try:
             dt = str(self._handle(name).get_slice(name).get_dtype()).upper()
-            return st_sizes.get(dt, 4)
+            return st_sizes.get(dt, 4), dt
         except Exception:  # noqa: BLE001 — older safetensors: assume fp32
-            return 4
+            return 4, "F32"
 
     def shape(self, name: str) -> tuple:
         return tuple(self._handle(name).get_slice(name).get_shape())
@@ -239,13 +248,16 @@ def load_checkpoint(
     def big2d(our_name: str, hf_name: str, *, transpose: bool = False):
         """Stream a large 2-D tensor in bounded row chunks."""
         rows, cols = reader.shape(hf_name)
-        # Budget by stored + target element sizes when they differ: an
-        # fp32→bf16 load briefly holds BOTH the stored fp32 rows and the
-        # converted bf16 copy, so chunking by either size alone overshoots
-        # the documented _CHUNK_BYTES peak.
-        stored = reader.itemsize(hf_name)
-        target = np.dtype(np_dtype).itemsize
-        itemsize = stored + target if stored != target else target
+        # Budget by stored + target element sizes whenever the DTYPES
+        # differ (not just the sizes — fp16→bf16 is same-size but still
+        # copies): a converting load briefly holds BOTH the stored rows
+        # and the converted copy, so chunking by either size alone
+        # overshoots the documented _CHUNK_BYTES peak.
+        stored_size, stored_tag = reader.dtype_info(hf_name)
+        target = np.dtype(np_dtype)
+        target_tag = _NP_TO_ST_TAG.get(target.name)
+        converts = stored_tag != target_tag
+        itemsize = stored_size + target.itemsize if converts else target.itemsize
         chunk = max(1, _CHUNK_BYTES // max(1, cols * itemsize))
         shape = (cols, rows) if transpose else (rows, cols)
         axis = 1 if transpose else 0

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import math
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
@@ -150,7 +149,9 @@ class ModelConfig:
         if gen_path.exists():
             try:
                 gen = json.loads(gen_path.read_text())
-            except (OSError, json.JSONDecodeError):
+            # ValueError covers JSONDecodeError and UnicodeDecodeError
+            # (corrupt bytes must not abort model loading either).
+            except (OSError, ValueError):
                 gen = None
             # Tolerate any malformed shape, not just broken syntax.
             gen_eos = gen.get("eos_token_id") if isinstance(gen, dict) else None

@@ -20,7 +20,6 @@ moves the kv-head shard that lives with its tp rank).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -7,7 +7,6 @@ prefetch, ack/reject-requeue, redelivery cap → DLQ, TTL, purge, stats.
 import asyncio
 import json
 
-import pytest
 
 from llmq_tpu.broker.base import connect_broker, make_broker
 from llmq_tpu.broker.manager import BrokerManager

@@ -8,7 +8,6 @@ SURVEY.md §4 notes zero engine tests). Each family test:
 3. compares full-prompt logits (prefill) and per-step decode logits.
 """
 
-import json
 
 import jax
 import jax.numpy as jnp

@@ -4,7 +4,6 @@ the suite-level analogue of the reference's DummyWorker integration tests,
 but exercising the real engine."""
 
 import asyncio
-import json
 
 from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import Config

@@ -28,7 +28,6 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from llmq_tpu.engine.weights import load_checkpoint  # noqa: E402
-from llmq_tpu.models.config import ModelConfig  # noqa: E402
 
 safetensors_np = pytest.importorskip("safetensors.numpy")
 
