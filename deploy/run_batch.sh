@@ -1,0 +1,76 @@
+#!/bin/bash
+# One-host production drill: broker + N TPU workers + submit + drain,
+# with jobs/sec accounting. This is the plain-bash equivalent of the
+# SLURM scripts in this directory (and of the reference's
+# utils/run_llmq_benchmark.slurm:1-142), for TPU VMs you ssh into.
+#
+# Usage:
+#   deploy/run_batch.sh MODEL_PATH SOURCE [QUEUE]
+#
+#   MODEL_PATH  HF checkpoint directory
+#   SOURCE      jobs.jsonl, '-', or an HF dataset id (needs --map below)
+#   QUEUE       queue name (default: batch)
+#
+# Env knobs:
+#   N_WORKERS   workers on this host (default 1; >1 partitions chips)
+#   TP          tensor-parallel degree per worker (default: chips/N_WORKERS)
+#   MAP_ARGS    e.g. MAP_ARGS='--map prompt="Clean: {text}" --limit 10000'
+#   LLMQ_MAX_NUM_SEQS / LLMQ_QUEUE_PREFETCH  engine/prefetch tuning
+set -euo pipefail
+
+MODEL="${1:?usage: run_batch.sh MODEL_PATH SOURCE [QUEUE]}"
+SOURCE="${2:?usage: run_batch.sh MODEL_PATH SOURCE [QUEUE]}"
+QUEUE="${3:-batch}"
+N_WORKERS="${N_WORKERS:-1}"
+RUN_DIR="${RUN_DIR:-/tmp/llmq-run-$$}"
+mkdir -p "$RUN_DIR"
+
+# Tuned operating point (counterpart of the reference's
+# VLLM_MAX_NUM_SEQS=750 / VLLM_QUEUE_PREFETCH=1250 on 8xGPU —
+# utils/run_llmq_benchmark.slurm:32-33). On a 16 GiB v5e chip a ~3B
+# model sustains ~192 slots; prefetch ~1.5x slots keeps the batch fed.
+export LLMQ_MAX_NUM_SEQS="${LLMQ_MAX_NUM_SEQS:-192}"
+export LLMQ_QUEUE_PREFETCH="${LLMQ_QUEUE_PREFETCH:-300}"
+
+# 1. Broker (self-hosted native daemon; idempotent).
+LLMQ_BROKER_DATA="$RUN_DIR/broker" bash "$(dirname "$0")/start_broker.sh" --native
+export LLMQ_BROKER_URL="tcp://$(hostname):${LLMQ_BROKER_PORT:-5672}"
+
+# 2. Workers. N_WORKERS>1 partitions the host's chips with
+#    TPU_VISIBLE_CHIPS; each worker spans its share via tensor
+#    parallelism (-tp) unless TP says otherwise.
+N_CHIPS=$(python - <<'EOF'
+import jax
+print(len(jax.devices()))
+EOF
+)
+TP="${TP:-$((N_CHIPS / N_WORKERS))}"
+echo "chips=$N_CHIPS workers=$N_WORKERS tp=$TP"
+WORKER_PIDS=()
+for w in $(seq 0 $((N_WORKERS - 1))); do
+    CHIPS=$(seq -s, $((w * TP)) $((w * TP + TP - 1)))
+    echo "worker $w on chips $CHIPS"
+    TPU_VISIBLE_CHIPS="$CHIPS" \
+    nohup python -m llmq_tpu worker run "$MODEL" "$QUEUE" -tp "$TP" \
+        > "$RUN_DIR/worker-$w.log" 2>&1 &
+    WORKER_PIDS+=($!)
+done
+trap 'kill "${WORKER_PIDS[@]}" 2>/dev/null || true' EXIT
+
+# 3. Submit.
+T0=$(date +%s)
+# shellcheck disable=SC2086
+python -m llmq_tpu submit "$QUEUE" "$SOURCE" ${MAP_ARGS:-}
+
+# 4. Drain results to disk (idle-timeout exits when the queue is done).
+python -m llmq_tpu receive "$QUEUE" --timeout 120 > "$RUN_DIR/results.jsonl"
+T1=$(date +%s)
+
+# 5. Accounting (same post-hoc jobs/sec the reference computes —
+#    utils/run_llmq_benchmark.slurm:112-113).
+N=$(wc -l < "$RUN_DIR/results.jsonl")
+DUR=$((T1 - T0))
+echo "=============================================="
+echo "$N results in ${DUR}s -> $(python -c "print(f'{$N/max(1,$DUR):.2f}')") jobs/sec"
+echo "results: $RUN_DIR/results.jsonl"
+python -m llmq_tpu status "$QUEUE"

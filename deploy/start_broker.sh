@@ -1,0 +1,62 @@
+#!/bin/bash
+# Start the self-hosted llmq-tpu broker daemon (the RabbitMQ-less
+# production default). Functional counterpart of the reference's
+# Singularity RabbitMQ bootstrap (utils/start_singularity_broker.sh:1-43)
+# — but no container runtime is needed: the daemon is part of the package
+# (asyncio) or a single dependency-free C++ binary (--native).
+#
+# Usage:
+#   deploy/start_broker.sh [--native]
+#
+# Env:
+#   LLMQ_BROKER_PORT    (default 5672)
+#   LLMQ_BROKER_DATA    journal dir (default $HOME/llmq-broker-data)
+#   LLMQ_BROKER_PIDFILE (default $LLMQ_BROKER_DATA/brokerd.pid)
+set -euo pipefail
+
+PORT="${LLMQ_BROKER_PORT:-5672}"
+DATA="${LLMQ_BROKER_DATA:-$HOME/llmq-broker-data}"
+PIDFILE="${LLMQ_BROKER_PIDFILE:-$DATA/brokerd.pid}"
+NATIVE_FLAG="${1:-}"
+
+mkdir -p "$DATA"
+
+# Stop a previous instance (pidfile-based: pkill -f would match ourselves).
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+    echo "Stopping existing broker (pid $(cat "$PIDFILE"))..."
+    kill "$(cat "$PIDFILE")" && sleep 1
+fi
+
+if [ "$NATIVE_FLAG" = "--native" ]; then
+    # Build the C++ daemon if missing (plain C++17, no deps).
+    REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+    BIN="$REPO_DIR/native/bin/llmq-tpu-brokerd"
+    [ -x "$BIN" ] || make -C "$REPO_DIR/native"
+    nohup "$BIN" --port "$PORT" --persist-dir "$DATA" \
+        > "$DATA/brokerd.log" 2>&1 &
+else
+    nohup python -m llmq_tpu broker serve --port "$PORT" --persist-dir "$DATA" \
+        > "$DATA/brokerd.log" 2>&1 &
+fi
+echo $! > "$PIDFILE"
+
+# Wait for the port to accept connections.
+for _ in $(seq 1 30); do
+    if python - "$PORT" <<'EOF'
+import socket, sys
+s = socket.socket()
+s.settimeout(1)
+try:
+    s.connect(("127.0.0.1", int(sys.argv[1])))
+except OSError:
+    raise SystemExit(1)
+EOF
+    then
+        echo "Broker up on port $PORT (journal: $DATA, pid $(cat "$PIDFILE"))"
+        echo "export LLMQ_BROKER_URL=tcp://$(hostname):$PORT"
+        exit 0
+    fi
+    sleep 1
+done
+echo "Broker failed to come up; see $DATA/brokerd.log" >&2
+exit 1

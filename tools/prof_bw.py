@@ -1,0 +1,37 @@
+"""HBM bandwidth probe: chained elementwise passes over a 512 MiB array.
+
+The multipliers/addends must actually change values in bf16, or XLA can
+fold the op away and the GB/s figure overstates the real bandwidth:
+1.0078125 = 1 + 2^-7 is exactly representable in bf16 (8 mantissa bits),
+and alternating *x/÷x keeps the values bounded across iterations.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_STEP = 1.0078125  # 1 + 2^-7: representable in bf16, not folded away
+
+x = jnp.asarray(np.random.default_rng(0).standard_normal((1 << 28,)), jnp.bfloat16)  # 512 MiB
+g_up = jax.jit(lambda x: x * jnp.bfloat16(_STEP))
+g_dn = jax.jit(lambda x: x * jnp.bfloat16(1.0 / _STEP))
+x = g_dn(g_up(x)); jax.block_until_ready(x)
+t0 = time.monotonic()
+for _ in range(10):  # chained: args differ every call, values stay bounded
+    x = g_dn(g_up(x))
+jax.block_until_ready(x); dt = (time.monotonic() - t0) / 20
+print(f"chained copy 512MiB: {dt*1e3:.2f} ms -> {2*x.nbytes/dt/1e9:.0f} GB/s r+w")
+
+# chained read+write pass with a reduction: scale keeps the array changing
+h = jax.jit(
+    lambda x, s: (x * jnp.bfloat16(_STEP), jnp.sum(x.astype(jnp.float32)))
+)
+# Warm up with an f32 *array* for s — a Python float would trace a
+# different (weak-typed) signature and push the recompile into the loop.
+x, s = h(x, jnp.float32(0)); jax.block_until_ready(s)
+t0 = time.monotonic()
+for _ in range(20):
+    x, s = h(x, s)
+jax.block_until_ready(s); dt = (time.monotonic() - t0) / 20
+print(f"chained r+w pass: {dt*1e3:.2f} ms -> {2*x.nbytes/dt/1e9:.0f} GB/s")
