@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import queue
 import threading
 import time
@@ -243,6 +244,8 @@ class EngineCore:
         self.prefills = 0
         self._started_at = time.monotonic()
         self._resync()
+        if os.environ.get("LLMQ_PARAM_AUTO_LAYOUT", "0") == "1":
+            self._optimize_param_layouts()
 
     # --- compilation ------------------------------------------------------
     def _build_steps(self) -> None:
@@ -358,35 +361,94 @@ class EngineCore:
 
         repl, slot1, slot2 = self._repl, self._slot1, self._slot2
         kv = self._kv_format
-        ps = self._param_shardings
         st_sh = (slot1, slot1, slot2, slot1, slot2, slot1, slot1, slot1,
                  slot1, slot1, slot1, slot2)
         self._st_shardings = st_sh
         self._prefill_arg_shardings = (repl,) * 12
-        # One decode executable per sampler variant actually used: a greedy
-        # batch must not pay the [S, V] vocab sort (sampling.required_mode).
-        # jit compiles lazily, so unused variants cost nothing.
+        self._decode_fn = decode_step
+        self._prefill_fn = prefill_step
+        self._make_jits(self._param_shardings)
+
+    def _make_jits(self, param_spec) -> None:
+        """(Re)build the per-mode compiled steps with ``param_spec`` as the
+        parameter in_sharding (NamedShardings, or pinned Formats after
+        ``_optimize_param_layouts``). One executable per sampler variant
+        actually used: a greedy batch must not pay the [S, V] vocab sort
+        (sampling.required_mode); jit compiles lazily, so unused variants
+        cost nothing. Prefill gets the same per-mode treatment (~19 ms per
+        8x256 chunk of filter machinery at a 152k vocab, measured round 3).
+        """
+        repl, slot1 = self._repl, self._slot1
+        kv = self._kv_format
+        st_sh = self._st_shardings
         self._decode_jits = {
             mode: jax.jit(
-                partial(decode_step, mode=mode),
-                in_shardings=(ps, kv, kv, st_sh),
+                partial(self._decode_fn, mode=mode),
+                in_shardings=(param_spec, kv, kv, st_sh),
                 out_shardings=(slot1, kv, kv, st_sh),
                 donate_argnums=(1, 2, 3),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
-        # Prefill gets the same per-mode treatment as decode: an all-greedy
-        # chunk must not pay the [B, V] vocab sort + filter machinery
-        # (~19 ms per 8x256 chunk at a 152k vocab, measured round 3).
         self._prefill_jits = {
             mode: jax.jit(
-                partial(prefill_step, mode=mode),
-                in_shardings=(ps, kv, kv) + (repl,) * 12 + (st_sh,),
+                partial(self._prefill_fn, mode=mode),
+                in_shardings=(param_spec, kv, kv) + (repl,) * 12 + (st_sh,),
                 out_shardings=(repl, kv, kv, st_sh),
                 donate_argnums=(1, 2, 15),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
+
+    def _optimize_param_layouts(self) -> None:
+        """Pin parameters to the decode executable's PREFERRED layouts
+        (LLMQ_PARAM_AUTO_LAYOUT=1). With default row-major inputs XLA
+        re-layouts some stacked weights around every layer-scan slice
+        (o/k/v_proj transpose copies, ~1.1 ms/step at 3B/192 slots —
+        measured round 4); compiling once with AUTO input layouts and
+        re-putting the params in whatever XLA chose removes those copies
+        for every subsequent step. Costs one extra compile at startup."""
+        from jax.experimental.layout import Format, Layout
+
+        auto_ps = jax.tree.map(
+            lambda sh: Format(Layout.AUTO, sh), self._param_shardings
+        )
+        kv = self._kv_format
+        probe = jax.jit(
+            partial(self._decode_fn, mode="greedy"),
+            in_shardings=(auto_ps, kv, kv, self._st_shardings),
+            out_shardings=(self._slot1, kv, kv, self._st_shardings),
+            donate_argnums=(1, 2, 3),
+        )
+        # Runs after _resync, so the state spec comes straight from the
+        # live device state — no hand-maintained shape list to drift.
+        sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        try:
+            compiled = probe.lower(
+                jax.tree.map(sds, self.params),
+                sds(self.k_pages),
+                sds(self.v_pages),
+                jax.tree.map(sds, self._dev_state),
+            ).compile()
+            formats = compiled.input_formats[0][0]
+        except Exception:  # noqa: BLE001 — backend without layout support
+            logger.exception("param auto-layout probe failed; keeping defaults")
+            return
+
+        def reput(leaf, fmt):
+            # Leaf-by-leaf with immediate delete: a whole-tree device_put
+            # would briefly hold TWO full parameter copies in HBM, which
+            # the auto-sized KV pool has not left room for. The in-flight
+            # copy holds its own buffer reference, so delete() is safe —
+            # but device_put returns the SAME array when the layout
+            # already matches, and that one must survive.
+            new = jax.device_put(leaf, fmt)
+            if new is not leaf:
+                leaf.delete()
+            return new
+
+        self.params = jax.tree.map(reput, self.params, formats)
+        self._make_jits(formats)
 
     def _auto_num_pages(self) -> int:
         """Size the KV pool from device HBM (vLLM gpu_memory_utilization
