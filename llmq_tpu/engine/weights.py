@@ -142,6 +142,13 @@ def _write_block(buf: jnp.ndarray, block: jnp.ndarray, start, *, axis: int):
     return jax.lax.dynamic_update_slice(buf, block, tuple(idx))
 
 
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("ndim",))
+def _write_block_at(buf: jnp.ndarray, block: jnp.ndarray, starts, *, ndim: int):
+    """Multi-axis block write (expert stacks stream per (layer, expert))."""
+    idx = tuple(starts) + (0,) * (buf.ndim - ndim)
+    return jax.lax.dynamic_update_slice(buf, block, idx)
+
+
 class _Streamer:
     """Allocates device buffers and fills them block-by-block in place."""
 
@@ -164,14 +171,15 @@ class _Streamer:
         )
         return fn()
 
-    def _block_sharding(self, sharding, axis: int):
-        """The full-buffer sharding with the streamed axis unsharded (a
-        block spans only part of that axis, so it can't keep a sharded
+    def _block_sharding(self, sharding, axes):
+        """The full-buffer sharding with the streamed axes unsharded (a
+        block spans only part of those axes, so it can't keep a sharded
         spec there; every other axis keeps its placement)."""
         if sharding is None:
             return None
         parts = list(sharding.spec) + [None] * 8
-        parts[axis] = None
+        for axis in axes:
+            parts[axis] = None
         return NamedSharding(self.mesh, P(*parts[: len(sharding.spec)]))
 
     def stream(
@@ -179,23 +187,31 @@ class _Streamer:
         name: str,
         shape: tuple,
         dtype,
-        blocks,  # iterable of (start, np.ndarray) along `axis`
+        blocks,  # iterable of (start, np.ndarray); int start → `axis`,
+        #          tuple start → offsets along the leading axes
         *,
         axis: int = 0,
     ) -> jnp.ndarray:
         sharding = self._sharding(name)
         buf = self._alloc(shape, dtype, sharding)
-        bsh = self._block_sharding(sharding, axis)
+        bsh_cache: dict = {}
         for start, block in blocks:
             host = np.ascontiguousarray(block).astype(
                 _np_dtype(dtype), copy=False
             )
+            axes = tuple(range(len(start))) if isinstance(start, tuple) else (axis,)
+            if axes not in bsh_cache:
+                bsh_cache[axes] = self._block_sharding(sharding, axes)
+            bsh = bsh_cache[axes]
             dev = (
                 jax.device_put(host, bsh)
                 if bsh is not None
                 else jax.device_put(host)
             )
-            buf = _write_block(buf, dev, start, axis=axis)
+            if isinstance(start, tuple):
+                buf = _write_block_at(buf, dev, start, ndim=len(start))
+            else:
+                buf = _write_block(buf, dev, start, axis=axis)
         return buf
 
 
@@ -294,13 +310,58 @@ def load_checkpoint(
         ("k_proj", "self_attn.k_proj"),
         ("v_proj", "self_attn.v_proj"),
         ("o_proj", "self_attn.o_proj"),
-        ("gate_proj", "mlp.gate_proj"),
-        ("up_proj", "mlp.up_proj"),
-        ("down_proj", "mlp.down_proj"),
     ):
         layers[ours] = stacked(
             ours, f"model.layers.{{i}}.{theirs}.weight", transpose=True
         )
+    if config.num_experts:
+        E = config.num_experts
+
+        def expert_stacked(our_name: str, fmt: str):
+            """Stream a [L, E, in, out] expert stack one (layer, expert)
+            tensor at a time — host RSS stays ~1 expert tensor."""
+            shape0 = reader.shape(fmt.format(i=0, e=0))[::-1]  # transposed
+            full = (L, E, *shape0)
+
+            def blocks():
+                for i in range(L):
+                    for e in range(E):
+                        arr = reader.get(fmt.format(i=i, e=e)).T
+                        yield (i, e), arr[None, None]
+
+            return streamer.stream(f"layers.{our_name}", full, dtype, blocks())
+
+        layers["router"] = stacked(
+            "router", "model.layers.{i}.mlp.gate.weight", transpose=True
+        )
+        for ours, theirs in (
+            ("expert_gate_proj", "gate_proj"),
+            ("expert_up_proj", "up_proj"),
+            ("expert_down_proj", "down_proj"),
+        ):
+            layers[ours] = expert_stacked(
+                ours, f"model.layers.{{i}}.mlp.experts.{{e}}.{theirs}.weight"
+            )
+        if config.shared_expert_intermediate_size:
+            for ours, theirs in (
+                ("shared_gate_proj", "shared_expert.gate_proj"),
+                ("shared_up_proj", "shared_expert.up_proj"),
+                ("shared_down_proj", "shared_expert.down_proj"),
+                ("shared_expert_gate", "shared_expert_gate"),
+            ):
+                layers[ours] = stacked(
+                    ours, f"model.layers.{{i}}.mlp.{theirs}.weight",
+                    transpose=True,
+                )
+    else:
+        for ours, theirs in (
+            ("gate_proj", "mlp.gate_proj"),
+            ("up_proj", "mlp.up_proj"),
+            ("down_proj", "mlp.down_proj"),
+        ):
+            layers[ours] = stacked(
+                ours, f"model.layers.{{i}}.{theirs}.weight", transpose=True
+            )
     if config.attention_bias:
         for ours, theirs in (
             ("q_bias", "self_attn.q_proj"),

@@ -55,6 +55,12 @@ class ModelConfig:
     sliding_window: Optional[int] = None
     sliding_window_pattern: int = 1  # every Nth layer is global (Gemma-2: 2)
     query_pre_attn_scalar: Optional[float] = None  # Gemma-2 attn scale
+    # Mixture-of-experts (qwen2_moe/qwen3_moe): None → dense MLP.
+    num_experts: Optional[int] = None
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: Optional[int] = None
+    shared_expert_intermediate_size: Optional[int] = None  # qwen2_moe only
+    norm_topk_prob: bool = False  # renormalize the top-k routing weights
     eos_token_ids: Tuple[int, ...] = ()
     bos_token_id: Optional[int] = None
     model_type: str = "llama"
@@ -108,7 +114,7 @@ class ModelConfig:
                 attention_bias=hf.get("attention_bias", False),
                 sliding_window=hf.get("sliding_window"),
             )
-        if mt in ("qwen2", "qwen2_moe"):
+        if mt == "qwen2":
             # Qwen2 ships QKV bias; sliding window usually disabled in config.
             return cls(
                 **common,
@@ -116,6 +122,30 @@ class ModelConfig:
                 sliding_window=(
                     hf.get("sliding_window") if hf.get("use_sliding_window") else None
                 ),
+            )
+        if mt in ("qwen2_moe", "qwen3_moe"):
+            # Sparse-MoE decoders. Only the uniform all-sparse layout is
+            # supported (every public qwen-MoE checkpoint uses it); a
+            # config interleaving dense layers must fail loudly rather
+            # than produce silently-wrong numerics.
+            if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+                raise ValueError(
+                    f"{mt} with interleaved dense layers "
+                    "(mlp_only_layers/decoder_sparse_step) is not supported"
+                )
+            return cls(
+                **common,
+                attention_bias=(mt == "qwen2_moe"),
+                qk_norm=(mt == "qwen3_moe"),
+                num_experts=hf["num_experts"],
+                num_experts_per_tok=hf["num_experts_per_tok"],
+                moe_intermediate_size=hf["moe_intermediate_size"],
+                shared_expert_intermediate_size=(
+                    hf.get("shared_expert_intermediate_size")
+                    if mt == "qwen2_moe"
+                    else None
+                ),
+                norm_topk_prob=hf.get("norm_topk_prob", False),
             )
         if mt == "qwen3":
             return cls(**common, attention_bias=False, qk_norm=True)
@@ -185,6 +215,26 @@ class ModelConfig:
         h, v, l = self.hidden_size, self.vocab_size, self.num_layers
         d = self.head_dim_
         attn = h * d * self.num_heads + 2 * h * d * self.num_kv_heads + d * self.num_heads * h
-        mlp = 3 * h * self.intermediate_size
+        if self.num_experts:
+            mlp = 3 * h * (self.moe_intermediate_size or 0) * self.num_experts
+            mlp += h * self.num_experts  # router
+            if self.shared_expert_intermediate_size:
+                mlp += 3 * h * self.shared_expert_intermediate_size + h
+        else:
+            mlp = 3 * h * self.intermediate_size
         embed = v * h * (1 if self.tie_word_embeddings else 2)
         return l * (attn + mlp + 2 * h) + embed + h
+
+    def active_params_per_token(self) -> int:
+        """Params touched per token (MoE: only routed + shared experts) —
+        the MFU-relevant count for throughput estimates."""
+        if not self.num_experts:
+            return self.num_params()
+        h, l = self.hidden_size, self.num_layers
+        dense_like = dataclasses.replace(self, num_experts=None)
+        per_layer_moe = 3 * h * (self.moe_intermediate_size or 0)
+        active = self.num_experts_per_tok * per_layer_moe
+        if self.shared_expert_intermediate_size:
+            active += 3 * h * self.shared_expert_intermediate_size + h
+        active += h * self.num_experts  # router
+        return dense_like.num_params() - l * 3 * h * self.intermediate_size + l * active

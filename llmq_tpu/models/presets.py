@@ -78,6 +78,17 @@ PRESETS = {
         num_kv_heads=8, head_dim=256, intermediate_size=14336,
         query_pre_attn_scalar=256, max_position_embeddings=8192, **_G,
     ),
+    # Sparse MoE (Qwen1.5-MoE-A2.7B card): 60 experts, 4 routed + 1
+    # shared per token — exercises the grouped-matmul expert path at a
+    # realistic expert count.
+    "qwen1.5-moe-a2.7b": ModelConfig(
+        vocab_size=151936, hidden_size=2048, num_layers=24, num_heads=16,
+        num_kv_heads=16, intermediate_size=5632, model_type="qwen2_moe",
+        attention_bias=True, rope_theta=1_000_000.0,
+        max_position_embeddings=8192, num_experts=60, num_experts_per_tok=4,
+        moe_intermediate_size=1408, shared_expert_intermediate_size=5632,
+        norm_topk_prob=False, tie_word_embeddings=False,
+    ),
 }
 
 

@@ -104,6 +104,60 @@ def _mlp(h: jnp.ndarray, lp: Params, activation: str) -> jnp.ndarray:
     return (act * up) @ lp["down_proj"]
 
 
+def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
+    """Sparse mixture-of-experts MLP (qwen2_moe/qwen3_moe semantics),
+    TPU-first: tokens are sorted by routed expert and each expert's group
+    runs as one ``jax.lax.ragged_dot`` (grouped matmul on the MXU) — the
+    dense-per-expert loop a torch port would write is E/k× the FLOPs.
+
+    Routing follows HF Qwen2MoeSparseMoeBlock: softmax over ALL experts
+    in f32, then top-k (optionally renormalized), plus qwen2_moe's
+    always-on shared expert blended through a sigmoid gate.
+    """
+    *lead, H = h.shape
+    x = h.reshape(-1, H)
+    N = x.shape[0]
+    E = config.num_experts
+    k = config.num_experts_per_tok
+
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_w, top_e = jax.lax.top_k(probs, k)  # [N, k]
+    if config.norm_topk_prob:
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+
+    # Sort the N*k (token, expert) assignments by expert id so each
+    # expert's tokens are one contiguous group for ragged_dot.
+    flat_e = top_e.reshape(-1)  # [N*k]
+    order = jnp.argsort(flat_e)  # stable: ties keep token order
+    token_of = order // k  # source token per sorted row
+    xs = x[token_of]  # [N*k, H] gathered, grouped by expert
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    gate = jax.lax.ragged_dot(xs, lp["expert_gate_proj"], group_sizes)
+    up = jax.lax.ragged_dot(xs, lp["expert_up_proj"], group_sizes)
+    act = jax.nn.silu(gate) * up
+    down = jax.lax.ragged_dot(act, lp["expert_down_proj"], group_sizes)
+
+    w_sorted = top_w.reshape(-1)[order].astype(down.dtype)  # [N*k]
+    out = jax.ops.segment_sum(
+        down * w_sorted[:, None], token_of, num_segments=N
+    ).astype(h.dtype)
+
+    if config.shared_expert_intermediate_size:
+        shared = _mlp(
+            x,
+            {
+                "gate_proj": lp["shared_gate_proj"],
+                "up_proj": lp["shared_up_proj"],
+                "down_proj": lp["shared_down_proj"],
+            },
+            config.activation,
+        )
+        out = out + jax.nn.sigmoid(x @ lp["shared_expert_gate"]) * shared
+    return out.reshape(*lead, H)
+
+
 # ---------------------------------------------------------------------------
 # Transformer
 # ---------------------------------------------------------------------------
@@ -161,7 +215,11 @@ class Transformer:
             )
         h = h + attn_proj
         mlp_in = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, one_plus=one_plus)
-        mlp_out = _mlp(mlp_in, lp, cfg.activation)
+        mlp_out = (
+            _moe_mlp(mlp_in, lp, cfg)
+            if cfg.num_experts
+            else _mlp(mlp_in, lp, cfg.activation)
+        )
         if cfg.post_norms:
             mlp_out = rms_norm(
                 mlp_out, lp["post_mlp_norm"], cfg.rms_norm_eps, one_plus=one_plus
@@ -356,10 +414,23 @@ def init_params(
         "k_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
         "v_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
         "o_proj": w(next(keys), (L, cfg.num_heads * d, H), cfg.num_heads * d),
-        "gate_proj": w(next(keys), (L, H, I), H),
-        "up_proj": w(next(keys), (L, H, I), H),
-        "down_proj": w(next(keys), (L, I, H), I),
     }
+    if cfg.num_experts:
+        E, Im = cfg.num_experts, cfg.moe_intermediate_size
+        layers["router"] = w(next(keys), (L, H, E), H)
+        layers["expert_gate_proj"] = w(next(keys), (L, E, H, Im), H)
+        layers["expert_up_proj"] = w(next(keys), (L, E, H, Im), H)
+        layers["expert_down_proj"] = w(next(keys), (L, E, Im, H), Im)
+        if cfg.shared_expert_intermediate_size:
+            Is = cfg.shared_expert_intermediate_size
+            layers["shared_gate_proj"] = w(next(keys), (L, H, Is), H)
+            layers["shared_up_proj"] = w(next(keys), (L, H, Is), H)
+            layers["shared_down_proj"] = w(next(keys), (L, Is, H), Is)
+            layers["shared_expert_gate"] = w(next(keys), (L, H, 1), H)
+    else:
+        layers["gate_proj"] = w(next(keys), (L, H, I), H)
+        layers["up_proj"] = w(next(keys), (L, H, I), H)
+        layers["down_proj"] = w(next(keys), (L, I, H), I)
     if cfg.attention_bias:
         layers["q_bias"] = jnp.zeros((L, cfg.num_heads * d), dtype)
         layers["k_bias"] = jnp.zeros((L, cfg.num_kv_heads * d), dtype)

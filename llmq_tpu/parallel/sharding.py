@@ -58,10 +58,28 @@ def param_pspecs(config: ModelConfig, tp: int) -> Params:
         "k_proj": P(None, None, col_kv),
         "v_proj": P(None, None, col_kv),
         "o_proj": P(None, col_q, None),
-        "gate_proj": P(None, None, col_mlp),
-        "up_proj": P(None, None, col_mlp),
-        "down_proj": P(None, col_mlp, None),
     }
+    if config.num_experts:
+        # MoE: column/row-parallel INSIDE each expert (same Megatron
+        # pattern as the dense MLP, applied to the grouped matmuls); the
+        # router and tiny shared-expert gate stay replicated. Sharding
+        # the expert axis instead (classic EP) would need all_to_all
+        # token exchange — the per-expert split needs none.
+        col_moe = _tp_dim(config.moe_intermediate_size or 0, tp)
+        layers["router"] = P()
+        layers["expert_gate_proj"] = P(None, None, None, col_moe)
+        layers["expert_up_proj"] = P(None, None, None, col_moe)
+        layers["expert_down_proj"] = P(None, None, col_moe, None)
+        if config.shared_expert_intermediate_size:
+            col_sh = _tp_dim(config.shared_expert_intermediate_size, tp)
+            layers["shared_gate_proj"] = P(None, None, col_sh)
+            layers["shared_up_proj"] = P(None, None, col_sh)
+            layers["shared_down_proj"] = P(None, col_sh, None)
+            layers["shared_expert_gate"] = P()
+    else:
+        layers["gate_proj"] = P(None, None, col_mlp)
+        layers["up_proj"] = P(None, None, col_mlp)
+        layers["down_proj"] = P(None, col_mlp, None)
     if config.attention_bias:
         layers["q_bias"] = P(None, col_q)
         layers["k_bias"] = P(None, col_kv)

@@ -54,6 +54,19 @@ def _hf_tiny(family: str, tmp_path):
             final_logit_softcapping=30.0,
         )
         model = transformers.Gemma2ForCausalLM(cfg)
+    elif family == "qwen2_moe":
+        cfg = transformers.Qwen2MoeConfig(
+            **common,
+            rope_theta=10000.0,
+            num_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            shared_expert_intermediate_size=48,
+            norm_topk_prob=False,
+            decoder_sparse_step=1,
+            mlp_only_layers=[],
+        )
+        model = transformers.Qwen2MoeForCausalLM(cfg)
     else:
         raise ValueError(family)
     model = model.eval().to(torch.float32)
@@ -75,7 +88,7 @@ def _sequential_block_table(num_seqs):
     ).reshape(num_seqs, PAGES_PER_SEQ)
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2"])
+@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2", "qwen2_moe"])
 def test_prefill_logits_match_hf(family, tmp_path):
     path, hf_model = _hf_tiny(family, tmp_path)
     config, model, params = _our_model(path)
@@ -105,7 +118,7 @@ def test_prefill_logits_match_hf(family, tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2"])
+@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2", "qwen2_moe"])
 def test_decode_matches_hf_stepwise(family, tmp_path):
     """Prefill a prompt, then greedy-decode 6 tokens; every step's logits
     must match HF's full-context forward at that position."""
