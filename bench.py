@@ -317,8 +317,11 @@ def main() -> None:
 
     tok_s = out_tokens / elapsed
     tok_s_chip = tok_s / len(devices)
-    baseline = 1500.0 * 9e9 / config.num_params()
-    mfu = (tok_s * 2.0 * config.num_params()) / (
+    # MoE presets: throughput scales with ACTIVE params per token (the
+    # FLOPs actually spent), not the total parameter count.
+    active = config.active_params_per_token()
+    baseline = 1500.0 * 9e9 / active
+    mfu = (tok_s * 2.0 * active) / (
         peak_flops_per_chip(devices) * len(devices)
     )
     payload = {

@@ -137,6 +137,9 @@ class ModelConfig:
                 **common,
                 attention_bias=(mt == "qwen2_moe"),
                 qk_norm=(mt == "qwen3_moe"),
+                sliding_window=(
+                    hf.get("sliding_window") if hf.get("use_sliding_window") else None
+                ),
                 num_experts=hf["num_experts"],
                 num_experts_per_tok=hf["num_experts_per_tok"],
                 moe_intermediate_size=hf["moe_intermediate_size"],

@@ -136,7 +136,10 @@ def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
 
     gate = jax.lax.ragged_dot(xs, lp["expert_gate_proj"], group_sizes)
     up = jax.lax.ragged_dot(xs, lp["expert_up_proj"], group_sizes)
-    act = jax.nn.silu(gate) * up
+    if config.activation == "gelu_tanh":
+        act = jax.nn.gelu(gate, approximate=True) * up
+    else:
+        act = jax.nn.silu(gate) * up
     down = jax.lax.ragged_dot(act, lp["expert_down_proj"], group_sizes)
 
     w_sorted = top_w.reshape(-1)[order].astype(down.dtype)  # [N*k]
