@@ -56,6 +56,14 @@ class TPUWorker(BaseWorker):
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
+        # Prefetch must exceed the continuous batch's slot count or the
+        # engine starves: with slots=192 and the default prefetch=100,
+        # occupancy silently caps at 52%. When the user didn't pass an
+        # explicit -c, keep ~1.5x slots in flight (the reference's tuned
+        # ratio: VLLM_QUEUE_PREFETCH=1250 for 750 slots).
+        slots = max_num_seqs or self.config.max_num_seqs
+        if kwargs.get("concurrency") is None and slots:
+            self.concurrency = max(self.concurrency, slots + slots // 2)
 
     # --- identity (reference vllm_worker.py:39-50) ------------------------
     def _generate_worker_id(self) -> str:
