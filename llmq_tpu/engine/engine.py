@@ -99,6 +99,14 @@ class EngineConfig:
     kv_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
+    # Chunked prefill: process prompts in fixed-size chunks of this many
+    # positions against the paged cache instead of whole-prompt buckets.
+    # One compiled executable for ANY prompt length (no per-bucket
+    # variants, ≤ chunk−1 positions of padding), and decode steps for the
+    # already-running batch interleave between chunks, so a long prompt
+    # no longer stalls every running slot for its whole prefill.
+    # None → bucketed whole-prompt prefill (the default).
+    prefill_chunk_size: Optional[int] = None
     # Admission deferral waits for a full prefill chunk's worth of free
     # slots (throughput), but never keeps *deferring admissible work* for
     # longer than this (latency floor for trickle arrivals; the clock
@@ -317,17 +325,16 @@ class EngineCore:
             out = jnp.where(active, next_tokens, 0)
             return out, kp, vp, advance_state(st, out, active)
 
-        def prefill_step(params, kp, vp, p_tokens, p_lengths, p_bt, p_slots,
-                         p_keys, p_steps, p_temps, p_topks, p_topps,
-                         p_limits, p_mins, p_stopids, st, *, mode):
-            logits, kp, vp = model.prefill(
-                params, p_tokens, p_lengths, kp, vp, p_bt
-            )
+        def sample_and_scatter(logits, valid, p_lengths, p_bt, p_slots,
+                               p_keys, p_steps, p_temps, p_topks, p_topps,
+                               p_limits, p_mins, p_stopids, st, *, mode):
+            """Shared tail of the prefill variants: sample each valid
+            row's first token and scatter the row into the decode state
+            (invalid rows route out of range and are dropped)."""
             logits = suppress_stops(logits, p_stopids, p_steps, p_mins)
             nt = sample_tokens(
                 logits, p_keys, p_steps, p_temps, p_topks, p_topps, mode=mode
             )
-            valid = p_slots >= 0
             out = jnp.where(valid, nt, 0)
             new_steps = p_steps + 1
             hit_stop = jnp.logical_and(
@@ -339,8 +346,6 @@ class EngineCore:
                     jnp.logical_or(hit_stop, new_steps >= p_limits)
                 ),
             )
-            # Scatter the freshly prefilled rows into the decode state;
-            # padded rows (slot -1) route out of range and are dropped.
             idx = jnp.where(valid, p_slots, S)
             (tokens, ctx, bt, active, keys, steps, temps, topks, topps,
              limits, mins, stop_ids) = st
@@ -358,6 +363,37 @@ class EngineCore:
                 mins.at[idx].set(p_mins, mode="drop"),
                 stop_ids.at[idx].set(p_stopids, mode="drop"),
             )
+            return out, st
+
+        def prefill_step(params, kp, vp, p_tokens, p_lengths, p_bt, p_slots,
+                         p_keys, p_steps, p_temps, p_topks, p_topps,
+                         p_limits, p_mins, p_stopids, st, *, mode):
+            logits, kp, vp = model.prefill(
+                params, p_tokens, p_lengths, kp, vp, p_bt
+            )
+            out, st = sample_and_scatter(
+                logits, p_slots >= 0, p_lengths, p_bt, p_slots, p_keys,
+                p_steps, p_temps, p_topks, p_topps, p_limits, p_mins,
+                p_stopids, st, mode=mode,
+            )
+            return out, kp, vp, st
+
+        def chunkfill_step(params, kp, vp, c_tokens, c_positions, c_bt,
+                           c_final, c_last, c_lengths, c_slots, c_keys,
+                           c_steps, c_temps, c_topks, c_topps, c_limits,
+                           c_mins, c_stopids, st, *, mode):
+            """One chunk of prompt positions for up to B rows. Rows whose
+            prompt ENDS in this chunk (c_final) sample their first token
+            and scatter into the decode state exactly like prefill_step;
+            other rows only extend their cached K/V."""
+            logits, kp, vp = model.prefill_chunk(
+                params, c_tokens, c_positions, kp, vp, c_bt, c_last
+            )
+            out, st = sample_and_scatter(
+                logits, jnp.logical_and(c_slots >= 0, c_final), c_lengths,
+                c_bt, c_slots, c_keys, c_steps, c_temps, c_topks, c_topps,
+                c_limits, c_mins, c_stopids, st, mode=mode,
+            )
             return out, kp, vp, st
 
         repl, slot1, slot2 = self._repl, self._slot1, self._slot2
@@ -366,8 +402,10 @@ class EngineCore:
                  slot1, slot1, slot1, slot2)
         self._st_shardings = st_sh
         self._prefill_arg_shardings = (repl,) * 12
+        self._chunkfill_arg_shardings = (repl,) * 15
         self._decode_fn = decode_step
         self._prefill_fn = prefill_step
+        self._chunkfill_fn = chunkfill_step
         self._make_jits(self._param_shardings)
 
     def _make_jits(self, param_spec) -> None:
@@ -397,6 +435,15 @@ class EngineCore:
                 in_shardings=(param_spec, kv, kv) + (repl,) * 12 + (st_sh,),
                 out_shardings=(repl, kv, kv, st_sh),
                 donate_argnums=(1, 2, 15),
+            )
+            for mode in ("greedy", "stochastic", "filtered")
+        }
+        self._chunkfill_jits = {
+            mode: jax.jit(
+                partial(self._chunkfill_fn, mode=mode),
+                in_shardings=(param_spec, kv, kv) + (repl,) * 15 + (st_sh,),
+                out_shardings=(repl, kv, kv, st_sh),
+                donate_argnums=(1, 2, 18),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
@@ -731,6 +778,9 @@ class EngineCore:
         if self._dirty:
             self._drain(finished)
             self._resync()
+        if self.cfg.prefill_chunk_size:
+            self._prefill_chunked(seqs, finished)
+            return
         by_bucket: Dict[int, List[Sequence]] = {}
         for seq in seqs:
             n = seq.num_tokens
@@ -740,6 +790,108 @@ class EngineCore:
             for i in range(0, len(group), self.cfg.max_prefill_batch):
                 self._prefill_chunk(group[i : i + self.cfg.max_prefill_batch],
                                     bucket)
+
+    def _prefill_chunked(
+        self, seqs: List[Sequence], finished: List[RequestOutput]
+    ) -> None:
+        """Chunked prefill: run each admitted group's prompts through the
+        single fixed-[B, C] chunk executable, C positions at a time, and
+        interleave one decode step for the already-running batch between
+        chunks — a long prompt costs the decoders ceil(len/C) short
+        stalls instead of one long one."""
+        C = self.cfg.prefill_chunk_size
+        B = self.cfg.max_prefill_batch
+        E = self._stop_capacity
+        key_shape = self._h_keys.shape[1:]
+        for i in range(0, len(seqs), B):
+            rows = seqs[i : i + B]
+            # Snapshot every chunk-invariant per-row value ONCE. The live
+            # seq.num_tokens/output_ids MUST NOT be re-read inside the lo
+            # loop: interleaved decode steps append tokens to rows that
+            # went final in an earlier chunk, and a re-read length would
+            # mark such a row "final" again — double-scattering it and
+            # rewinding its device RNG/step state. (Block tables are the
+            # one exception below: pages only grow, and the final-chunk
+            # scatter should carry the freshest map.)
+            lens = [seq.num_tokens for seq in rows]
+            ids0 = [seq.prompt_ids + seq.output_ids for seq in rows]
+            steps0 = np.zeros((B,), np.int32)
+            slots0 = np.full((B,), -1, np.int32)
+            keys0 = np.zeros((B, *key_shape), np.uint32)
+            temps0 = np.zeros((B,), np.float32)
+            topks0 = np.zeros((B,), np.int32)
+            topps0 = np.ones((B,), np.float32)
+            limits0 = np.full((B,), 1, np.int32)
+            mins0 = np.zeros((B,), np.int32)
+            stopids0 = np.full((B, E), -1, np.int32)
+            lengths0 = np.zeros((B,), np.int32)
+            for r, seq in enumerate(rows):
+                p = seq.params
+                slots0[r] = seq.slot
+                lengths0[r] = lens[r]
+                keys0[r] = np.asarray(make_base_key(p.seed, seq.slot))
+                steps0[r] = len(seq.output_ids)
+                temps0[r] = p.temperature
+                topks0[r] = p.top_k
+                topps0[r] = p.top_p
+                limits0[r] = p.max_tokens
+                mins0[r] = p.min_tokens
+                stopids0[r] = self._stop_ids_for(seq)
+            chunk_mode = sampling_mod.join_modes(
+                sampling_mod.required_mode(s.params) for s in rows
+            )
+            maxlen = max(lens)
+            for lo in range(0, maxlen, C):
+                tokens = np.zeros((B, C), np.int32)
+                positions = np.full((B, C), -1, np.int32)
+                bt = np.zeros((B, self._pages_per_seq), np.int32)
+                final = np.zeros((B,), bool)
+                last = np.zeros((B,), np.int32)
+                slots = np.full((B,), -1, np.int32)
+                snapshot: List[Tuple[int, Sequence]] = []
+                for r, seq in enumerate(rows):
+                    n = lens[r]
+                    if lo >= n:
+                        continue  # this row's prompt already fully cached
+                    hi = min(n, lo + C)
+                    tokens[r, : hi - lo] = ids0[r][lo:hi]
+                    positions[r, : hi - lo] = np.arange(lo, hi)
+                    bt[r, : len(seq.pages)] = seq.pages  # live: grow-only
+                    slots[r] = slots0[r]
+                    if lo <= n - 1 < hi:
+                        final[r] = True
+                        last[r] = n - 1 - lo
+                        snapshot.append((r, seq))
+                args = jax.device_put(
+                    (tokens, positions, bt, final, last, lengths0, slots,
+                     keys0, steps0, temps0, topks0, topps0, limits0,
+                     mins0, stopids0),
+                    self._chunkfill_arg_shardings,
+                )
+                out, self.k_pages, self.v_pages, self._dev_state = (
+                    self._chunkfill_jits[chunk_mode](
+                        self.params, self.k_pages, self.v_pages, *args,
+                        self._dev_state,
+                    )
+                )
+                if snapshot:  # rows whose prompt finished in this chunk
+                    for _, seq in snapshot:
+                        seq.prefilled = True
+                    self.prefills += len(snapshot)
+                    self._push_pending("prefill", out, snapshot)
+                    self._mode = sampling_mod.join_modes(
+                        (self._mode, chunk_mode)
+                    )
+                # Interleave: let already-DECODABLE sequences advance while
+                # the next chunk queues behind this one on the device
+                # stream. Mid-prefill rows are in `running` too, so the
+                # guard must ask for a prefilled one — an idle engine's
+                # long first prompt must not pay an empty decode step per
+                # chunk.
+                if lo + C < maxlen and any(
+                    s.prefilled for s in self.scheduler.running.values()
+                ):
+                    self._dispatch_decode(finished)
 
     def _prefill_chunk(self, chunk: List[Sequence], bucket: int) -> None:
         # Pad to {1, max_prefill_batch} rows so at most two executables
@@ -833,9 +985,14 @@ class EngineCore:
                     self._drain(finished)
                     if seq.rid not in self.scheduler.running:
                         continue
-                    try:  # minimal demand; preemption allowed (drained)
+                    try:  # minimal demand; preemption allowed (drained) —
+                        # but never of a mid-prefill sequence, whose
+                        # in-flight chunk loop would keep writing its old
+                        # (freed) pages.
                         self.scheduler.ensure_pages(
-                            seq, self._page_target(seq, lookahead)
+                            seq,
+                            self._page_target(seq, lookahead),
+                            preemptible=lambda s: s.prefilled,
                         )
                     except OutOfPages:
                         # Alone and still short: the pool itself is the

@@ -183,7 +183,12 @@ class Scheduler:
         self.ensure_pages(seq, seq.num_tokens + 1)
 
     def ensure_pages(
-        self, seq: Sequence, num_positions: int, *, allow_preempt: bool = True
+        self,
+        seq: Sequence,
+        num_positions: int,
+        *,
+        allow_preempt: bool = True,
+        preemptible=None,
     ) -> None:
         """Grow ``seq``'s page map to cover ``num_positions`` KV slots
         (capped at the per-sequence maximum). The engine's run-ahead
@@ -191,7 +196,10 @@ class Scheduler:
         always exist on-device before the step that writes them. May
         preempt other sequences (unless ``allow_preempt`` is off — the
         engine forbids it while steps are in flight, because a victim's
-        freed pages could still be written); raises OutOfPages otherwise."""
+        freed pages could still be written); ``preemptible`` optionally
+        filters victims (the engine excludes mid-prefill sequences, whose
+        in-flight chunk loop would keep writing into freed pages); raises
+        OutOfPages otherwise."""
         cap = self.config.pages_per_seq * self.config.page_size
         num_positions = min(num_positions, cap)
         while -(-num_positions // self.config.page_size) > len(seq.pages):
@@ -200,13 +208,21 @@ class Scheduler:
             except OutOfPages:
                 if not allow_preempt:
                     raise
-                victim = self._youngest_running(exclude=seq.rid)
+                victim = self._youngest_running(
+                    exclude=seq.rid, preemptible=preemptible
+                )
                 if victim is None:
                     raise
                 self.preempt(victim)
 
-    def _youngest_running(self, exclude: str) -> Optional[Sequence]:
-        candidates = [s for s in self.running.values() if s.rid != exclude]
+    def _youngest_running(
+        self, exclude: str, preemptible=None
+    ) -> Optional[Sequence]:
+        candidates = [
+            s
+            for s in self.running.values()
+            if s.rid != exclude and (preemptible is None or preemptible(s))
+        ]
         if not candidates:
             return None
         return max(candidates, key=lambda s: s.admitted_at)

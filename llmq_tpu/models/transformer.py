@@ -334,6 +334,70 @@ class Transformer:
         last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
         return self._logits(params, last_h), k_pages, v_pages
 
+    # --- chunked prefill ---------------------------------------------------
+    def prefill_chunk(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [B, C] one chunk of prompt tokens
+        positions: jnp.ndarray,  # [B, C] absolute positions (−1 = padding)
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [B, pages_per_seq]
+        last_in_chunk: jnp.ndarray,  # [B] index of each row's final valid
+        #                              position within this chunk (0 if none)
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One fixed-size chunk of prompt positions through all layers:
+        writes the chunk's K/V into the cache and attends each query
+        against everything cached so far (earlier chunks + itself,
+        causal). Any prompt length runs through ONE compiled executable —
+        no per-bucket variants, ≤ C−1 positions of padding — and a long
+        prompt no longer stalls decode for its whole length (the engine
+        interleaves decode steps between chunks). Returns logits for each
+        row's ``last_in_chunk`` position (meaningful only on a row's
+        final chunk) plus the updated pages.
+        """
+        cfg = self.config
+        B, C = tokens.shape
+        inv_freq = compute_rope_inv_freq(cfg)
+        h = self._embed(params, tokens)  # [B, C, H]
+        windows = self._window_for_layers()
+        one_plus = cfg.model_type.startswith("gemma")
+
+        def layer_fn(carry, xs):
+            h, kps, vps = carry
+            lp, window, li = xs
+            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
+            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            kps, vps = attn_ops.write_kv_pages(
+                kps, vps, k, v, block_tables, positions, layer=li
+            )
+            attn_out = attn_dispatch.chunked_prefill_attention(
+                q,
+                kps,
+                vps,
+                block_tables,
+                positions,
+                scale=cfg.attn_scale,
+                sliding_window=window,
+                softcap=cfg.attn_softcap,
+                mesh=self.mesh,
+                backend=self.attn_backend,
+                layer=li,
+            )
+            h = self._finish_layer(lp, h, attn_out)
+            return (h, kps, vps), None
+
+        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (h, k_pages, v_pages), _ = jax.lax.scan(
+            layer_fn,
+            (h, k_pages, v_pages),
+            (params["layers"], windows, layer_idx),
+        )
+        last_h = jnp.take_along_axis(
+            h, last_in_chunk[:, None, None], axis=1
+        )[:, 0]
+        return self._logits(params, last_h), k_pages, v_pages
+
     # --- decode ------------------------------------------------------------
     def decode(
         self,

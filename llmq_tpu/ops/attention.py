@@ -119,6 +119,56 @@ def paged_decode_attention(
     return jnp.einsum("shk,skhd->shd", weights, v)
 
 
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [B, C, n_heads, d] — a chunk of query positions
+    k_pages: jnp.ndarray,  # [P, page_size, n_kv, d] or [L, P, ...]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    q_positions: jnp.ndarray,  # [B, C] absolute positions (−1 = padding)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    layer: Optional[jnp.ndarray] = None,  # required when pages are stacked
+) -> jnp.ndarray:
+    """Chunked-prefill attention: C query positions per row against the
+    paged KV cache (which must already hold the chunk's own K/V — same
+    write-then-attend order as the decode step).
+
+    The causal frontier is per-token: query at absolute position ``p``
+    attends cached keys ``[max(0, p+1−window), p]``. Generalizes
+    :func:`paged_decode_attention` (C == 1, position == ctx−1); this is
+    what lets prefill run in fixed-size chunks instead of whole-prompt
+    buckets — any prompt length, one compiled executable.
+    """
+    if k_pages.ndim == 5:
+        assert layer is not None, "stacked pages need a layer index"
+        k_pages = k_pages[layer]
+        v_pages = v_pages[layer]
+    B, C, n_heads, head_dim = q.shape
+    page_size = k_pages.shape[1]
+    pages_per_seq = block_tables.shape[1]
+    max_ctx = pages_per_seq * page_size
+    n_kv = k_pages.shape[2]
+    n_rep = n_heads // n_kv
+
+    k = k_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
+    v = v_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = _softcap(scores, softcap)
+    k_pos = jnp.arange(max_ctx)[None, None, :]  # [1, 1, max_ctx]
+    q_pos = q_positions[:, :, None]  # [B, C, 1]
+    mask = (k_pos <= q_pos) & (q_pos >= 0)
+    if sliding_window is not None:
+        mask &= k_pos > q_pos - sliding_window
+    scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
 def write_prompt_kv_pages(
     k_pages: jnp.ndarray,  # [L, P, page_size, n_kv, d] (stacked only)
     v_pages: jnp.ndarray,

@@ -111,6 +111,33 @@ def prefill_attention(
     return call(q, k, v, lengths, window)
 
 
+def chunked_prefill_attention(
+    q: jnp.ndarray,  # [B, C, n_heads, d]
+    k_pages: jnp.ndarray,  # [L, P, page, n_kv, d] (or unstacked)
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, pages_per_seq]
+    q_positions: jnp.ndarray,  # [B, C] absolute (−1 = padding)
+    *,
+    scale: float,
+    sliding_window=None,
+    softcap: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+    layer: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Chunk-of-queries attention against the paged cache (chunked
+    prefill). Currently always the XLA path: it is a single dense einsum
+    over the gathered pages that GSPMD partitions over tp directly; a
+    Pallas flash variant (per-chunk page DMA like the decode kernels) is
+    the planned optimization once measured to matter.
+    """
+    return xla_ops.paged_prefill_attention(
+        q, k_pages, v_pages, block_tables, q_positions,
+        scale=scale, sliding_window=sliding_window, softcap=softcap,
+        layer=layer,
+    )
+
+
 def decode_attention(
     q: jnp.ndarray,  # [S, n_heads, d]
     k_pages: jnp.ndarray,  # [Pg, page_size, n_kv, d] or [L, Pg, ...]

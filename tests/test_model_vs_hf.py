@@ -208,3 +208,63 @@ def test_batched_decode_slots_independent(tmp_path):
     only1 = run(2, [1])
     np.testing.assert_allclose(both[0], only0[0], rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(both[1], only1[1], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("family", ["llama", "gemma2"])
+@pytest.mark.parametrize("chunk", [4, 8, 16])
+def test_chunked_prefill_matches_full_and_hf(family, chunk, tmp_path):
+    """Prefilling in fixed-size chunks against the paged cache must
+    reproduce the bucketed whole-prompt prefill (same final logits, same
+    cached K/V) and the HF oracle — incl. positions straddling page
+    boundaries and a sliding-window family (gemma2)."""
+    path, hf_model = _hf_tiny(family, tmp_path)
+    config, model, params = _our_model(path)
+    rng = np.random.default_rng(3)
+    T = 21  # not a multiple of any chunk size: exercises the ragged tail
+    tokens = rng.integers(1, config.vocab_size, size=(1, T))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()[0, T - 1]
+
+    bt = _sequential_block_table(1)
+
+    # bucketed reference
+    k_full, v_full = make_kv_pages(config, 1 + PAGES_PER_SEQ, PAGE_SIZE, jnp.float32)
+    padded = np.zeros((1, 32), np.int32)
+    padded[0, :T] = tokens
+    full_logits, k_full, v_full = model.prefill(
+        params, jnp.asarray(padded), jnp.asarray([T], jnp.int32),
+        k_full, v_full, bt,
+    )
+
+    # chunked
+    k_pages, v_pages = make_kv_pages(config, 1 + PAGES_PER_SEQ, PAGE_SIZE, jnp.float32)
+    logits = None
+    for lo in range(0, T, chunk):
+        hi = min(T, lo + chunk)
+        ck = np.zeros((1, chunk), np.int32)
+        pos = np.full((1, chunk), -1, np.int32)
+        ck[0, : hi - lo] = tokens[0, lo:hi]
+        pos[0, : hi - lo] = np.arange(lo, hi)
+        step_logits, k_pages, v_pages = model.prefill_chunk(
+            params, jnp.asarray(ck), jnp.asarray(pos),
+            k_pages, v_pages, bt,
+            jnp.asarray([hi - lo - 1], jnp.int32),
+        )
+        logits = step_logits  # the last chunk's output is the one that counts
+
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full_logits[0]), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(np.asarray(logits[0]), hf_logits, rtol=3e-4, atol=3e-4)
+    # cached K/V identical on every live position (pages 1..3 hold 0..T-1)
+    live_pages = -(-T // PAGE_SIZE)
+    for p in range(1, 1 + live_pages):
+        rows = PAGE_SIZE if p < live_pages else T - (live_pages - 1) * PAGE_SIZE
+        np.testing.assert_allclose(
+            np.asarray(k_pages[:, p, :rows]), np.asarray(k_full[:, p, :rows]),
+            rtol=1e-5, atol=1e-5, err_msg=f"k page {p}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(v_pages[:, p, :rows]), np.asarray(v_full[:, p, :rows]),
+            rtol=1e-5, atol=1e-5, err_msg=f"v page {p}",
+        )
