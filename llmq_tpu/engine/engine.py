@@ -402,7 +402,6 @@ class EngineCore:
                  slot1, slot1, slot1, slot2)
         self._st_shardings = st_sh
         self._prefill_arg_shardings = (repl,) * 12
-        self._chunkfill_arg_shardings = (repl,) * 15
         self._decode_fn = decode_step
         self._prefill_fn = prefill_step
         self._chunkfill_fn = chunkfill_step
@@ -801,11 +800,11 @@ class EngineCore:
         stalls instead of one long one."""
         C = self.cfg.prefill_chunk_size
         B = self.cfg.max_prefill_batch
-        E = self._stop_capacity
-        key_shape = self._h_keys.shape[1:]
+        repl = self._repl
         for i in range(0, len(seqs), B):
             rows = seqs[i : i + B]
-            # Snapshot every chunk-invariant per-row value ONCE. The live
+            # Snapshot every chunk-invariant per-row value ONCE, and ship
+            # the invariant arrays to the device ONCE per group. The live
             # seq.num_tokens/output_ids MUST NOT be re-read inside the lo
             # loop: interleaved decode steps append tokens to rows that
             # went final in an earlier chunk, and a re-read length would
@@ -815,28 +814,12 @@ class EngineCore:
             # scatter should carry the freshest map.)
             lens = [seq.num_tokens for seq in rows]
             ids0 = [seq.prompt_ids + seq.output_ids for seq in rows]
-            steps0 = np.zeros((B,), np.int32)
-            slots0 = np.full((B,), -1, np.int32)
-            keys0 = np.zeros((B, *key_shape), np.uint32)
-            temps0 = np.zeros((B,), np.float32)
-            topks0 = np.zeros((B,), np.int32)
-            topps0 = np.ones((B,), np.float32)
-            limits0 = np.full((B,), 1, np.int32)
-            mins0 = np.zeros((B,), np.int32)
-            stopids0 = np.full((B, E), -1, np.int32)
             lengths0 = np.zeros((B,), np.int32)
-            for r, seq in enumerate(rows):
-                p = seq.params
-                slots0[r] = seq.slot
-                lengths0[r] = lens[r]
-                keys0[r] = np.asarray(make_base_key(p.seed, seq.slot))
-                steps0[r] = len(seq.output_ids)
-                temps0[r] = p.temperature
-                topks0[r] = p.top_k
-                topps0[r] = p.top_p
-                limits0[r] = p.max_tokens
-                mins0[r] = p.min_tokens
-                stopids0[r] = self._stop_ids_for(seq)
+            lengths0[: len(rows)] = lens
+            inv = jax.device_put(
+                (lengths0, *self._pack_sampling_rows(rows, B)),
+                (repl,) * 10,
+            )
             chunk_mode = sampling_mod.join_modes(
                 sampling_mod.required_mode(s.params) for s in rows
             )
@@ -847,31 +830,26 @@ class EngineCore:
                 bt = np.zeros((B, self._pages_per_seq), np.int32)
                 final = np.zeros((B,), bool)
                 last = np.zeros((B,), np.int32)
-                slots = np.full((B,), -1, np.int32)
                 snapshot: List[Tuple[int, Sequence]] = []
                 for r, seq in enumerate(rows):
                     n = lens[r]
-                    if lo >= n:
-                        continue  # this row's prompt already fully cached
+                    if lo >= n or seq.rid not in self.scheduler.running:
+                        continue  # fully cached (or gone) — padding row
                     hi = min(n, lo + C)
                     tokens[r, : hi - lo] = ids0[r][lo:hi]
                     positions[r, : hi - lo] = np.arange(lo, hi)
                     bt[r, : len(seq.pages)] = seq.pages  # live: grow-only
-                    slots[r] = slots0[r]
                     if lo <= n - 1 < hi:
                         final[r] = True
                         last[r] = n - 1 - lo
                         snapshot.append((r, seq))
-                args = jax.device_put(
-                    (tokens, positions, bt, final, last, lengths0, slots,
-                     keys0, steps0, temps0, topks0, topps0, limits0,
-                     mins0, stopids0),
-                    self._chunkfill_arg_shardings,
+                chunk_args = jax.device_put(
+                    (tokens, positions, bt, final, last), (repl,) * 5
                 )
                 out, self.k_pages, self.v_pages, self._dev_state = (
                     self._chunkfill_jits[chunk_mode](
-                        self.params, self.k_pages, self.v_pages, *args,
-                        self._dev_state,
+                        self.params, self.k_pages, self.v_pages,
+                        *chunk_args, *inv, self._dev_state,
                     )
                 )
                 if snapshot:  # rows whose prompt finished in this chunk
@@ -893,15 +871,12 @@ class EngineCore:
                 ):
                     self._dispatch_decode(finished)
 
-    def _prefill_chunk(self, chunk: List[Sequence], bucket: int) -> None:
-        # Pad to {1, max_prefill_batch} rows so at most two executables
-        # exist per bucket.
-        B = 1 if len(chunk) == 1 else self.cfg.max_prefill_batch
+    def _pack_sampling_rows(self, rows: List[Sequence], B: int) -> tuple:
+        """Per-row device-state arrays shared by both prefill paths
+        (bucketed + chunked): slots, RNG keys, step counts, sampling
+        params, stop-id rows. Padding rows keep slot −1 / limit 1."""
         E = self._stop_capacity
         key_shape = self._h_keys.shape[1:]
-        tokens = np.zeros((B, bucket), np.int32)
-        lengths = np.zeros((B,), np.int32)
-        bt = np.zeros((B, self._pages_per_seq), np.int32)
         slots = np.full((B,), -1, np.int32)
         keys = np.zeros((B, *key_shape), np.uint32)
         steps = np.zeros((B,), np.int32)
@@ -911,24 +886,33 @@ class EngineCore:
         limits = np.full((B,), 1, np.int32)
         mins = np.zeros((B,), np.int32)
         stopids = np.full((B, E), -1, np.int32)
+        for r, seq in enumerate(rows):
+            p = seq.params
+            slots[r] = seq.slot
+            keys[r] = np.asarray(make_base_key(p.seed, seq.slot))
+            steps[r] = len(seq.output_ids)
+            temps[r] = p.temperature
+            topks[r] = p.top_k
+            topps[r] = p.top_p
+            limits[r] = p.max_tokens
+            mins[r] = p.min_tokens
+            stopids[r] = self._stop_ids_for(seq)
+        return slots, keys, steps, temps, topks, topps, limits, mins, stopids
+
+    def _prefill_chunk(self, chunk: List[Sequence], bucket: int) -> None:
+        # Pad to {1, max_prefill_batch} rows so at most two executables
+        # exist per bucket.
+        B = 1 if len(chunk) == 1 else self.cfg.max_prefill_batch
+        tokens = np.zeros((B, bucket), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        bt = np.zeros((B, self._pages_per_seq), np.int32)
         for row, seq in enumerate(chunk):
             ids = seq.prompt_ids + seq.output_ids
             tokens[row, : len(ids)] = ids
             lengths[row] = len(ids)
             bt[row, : len(seq.pages)] = seq.pages
-            slots[row] = seq.slot
-            p = seq.params
-            keys[row] = np.asarray(make_base_key(p.seed, seq.slot))
-            steps[row] = len(seq.output_ids)
-            temps[row] = p.temperature
-            topks[row] = p.top_k
-            topps[row] = p.top_p
-            limits[row] = p.max_tokens
-            mins[row] = p.min_tokens
-            stopids[row] = self._stop_ids_for(seq)
         args = jax.device_put(
-            (tokens, lengths, bt, slots, keys, steps, temps, topks,
-             topps, limits, mins, stopids),
+            (tokens, lengths, bt, *self._pack_sampling_rows(chunk, B)),
             self._prefill_arg_shardings,
         )
         chunk_mode = sampling_mod.join_modes(
@@ -955,16 +939,24 @@ class EngineCore:
         # exhaustion (preemption needed) forces a drain + resync.
         # Count only in-flight DECODE entries: a pending prefill writes
         # solely its own new rows, so a wave of refill chunks must not
-        # inflate every running sequence's page demand.
+        # inflate every running sequence's page demand. Mid-prefill
+        # sequences are excluded outright: their prompt pages were fully
+        # allocated at admission, decode steps never write their rows,
+        # and demanding lookahead pages for them here could cascade into
+        # preempting/length-finishing a row whose chunk loop is still in
+        # flight (zombie-slot corruption).
         lookahead = self._pending_decodes + 2
+        decodable = [
+            s for s in self.scheduler.running.values() if s.prefilled
+        ]
         needs_pages = any(
             -(-self._page_target(seq, lookahead) // self.cfg.page_size)
             > len(seq.pages)
-            for seq in self.scheduler.running.values()
+            for seq in decodable
         )
         if needs_pages:
             grown = False
-            for seq in list(self.scheduler.running.values()):
+            for seq in decodable:
                 if seq.rid not in self.scheduler.running:
                     continue  # preempted by an earlier iteration's ensure
                 try:

@@ -126,15 +126,62 @@ def chunked_prefill_attention(
     layer: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Chunk-of-queries attention against the paged cache (chunked
-    prefill). Currently always the XLA path: it is a single dense einsum
-    over the gathered pages that GSPMD partitions over tp directly; a
-    Pallas flash variant (per-chunk page DMA like the decode kernels) is
-    the planned optimization once measured to matter.
+    prefill). Pallas on TPU (pages DMA'd through the block table, never
+    gathered — the XLA path materializes the full context per layer),
+    pure XLA elsewhere.
+
+    CONTRACT: on the pallas path each row's valid positions must be a
+    LEADING CONTIGUOUS run (``q_positions[b] = [s, s+1, ..., s+n−1, −1…]``
+    — exactly how the engine's chunk loop builds them); the kernel takes
+    the run as (start, count) and cannot represent gaps. Positions are
+    traced values, so this is the caller's responsibility — callers with
+    arbitrary position grids must pass ``backend="xla"``.
     """
-    return xla_ops.paged_prefill_attention(
-        q, k_pages, v_pages, block_tables, q_positions,
-        scale=scale, sliding_window=sliding_window, softcap=softcap,
-        layer=layer,
+    backend = resolve_backend() if backend == "auto" else backend
+    n_heads, n_kv = q.shape[2], k_pages.shape[-2]
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    stacked = k_pages.ndim == 5
+    if backend != "pallas" or not tp_ok:
+        return xla_ops.paged_prefill_attention(
+            q, k_pages, v_pages, block_tables, q_positions,
+            scale=scale, sliding_window=sliding_window, softcap=softcap,
+            layer=layer,
+        )
+    window = _window_scalar(sliding_window)
+    li = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if layer is not None
+        else jnp.zeros((1,), jnp.int32)
+    )
+    # Contiguous-run form: start = first valid position, count of valids.
+    num_valid = (q_positions >= 0).sum(axis=1).astype(jnp.int32)
+    chunk_start = jnp.where(num_valid > 0, q_positions[:, 0], 0)
+
+    def call(q, kp, vp, bt, cs, nv, window, li):
+        return pk.paged_prefill_attention_pallas(
+            q, kp, vp, bt, cs, nv, window, li,
+            scale=scale, softcap=softcap, interpret=_interpret(),
+        )
+
+    if tp > 1:
+        assert mesh is not None
+        kv_spec = (
+            P(None, None, None, TP_AXIS, None)
+            if stacked
+            else P(None, None, TP_AXIS, None)
+        )
+        call = jax.shard_map(
+            call,
+            mesh=mesh,
+            in_specs=(
+                P(None, None, TP_AXIS, None),
+                kv_spec, kv_spec, P(), P(), P(), P(), P(),
+            ),
+            out_specs=P(None, None, TP_AXIS, None),
+        )
+    return call(
+        q, k_pages, v_pages, block_tables, chunk_start, num_valid, window, li
     )
 
 

@@ -525,6 +525,238 @@ def paged_decode_attention_pallas_v2(
     return out
 
 # ---------------------------------------------------------------------------
+# Paged chunked prefill
+# ---------------------------------------------------------------------------
+
+
+def _paged_prefill_kernel(
+    # scalar prefetch
+    li_ref,  # [1] int32 — layer index into the stacked page pool
+    bt_ref,  # [B, pages_per_seq] int32
+    start_ref,  # [B] int32 — absolute position of the chunk's first query
+    nvalid_ref,  # [B] int32 — valid query positions in this row's chunk
+    w_ref,  # [1] int32 — sliding window (huge = disabled)
+    # blocked inputs
+    q_ref,  # [1, bq, n_heads, d]
+    k_ref,  # [1, 1, page_size, n_kv, d] — one whole page, all kv heads
+    v_ref,
+    # output
+    o_ref,  # [1, bq, n_heads, d]
+    # scratch
+    m_ref,  # [bq * n_heads, LANES] f32
+    l_ref,
+    acc_ref,  # [bq * n_heads, d] f32
+    *,
+    scale: float,
+    page_size: int,
+    pages_per_seq: int,
+    block_q: int,
+    n_kv: int,
+    softcap: Optional[float],
+):
+    """Chunk-of-queries attention against the paged KV cache.
+
+    Grid ``(B, nq, pages_per_seq)``: one q-block of ``block_q`` chunk
+    positions for row ``b`` against one cached page per step, online
+    softmax across pages. The causal frontier is per-token and ABSOLUTE
+    (query at position p attends cached keys ≤ p), so earlier chunks'
+    pages and the chunk's own freshly-written page both mask correctly.
+    """
+    b = pl.program_id(0)
+    iq = pl.program_id(1)
+    p = pl.program_id(2)
+    window = w_ref[0]
+    start = start_ref[b] + iq * block_q  # absolute pos of q row 0
+    nvalid = nvalid_ref[b] - iq * block_q  # valid q rows in this block
+    page_start = p * page_size
+    group = q_ref.shape[2] // n_kv
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Block is live iff some (q, k) pair is in the causal+window frontier:
+    # highest q position in THIS q-block = start + min(nvalid, bq) - 1
+    # (the whole-chunk frontier would drag ~C/page extra pages through
+    # every early block); lowest = start.
+    nhere = jnp.minimum(nvalid, block_q)
+    live = jnp.logical_and(
+        nhere > 0,
+        jnp.logical_and(
+            page_start <= start + nhere - 1,  # causal frontier
+            page_start + page_size > start - window,  # window frontier
+        ),
+    )
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [bq, H, d]
+        bq, H, d = q.shape
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, n_kv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        for g in range(n_kv):
+            rows = slice(g * group, (g + 1) * group)
+            qg = q[:, rows, :].reshape(bq * group, d)
+            scores = (
+                jax.lax.dot_general(
+                    qg, k[:, g, :], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [bq*group, page]
+            scores = _apply_softcap(scores, softcap)
+            qrow = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            qpos = start + qrow // group
+            kpos = page_start + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            mask = jnp.logical_and(
+                qrow // group < nvalid,
+                jnp.logical_and(kpos <= qpos, kpos > qpos - window),
+            )
+            scores = jnp.where(mask, scores, NEG_INF)
+
+            srows = slice(g * group * bq, (g + 1) * group * bq)
+            # scratch rows are laid out [bq*group per kv head]; scores
+            # rows are (q-position major, group minor) within the head.
+            m_prev = m_ref[srows, :1]
+            l_prev = l_ref[srows, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(scores - m_new)
+            l_ref[srows, :] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(probs, axis=1, keepdims=True),
+                (bq * group, l_ref.shape[1]),
+            )
+            m_ref[srows, :] = jnp.broadcast_to(
+                m_new, (bq * group, m_ref.shape[1])
+            )
+            acc_ref[srows, :] = acc_ref[srows, :] * alpha + (
+                jax.lax.dot_general(
+                    probs, v[:, g, :], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finish():
+        bq = q_ref.shape[1]
+        d = q_ref.shape[3]
+        # Per-kv-group writes invert the scratch layout without a 4-D
+        # transpose (same sliced-sublane idiom as the decode kernel).
+        for g in range(n_kv):
+            srows = slice(g * group * bq, (g + 1) * group * bq)
+            l = l_ref[srows, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            out = (acc_ref[srows, :] / l).reshape(bq, group, d)
+            o_ref[0, :, g * group : (g + 1) * group, :] = out.astype(
+                o_ref.dtype
+            )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "interpret"),
+)
+def paged_prefill_attention_pallas(
+    q: jnp.ndarray,  # [B, C, n_heads, d]
+    k_pages: jnp.ndarray,  # [P, page, n_kv, d] or [L, P, page, n_kv, d]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    chunk_start: jnp.ndarray,  # [B] int32 absolute first-query position
+    num_valid: jnp.ndarray,  # [B] int32 valid query count (≤ C)
+    sliding_window: jnp.ndarray,  # [] or [1] int32 (huge = disabled)
+    layer: Optional[jnp.ndarray] = None,
+    *,
+    scale: float,
+    softcap: Optional[float] = None,
+    block_q: int = 32,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas chunked-prefill attention (see `_paged_prefill_kernel`).
+
+    Contract mirrors ``ops/attention.py::paged_prefill_attention`` with
+    (chunk_start, num_valid) instead of a full positions grid: positions
+    are ``chunk_start[b] .. chunk_start[b]+num_valid[b)−1``, contiguous —
+    which is how the engine's chunk loop builds them. Rows past
+    ``num_valid`` produce garbage (finite) output the caller ignores.
+    """
+    B, C, n_heads, d = q.shape
+    if k_pages.ndim == 4:
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+        layer = jnp.zeros((), jnp.int32)
+    assert layer is not None, "stacked pages need a layer index"
+    _, _, page_size, n_kv, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    block_q = min(block_q, C)
+    c_pad = -(-C // block_q) * block_q
+    if c_pad != C:
+        q = jnp.pad(q, ((0, 0), (0, c_pad - C), (0, 0), (0, 0)))
+    nq = c_pad // block_q
+
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        scale=scale,
+        page_size=page_size,
+        pages_per_seq=pages_per_seq,
+        block_q=block_q,
+        n_kv=n_kv,
+        softcap=softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(B, nq, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, n_heads, d),
+                lambda b, iq, p, li, bt, st, nv, w: (b, iq, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, page_size, n_kv, d),
+                lambda b, iq, p, li, bt, st, nv, w: (li[0], bt[b, p], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, page_size, n_kv, d),
+                lambda b, iq, p, li, bt, st, nv, w: (li[0], bt[b, p], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, n_heads, d),
+            lambda b, iq, p, li, bt, st, nv, w: (b, iq, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q * n_heads, _LANES), jnp.float32),
+            pltpu.VMEM((block_q * n_heads, _LANES), jnp.float32),
+            pltpu.VMEM((block_q * n_heads, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, c_pad, n_heads, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        block_tables.astype(jnp.int32),
+        chunk_start.astype(jnp.int32),
+        num_valid.astype(jnp.int32),
+        jnp.asarray(sliding_window, jnp.int32).reshape(1),
+        q,
+        k_pages,
+        v_pages,
+    )
+    return out[:, :C]
+
+
+# ---------------------------------------------------------------------------
 # Flash prefill
 # ---------------------------------------------------------------------------
 

@@ -300,3 +300,112 @@ def test_write_prompt_kv_pages_matches_token_scatter():
     np.testing.assert_allclose(pg_k[0], base_k[0])
     np.testing.assert_allclose(pg_k[2], base_k[2])
     np.testing.assert_allclose(pg_v[1, 4], base_v[1, 4])
+
+
+@pytest.mark.parametrize(
+    "n_heads,n_kv,window,softcap,block_q",
+    [
+        (4, 4, None, None, 8),  # MHA
+        (8, 2, None, None, 8),  # GQA
+        (8, 2, 13, None, 4),  # sliding window
+        (4, 1, None, 30.0, 16),  # softcap, block > chunk
+        (6, 3, 7, 20.0, 8),  # everything, odd group
+    ],
+)
+def test_paged_prefill_chunk_matches_reference(
+    n_heads, n_kv, window, softcap, block_q
+):
+    """Chunked-prefill kernel vs the XLA gather reference: a mid-prompt
+    chunk whose queries attend earlier chunks' pages + their own."""
+    S, d, page_size, pages_per_seq, C = 3, 16, 8, 4, 10
+    key = jax.random.key(7)
+    kq, kp_ = jax.random.split(key)
+    q = _rand(kq, (S, C, n_heads, d))
+    # cached context lens (pages already written up to these positions)
+    starts = [0, 5, 17]  # chunk begins at these absolute positions
+    valids = [10, 10, 7]  # row 2 has a ragged tail
+    k_pages, v_pages, bt, _ = _paged_setup(
+        kp_, S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=[0, 0, 0],
+    )
+    positions = np.full((S, C), -1, np.int32)
+    for r in range(S):
+        positions[r, : valids[r]] = np.arange(starts[r], starts[r] + valids[r])
+    scale = d**-0.5
+    ref = ref_ops.paged_prefill_attention(
+        q, k_pages, v_pages, bt, jnp.asarray(positions),
+        scale=scale, sliding_window=window, softcap=softcap,
+    )
+    out = pk.paged_prefill_attention_pallas(
+        q, k_pages, v_pages, bt,
+        jnp.asarray(starts, jnp.int32), jnp.asarray(valids, jnp.int32),
+        jnp.asarray([window if window else _WINDOW_DISABLED], jnp.int32),
+        scale=scale, softcap=softcap, block_q=block_q, interpret=True,
+    )
+    for r in range(S):
+        np.testing.assert_allclose(
+            out[r, : valids[r]], ref[r, : valids[r]],
+            rtol=2e-5, atol=2e-5, err_msg=f"row {r}",
+        )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_paged_prefill_chunk_stacked_layer():
+    S, n_heads, n_kv, d, page_size, pages_per_seq, C, L = 2, 4, 2, 16, 8, 3, 6, 3
+    key = jax.random.key(8)
+    q = _rand(key, (S, C, n_heads, d))
+    P_ = 1 + S * pages_per_seq
+    k_pages = _rand(jax.random.key(9), (L, P_, page_size, n_kv, d))
+    v_pages = _rand(jax.random.key(10), (L, P_, page_size, n_kv, d))
+    bt = jnp.arange(1, 1 + S * pages_per_seq, dtype=jnp.int32).reshape(S, -1)
+    positions = np.full((S, C), -1, np.int32)
+    positions[0, :6] = np.arange(3, 9)
+    positions[1, :4] = np.arange(0, 4)
+    scale = d**-0.5
+    for li in (0, 2):
+        ref = ref_ops.paged_prefill_attention(
+            q, k_pages, v_pages, bt, jnp.asarray(positions),
+            scale=scale, layer=jnp.asarray(li, jnp.int32),
+        )
+        out = pk.paged_prefill_attention_pallas(
+            q, k_pages, v_pages, bt,
+            jnp.asarray([3, 0], jnp.int32), jnp.asarray([6, 4], jnp.int32),
+            jnp.asarray([_WINDOW_DISABLED], jnp.int32),
+            jnp.asarray(li, jnp.int32), scale=scale, block_q=4,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            out[0, :6], ref[0, :6], rtol=2e-5, atol=2e-5, err_msg=f"l{li} r0"
+        )
+        np.testing.assert_allclose(
+            out[1, :4], ref[1, :4], rtol=2e-5, atol=2e-5, err_msg=f"l{li} r1"
+        )
+
+
+def test_chunked_prefill_dispatch_pallas_matches_xla():
+    """dispatch.chunked_prefill_attention: the pallas path's contiguous
+    (start, num_valid) conversion must agree with the xla path."""
+    from llmq_tpu.ops import dispatch
+
+    S, C, n_heads, n_kv, d, page_size, pages_per_seq = 2, 6, 4, 2, 16, 8, 3
+    key = jax.random.key(11)
+    q = _rand(key, (S, C, n_heads, d))
+    k_pages, v_pages, bt, _ = _paged_setup(
+        jax.random.key(12), S=S, n_kv=n_kv, d=d, page_size=page_size,
+        pages_per_seq=pages_per_seq, ctx_lens=[0, 0],
+    )
+    positions = np.full((S, C), -1, np.int32)
+    positions[0, :6] = np.arange(4, 10)
+    positions[1, :3] = np.arange(0, 3)
+    outs = {}
+    for backend in ("xla", "pallas"):
+        outs[backend] = dispatch.chunked_prefill_attention(
+            q, k_pages, v_pages, bt, jnp.asarray(positions),
+            scale=d**-0.5, backend=backend,
+        )
+    np.testing.assert_allclose(
+        outs["pallas"][0, :6], outs["xla"][0, :6], rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        outs["pallas"][1, :3], outs["xla"][1, :3], rtol=2e-5, atol=2e-5
+    )
