@@ -224,6 +224,88 @@ def peak_flops_per_chip(devices) -> float:
     return 100e9  # CPU-ish placeholder so mfu stays finite
 
 
+def pick_decode_kernel(jax, config, *, max_seqs: int, page_size: int) -> str:
+    """Quick on-hardware A/B of the paged-decode kernels (v1 BlockSpec
+    pipeline vs v2 chunked manual-DMA) at an HBM-resident pool size, so
+    the headline run uses whichever is actually faster on this chip.
+    An explicit LLMQ_DECODE_KERNEL always wins; any failure → v1.
+
+    The pool must NOT fit in VMEM (~128 MB) or every kernel looks
+    infinitely fast (round-3 finding); ~300 MB per side with per-layer
+    distinct pages defeats caching while leaving the engine's HBM alone.
+    """
+    explicit = os.environ.get("LLMQ_DECODE_KERNEL")
+    if explicit:
+        return explicit
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from llmq_tpu.ops.pallas_attention import (
+            paged_decode_attention_pallas,
+            paged_decode_attention_pallas_v2,
+        )
+
+        H, NKV, D = config.num_heads, config.num_kv_heads, config.head_dim_
+        L = config.num_layers
+        S = max_seqs
+        PAGE = page_size
+        PPS = 4
+        per_page = PAGE * NKV * D * 2  # bf16
+        P = max(PPS * 4, min(300 * 2**20 // max(1, L * per_page), 961))
+        if P < PPS + 1:
+            return "v1"
+        ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
+        q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
+        kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
+        vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
+        rng = np.random.default_rng(0)
+        bt = jnp.asarray(
+            rng.integers(1, P, size=(S, PPS)).astype(np.int32)
+        )
+        cl = jnp.full((S,), ctx, jnp.int32)
+        w = jnp.asarray([1 << 30], jnp.int32)
+        scale = D**-0.5
+
+        def timeit(kern, n=2):
+            outs = [
+                kern(q, kp, vp, bt, cl, w, jnp.int32(li), scale=scale)
+                for li in range(L)
+            ]
+            jax.block_until_ready(outs)
+            t0 = time.monotonic()
+            for _ in range(n):
+                outs = [
+                    kern(q, kp, vp, bt, cl, w, jnp.int32(li), scale=scale)
+                    for li in range(L)
+                ]
+                jax.block_until_ready(outs)
+            return (time.monotonic() - t0) / (n * L)
+
+        v1 = timeit(paged_decode_attention_pallas)
+        v2 = timeit(paged_decode_attention_pallas_v2)
+        # numerics guard: never pick a kernel that disagrees
+        a = paged_decode_attention_pallas(
+            q, kp, vp, bt, cl, w, jnp.int32(0), scale=scale
+        )
+        b = paged_decode_attention_pallas_v2(
+            q, kp, vp, bt, cl, w, jnp.int32(0), scale=scale
+        )
+        diff = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for arr in (q, kp, vp, a, b):
+            arr.delete()
+        choice = "v2" if (v2 < 0.92 * v1 and diff < 0.05) else "v1"
+        print(
+            f"bench: decode-kernel A/B v1={v1*1e3:.3f}ms v2={v2*1e3:.3f}ms "
+            f"per layer (max|diff|={diff:.2e}) -> {choice}",
+            file=sys.stderr,
+        )
+        return choice
+    except Exception as exc:  # noqa: BLE001 — never endanger the headline run
+        print(f"bench: kernel A/B failed ({exc!r}); using v1", file=sys.stderr)
+        return "v1"
+
+
 def main() -> None:
     jax, devices, backend_note = init_devices()
     if jax is None or not devices:
@@ -263,6 +345,11 @@ def main() -> None:
         f"prompt {prompt_len}, gen {gen_len}",
         file=sys.stderr,
     )
+    page_size = 8 if on_cpu else 128
+    if not on_cpu:
+        os.environ["LLMQ_DECODE_KERNEL"] = pick_decode_kernel(
+            jax, config, max_seqs=max_seqs, page_size=page_size
+        )
     params = init_params(config, jax.random.key(0), dtype=dtype)
     mesh = make_mesh(devices=devices)  # all local devices, tp
     core = EngineCore(
@@ -279,7 +366,7 @@ def main() -> None:
             # step, and 16 KB transfers are latency-bound on the order of
             # 6x the bandwidth floor (measured round 2); 128-token pages
             # make the transfers 64 KB and quarter the grid.
-            page_size=8 if on_cpu else 128,
+            page_size=page_size,
             # 8-prompt prefill chunks: 2048-token batches amortize the
             # weight stream ~24% better than the default 4 (measured).
             max_prefill_batch=int(

@@ -5,10 +5,11 @@ This module replaces what the reference delegated to vLLM's
 engine that coalesces many in-flight requests into device batches. The
 TPU-native design differs from vLLM's CUDA core on purpose:
 
-- **Two compiled programs, fixed shapes.** A bucketed batched prefill and
-  a ``max_num_seqs``-slot decode step. Requests churn; the compiled
-  programs never change, so there is no recompilation in steady state
-  (XLA caches one executable per prefill bucket + one decode variant).
+- **Fixed-shape compiled programs.** A batched prefill (bucketed
+  whole-prompt by default, or fixed-[B, C] chunked against the paged
+  cache via ``prefill_chunk_size``) and a ``max_num_seqs``-slot decode
+  step. Requests churn; the compiled programs never change, so there is
+  no recompilation in steady state.
 - **Device-resident decode state + run-ahead pipeline.** The decode
   state (current tokens, context lengths, block tables, sampling state)
   lives on the device and is *updated by the compiled step itself*; the
