@@ -236,5 +236,44 @@ class BrokerManager:
             await msg.reject(requeue=True)  # put back for later inspection
         return errors
 
+    async def requeue_failed(
+        self, queue: str, limit: Optional[int] = None
+    ) -> int:
+        """Move dead-lettered jobs back onto the main queue for retry
+        (destructive on the DLQ: each message is re-published to ``queue``
+        and acked off ``<queue>.failed``). Returns the count moved. The
+        re-published copy drops the broker bookkeeping headers so the
+        redelivery counter starts fresh."""
+        dlq = queue + FAILED_SUFFIX
+        # Bound the drain by the DLQ's INITIAL depth: a concurrently
+        # failing worker can re-dead-letter requeued jobs while we work,
+        # and chasing the live queue would loop forever. Seen-id tracking
+        # backstops brokers whose stats can't report a depth.
+        depth = (await self.get_queue_stats(dlq)).message_count
+        seen: set = set()
+        moved = 0
+        while limit is None or moved < limit:
+            if depth is not None and moved >= depth:
+                break
+            msg = await self.broker.get(dlq)
+            if msg is None:
+                break
+            if msg.message_id is not None:
+                if msg.message_id in seen:  # came around again: stop
+                    await msg.reject(requeue=True)
+                    break
+                seen.add(msg.message_id)
+            headers = {
+                k: v
+                for k, v in (msg.headers or {}).items()
+                if not k.startswith("x-")
+            }
+            await self.broker.publish(
+                queue, msg.body, message_id=msg.message_id, headers=headers
+            )
+            await msg.ack()
+            moved += 1
+        return moved
+
     async def purge_queue(self, queue: str) -> int:
         return await self.broker.purge(queue)

@@ -131,12 +131,27 @@ def health(queue):
 
 @cli.command()
 @click.argument("queue")
-@click.option("--limit", type=int, default=10, show_default=True)
-def errors(queue, limit):
+@click.option(
+    "--limit",
+    type=int,
+    default=10,
+    show_default=True,
+    help="Max jobs to list, or to move with --requeue (0 = all)",
+)
+@click.option(
+    "--requeue",
+    is_flag=True,
+    help="Move the failed jobs back onto the queue for retry "
+    "(destructive on <queue>.failed; --limit bounds how many, 0 = all)",
+)
+def errors(queue, limit, requeue):
     """List dead-lettered jobs from <queue>.failed."""
-    from llmq_tpu.cli.monitor import show_errors
+    from llmq_tpu.cli.monitor import requeue_errors, show_errors
 
-    asyncio.run(show_errors(queue, limit=limit))
+    if requeue:
+        asyncio.run(requeue_errors(queue, limit=None if limit == 0 else limit))
+    else:
+        asyncio.run(show_errors(queue, limit=limit))
 
 
 @cli.command()

@@ -166,6 +166,27 @@ async def show_errors(queue: str, *, limit: int = 10) -> None:
         console.print(table)
 
 
+async def requeue_errors(queue: str, *, limit: Optional[int] = 10) -> None:
+    async with BrokerManager(get_config()) as mgr:
+        n = await mgr.requeue_failed(queue, limit=limit)
+        remaining = (
+            await mgr.get_queue_stats(queue + ".failed")
+        ).message_count
+        if n:
+            tail = (
+                f" ({remaining} still dead-lettered — raise --limit or use "
+                "--limit 0)"
+                if remaining
+                else ""
+            )
+            console.print(
+                f"Requeued {n} failed job(s) from '{queue}.failed' back to "
+                f"'{queue}'{tail}"
+            )
+        else:
+            console.print(f"[green]No dead-lettered jobs in '{queue}.failed'[/green]")
+
+
 async def clear_queue(queue: str) -> None:
     async with BrokerManager(get_config()) as mgr:
         n = await mgr.purge_queue(queue)

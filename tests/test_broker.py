@@ -359,3 +359,28 @@ stages:
             # non-destructive: still there
             errors2 = await mgr.get_failed_jobs("w")
             assert len(errors2) == 1
+
+    async def test_dlq_requeue(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("w")
+            for i in range(3):
+                job = Job(id=f"bad{i}", prompt="p")
+                await mgr.broker.publish(
+                    "w.failed",
+                    job.model_dump_json().encode(),
+                    headers={"x-delivery-count": 4, "x-death-queue": "w"},
+                )
+            moved = await mgr.requeue_failed("w", limit=2)
+            assert moved == 2
+            # the moved jobs are consumable from the main queue again,
+            # with the broker bookkeeping headers dropped
+            msg = await mgr.broker.get("w")
+            assert msg is not None
+            assert json.loads(msg.body)["id"] == "bad0"
+            assert "x-delivery-count" not in (msg.headers or {})
+            await msg.ack()
+            # one remains dead-lettered
+            assert len(await mgr.get_failed_jobs("w")) == 1
+            assert await mgr.requeue_failed("w") == 1
+            assert await mgr.requeue_failed("w") == 0
