@@ -212,6 +212,15 @@ class EngineCore:
         self._eos_ids = set(model_config.eos_token_ids) | set(
             tokenizer.eos_token_ids
         )
+        if (
+            self.cfg.prefill_chunk_size
+            and int(self.mesh.shape.get(SP_AXIS, 1)) > 1
+        ):
+            logger.warning(
+                "prefill_chunk_size with sp>1: chunked prefill does not "
+                "context-parallelize over the sp axis (each chunk computes "
+                "replicated); use bucketed prefill for ring attention"
+            )
         self._buckets = _prefill_buckets(
             self.cfg, sp=int(self.mesh.shape.get(SP_AXIS, 1))
         )
