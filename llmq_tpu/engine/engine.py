@@ -108,6 +108,11 @@ class EngineConfig:
     # no longer stalls every running slot for its whole prefill.
     # None → bucketed whole-prompt prefill (the default).
     prefill_chunk_size: Optional[int] = None
+    # Automatic prefix caching (requires chunked prefill): requests that
+    # share leading full prompt pages reuse the cached KV via refcounted
+    # pages and prefill only the tail — e.g. a shared --map template or
+    # system prompt is computed once, not per job.
+    enable_prefix_caching: bool = False
     # Admission deferral waits for a full prefill chunk's worth of free
     # slots (throughput), but never keeps *deferring admissible work* for
     # longer than this (latency floor for trickle arrivals; the clock
@@ -165,12 +170,19 @@ class EngineCore:
         )
         self.params = jax.tree.map(jax.device_put, params, self._param_shardings)
 
+        if self.cfg.enable_prefix_caching and not self.cfg.prefill_chunk_size:
+            raise ValueError(
+                "enable_prefix_caching requires prefill_chunk_size: only "
+                "chunked prefill can start mid-prompt (the bucketed "
+                "executables always compute positions 0..T)"
+            )
         num_pages = self.cfg.num_pages or self._auto_num_pages()
         sched_cfg = SchedulerConfig(
             max_num_seqs=self.cfg.max_num_seqs,
             num_pages=num_pages,
             page_size=self.cfg.page_size,
             max_model_len=self.cfg.max_model_len,
+            enable_prefix_caching=self.cfg.enable_prefix_caching,
         )
         self.scheduler = Scheduler(sched_cfg)
         self._pages_per_seq = sched_cfg.pages_per_seq
@@ -249,7 +261,7 @@ class EngineCore:
         self._pending: Deque[_Pending] = deque()
         self._pending_decodes = 0  # decode entries within _pending
         self._defer_since: Optional[float] = None  # admission-deferral start
-        self._deferred_pages: List[Tuple[int, List[int]]] = []
+        self._deferred_pages: List[Tuple[int, List[int], int]] = []
         self._dispatch_idx = 0
         self._processed_idx = 0
         self._dirty = True
@@ -694,8 +706,8 @@ class EngineCore:
             self._deferred_pages
             and self._deferred_pages[0][0] <= self._processed_idx
         ):
-            _, pages = self._deferred_pages.pop(0)
-            self.scheduler.release_pages(pages)
+            _, pages, cacheable = self._deferred_pages.pop(0)
+            self.scheduler.release_pages(pages, cacheable)
 
     def _push_pending(
         self, kind: str, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
@@ -824,6 +836,9 @@ class EngineCore:
             # scatter should carry the freshest map.)
             lens = [seq.num_tokens for seq in rows]
             ids0 = [seq.prompt_ids + seq.output_ids for seq in rows]
+            # Prefix-cached positions are already in the (shared) leading
+            # pages — each row prefills from its own prefix_len on.
+            prefix0 = [seq.prefix_len for seq in rows]
             lengths0 = np.zeros((B,), np.int32)
             lengths0[: len(rows)] = lens
             inv = jax.device_put(
@@ -841,18 +856,27 @@ class EngineCore:
                 final = np.zeros((B,), bool)
                 last = np.zeros((B,), np.int32)
                 snapshot: List[Tuple[int, Sequence]] = []
+                any_rows = False
                 for r, seq in enumerate(rows):
                     n = lens[r]
-                    if lo >= n or seq.rid not in self.scheduler.running:
-                        continue  # fully cached (or gone) — padding row
                     hi = min(n, lo + C)
-                    tokens[r, : hi - lo] = ids0[r][lo:hi]
-                    positions[r, : hi - lo] = np.arange(lo, hi)
+                    row_start = max(lo, prefix0[r])
+                    if (
+                        lo >= n
+                        or hi <= prefix0[r]  # still inside the cached prefix
+                        or seq.rid not in self.scheduler.running
+                    ):
+                        continue  # nothing to compute — padding row
+                    any_rows = True
+                    tokens[r, : hi - row_start] = ids0[r][row_start:hi]
+                    positions[r, : hi - row_start] = np.arange(row_start, hi)
                     bt[r, : len(seq.pages)] = seq.pages  # live: grow-only
-                    if lo <= n - 1 < hi:
+                    if row_start <= n - 1 < hi:
                         final[r] = True
-                        last[r] = n - 1 - lo
+                        last[r] = n - 1 - row_start
                         snapshot.append((r, seq))
+                if not any_rows:
+                    continue  # whole chunk inside every row's prefix
                 chunk_args = jax.device_put(
                     (tokens, positions, bt, final, last), (repl,) * 5
                 )
@@ -865,6 +889,7 @@ class EngineCore:
                 if snapshot:  # rows whose prompt finished in this chunk
                     for _, seq in snapshot:
                         seq.prefilled = True
+                        self.scheduler.register_prefix(seq)
                     self.prefills += len(snapshot)
                     self._push_pending("prefill", out, snapshot)
                     self._mode = sampling_mod.join_modes(
@@ -1084,9 +1109,9 @@ class EngineCore:
         device_detected: bool,
         finished: List[RequestOutput],
     ) -> None:
-        pages = self.scheduler.finish(seq, reason, defer_pages=True)
+        pages, cacheable = self.scheduler.finish(seq, reason, defer_pages=True)
         if pages:
-            self._deferred_pages.append((self._dispatch_idx, pages))
+            self._deferred_pages.append((self._dispatch_idx, pages, cacheable))
         if not device_detected:
             self._dirty = True
         finished.append(self._output_for(seq))
@@ -1158,6 +1183,10 @@ class EngineCore:
             self._pending.clear()
             self._pending_decodes = 0
         self._flush_deferred()
+        # The prefix cache must not survive an abort: the KV buffers may
+        # be rebuilt (zeroed) below, and a cached hash pointing at a page
+        # of the new pool would hand future requests empty context.
+        self.scheduler.invalidate_prefix_cache()
         for seq in list(self.scheduler.running.values()):
             self.scheduler.finish(seq, note)
         self.scheduler.waiting.clear()
@@ -1308,3 +1337,11 @@ class AsyncEngine:
                 for fut in list(self._futures.values()):
                     if not fut.done():
                         fut.set_exception(RuntimeError("engine step failed"))
+        # Loop exit (shutdown): catch the host up so in-flight steps are
+        # processed and deferred pages release — the last futures resolve
+        # several iterations before the run-ahead pipeline fully lands,
+        # and stopping mid-pipeline would strand refcounts.
+        try:
+            self.core._drain([])
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            logger.exception("drain on shutdown failed")

@@ -7,12 +7,14 @@ module decides which sequence lives in which slot and which physical KV
 pages back it, so the device program never recompiles as requests churn.
 
 Invariants (property-tested in tests/test_scheduler.py):
-  - a physical page is owned by at most one sequence (page 0 is a reserved
-    scratch page for masked writes and is never handed out),
+  - every physical page's refcount equals the number of running sequences
+    listing it (exactly one owner unless prefix caching shares it; page 0
+    is a reserved scratch page and is never handed out),
   - every admitted sequence has pages covering len(tokens)+1 positions
     (room for the KV write of the token being decoded),
   - slots hold at most one sequence; finished/preempted sequences release
-    pages immediately,
+    their references immediately (cache-registered pages park in an
+    evictable LRU pool instead of the free list),
   - admission is FIFO; preemption evicts the *youngest* running sequence
     (its re-prefill wastes the least work).
 """
@@ -20,8 +22,9 @@ Invariants (property-tested in tests/test_scheduler.py):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from llmq_tpu.engine.sampling import SamplingParams
 
@@ -31,10 +34,20 @@ class OutOfPages(Exception):
 
 
 class PageAllocator:
-    """Free-list allocator over the physical KV page pool.
+    """Refcounted free-list allocator over the physical KV page pool.
 
     Page 0 is reserved: masked/padded token positions scatter there
     (``ops/attention.py::write_kv_pages``), so it must never back live data.
+
+    Three page states:
+      - *allocated*: refcount ≥ 1 (prefix-cached pages shared by several
+        sequences carry one reference per sharer);
+      - *cached*: refcount dropped to 0 but the page was registered as
+        evictable (its KV content may be reused by a future prefix
+        match) — it is reclaimed lazily, LRU, under pool pressure;
+      - *free*: on the free list.
+    Without prefix caching every page has refcount 1 and the allocator
+    degenerates to the plain free list.
     """
 
     def __init__(self, num_pages: int) -> None:
@@ -42,26 +55,79 @@ class PageAllocator:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._allocated: set[int] = set()
+        self._refs: Dict[int, int] = {}
+        # LRU order of refcount-0 evictable pages (dict = ordered set).
+        self._cached: Dict[int, None] = {}
+        # Called with the page id when a cached page is evicted, so the
+        # prefix cache can drop entries pointing at it.
+        self.on_evict = None
 
     @property
     def available(self) -> int:
-        return len(self._free)
+        return len(self._free) + len(self._cached)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     def alloc(self, n: int = 1) -> List[int]:
-        """Allocate n pages atomically; raises OutOfPages if short."""
-        if n > len(self._free):
-            raise OutOfPages(f"want {n} pages, have {len(self._free)}")
+        """Allocate n fresh pages atomically; raises OutOfPages if short
+        (evicting cached pages as needed, oldest first)."""
+        if n > self.available:
+            raise OutOfPages(f"want {n} pages, have {self.available}")
+        while len(self._free) < n:
+            self._evict_one()
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for page in pages:
+            self._refs[page] = 1
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def share(self, page: int) -> None:
+        """Take an additional reference on an allocated or cached page."""
+        rc = self._refs.get(page)
+        if rc is None:
+            raise ValueError(f"share of unallocated page {page}")
+        if rc == 0:  # revive from the evictable pool
+            del self._cached[page]
+        self._refs[page] = rc + 1
+
+    def free(self, pages: List[int], *, cacheable: bool = False) -> None:
+        """Drop one reference per page. At refcount 0 the page returns to
+        the free list — or parks in the evictable LRU pool when
+        ``cacheable`` (its content may serve a future prefix match)."""
         for page in pages:
-            if page not in self._allocated:
+            rc = self._refs.get(page)
+            if rc is None or rc < 1:
                 raise ValueError(f"double-free or foreign page {page}")
-            self._allocated.remove(page)
+            if rc > 1:
+                self._refs[page] = rc - 1
+                continue
+            if cacheable:
+                self._refs[page] = 0
+                self._cached[page] = None
+            else:
+                del self._refs[page]
+                self._free.append(page)
+
+    def drop_cached(self, page: int) -> None:
+        """Forget a cached (refcount-0) page, returning it to the free
+        list. Notifies ``on_evict`` like pressure eviction does, so the
+        prefix cache drops the hashes pointing at it — a silently freed
+        page whose hash survived would hand its next owner's content to
+        strangers."""
+        if page in self._cached:
+            del self._cached[page]
+            del self._refs[page]
+            if self.on_evict is not None:
+                self.on_evict(page)
             self._free.append(page)
+
+    def _evict_one(self) -> None:
+        page = next(iter(self._cached))  # oldest
+        del self._cached[page]
+        del self._refs[page]
+        if self.on_evict is not None:
+            self.on_evict(page)
+        self._free.append(page)
 
 
 @dataclasses.dataclass
@@ -73,6 +139,12 @@ class Sequence:
     params: SamplingParams
     output_ids: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
+    # Prefix caching: leading prompt positions whose KV is already in the
+    # (shared) leading pages — prefill starts at prefix_len. cacheable_pages
+    # counts the leading pages registered in the prefix cache (they park
+    # in the evictable pool instead of the free list when released).
+    prefix_len: int = 0
+    cacheable_pages: int = 0
     slot: int = -1
     admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
     preempt_count: int = 0
@@ -95,6 +167,11 @@ class SchedulerConfig:
     num_pages: int
     page_size: int
     max_model_len: int
+    # Automatic prefix caching: sequences sharing full leading prompt
+    # pages (position-identical, so RoPE'd K matches) reuse them via
+    # refcounts instead of recomputing — the engine then prefills only
+    # from prefix_len on (requires chunked prefill).
+    enable_prefix_caching: bool = False
 
     @property
     def pages_per_seq(self) -> int:
@@ -111,6 +188,85 @@ class Scheduler:
         self.waiting: Deque[Sequence] = deque()
         self.running: Dict[str, Sequence] = {}
         self._tick = 0
+        # Prefix cache: chain-hash of the prompt's leading full pages →
+        # page id holding that KV, plus the reverse map for eviction.
+        self._prefix_cache: Dict[bytes, int] = {}
+        self._prefix_rev: Dict[int, List[bytes]] = {}
+        self.prefix_hits = 0  # pages reused via the cache (stats)
+        self.allocator.on_evict = self._drop_page_hashes
+
+    # --- prefix caching ---------------------------------------------------
+    def _prefix_hashes(self, prompt_ids: List[int]) -> List[bytes]:
+        """Chain digests of the prompt's leading FULL pages. Capped so at
+        least the final prompt position is always recomputed (its logits
+        seed generation, and decode's +1 headroom stays private).
+        blake2b, not Python ``hash()``: a constructible tuple-hash
+        collision would silently substitute another request's KV (wrong
+        output + cross-request content leak)."""
+        ps = self.config.page_size
+        n_full = (len(prompt_ids) - 1) // ps
+        hashes: List[bytes] = []
+        h = b""
+        for i in range(n_full):
+            dig = hashlib.blake2b(h, digest_size=16)
+            dig.update(
+                b"".join(
+                    int(t).to_bytes(8, "little", signed=True)
+                    for t in prompt_ids[i * ps : (i + 1) * ps]
+                )
+            )
+            h = dig.digest()
+            hashes.append(h)
+        return hashes
+
+    def _match_prefix(self, prompt_ids: List[int]) -> List[int]:
+        """Longest run of cached pages matching the prompt's hash chain."""
+        matched: List[int] = []
+        for h in self._prefix_hashes(prompt_ids):
+            page = self._prefix_cache.get(h)
+            if page is None:
+                break
+            matched.append(page)
+        return matched
+
+    def register_prefix(self, seq: Sequence) -> None:
+        """Offer a prefilled sequence's full prompt pages to the cache.
+        First writer wins per hash; only the leading pages that ARE the
+        cache's pages count as cacheable on release (a losing page would
+        park in the evictable pool with no hash pointing at it)."""
+        if not self.config.enable_prefix_caching:
+            return
+        cacheable = 0
+        for i, h in enumerate(self._prefix_hashes(seq.prompt_ids)):
+            if i >= len(seq.pages):
+                break
+            page = self._prefix_cache.get(h)
+            if page is None:
+                self._prefix_cache[h] = seq.pages[i]
+                self._prefix_rev.setdefault(seq.pages[i], []).append(h)
+                cacheable = i + 1
+            elif page == seq.pages[i]:
+                cacheable = i + 1  # re-admission re-matched the same page
+            else:
+                break  # a different page already serves this chain
+        seq.cacheable_pages = cacheable
+
+    def _drop_page_hashes(self, page: int) -> None:
+        for h in self._prefix_rev.pop(page, []):
+            if self._prefix_cache.get(h) == page:
+                del self._prefix_cache[h]
+
+    def invalidate_prefix_cache(self) -> None:
+        """Forget every cached prefix and return the parked pages to the
+        free list — required when the engine rebuilds the KV buffers
+        (after a failed step): the page ids would otherwise still match
+        hash chains while pointing at zeroed content."""
+        for page in list(self.allocator._cached):
+            self.allocator.drop_cached(page)
+        self._prefix_cache.clear()
+        self._prefix_rev.clear()
+        for seq in list(self.running.values()) + list(self.waiting):
+            seq.cacheable_pages = 0  # nothing may re-park as cached
 
     # --- queue ------------------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -160,11 +316,28 @@ class Scheduler:
             if max_new is not None and len(admitted) >= max_new:
                 break
             seq = self.waiting[0]
-            need = self._pages_needed(seq.num_tokens)
+            matched: List[int] = []
+            if self.config.enable_prefix_caching:
+                matched = self._match_prefix(seq.prompt_ids)
+                # Share FIRST: matched refcount-0 pages leave the
+                # evictable pool, so the fresh alloc below cannot evict
+                # them out from under us.
+                for page in matched:
+                    self.allocator.share(page)
+            need = self._pages_needed(seq.num_tokens) - len(matched)
             try:
-                seq.pages = self.allocator.alloc(need)
+                fresh = self.allocator.alloc(need) if need > 0 else []
             except OutOfPages:
+                for page in matched:  # undo the shares; stay cacheable
+                    self.allocator.free([page], cacheable=True)
                 break
+            seq.pages = matched + fresh
+            seq.prefix_len = len(matched) * self.config.page_size
+            # Matched pages are cache-registered by construction; they
+            # must park back in the evictable pool on release even if
+            # this sequence never re-registers (e.g. finishes early).
+            seq.cacheable_pages = len(matched)
+            self.prefix_hits += len(matched)
             self.waiting.popleft()
             seq.slot = free_slots.pop(0)
             seq.admitted_at = self._tick
@@ -238,22 +411,28 @@ class Scheduler:
 
     def finish(
         self, seq: Sequence, reason: str, *, defer_pages: bool = False
-    ) -> List[int]:
-        """Finish a sequence. With ``defer_pages`` the slot is released but
-        the KV pages are detached and *returned* instead of freed — the
-        engine holds them until every in-flight device step that may still
-        write them has completed, then calls ``release_pages``."""
+    ) -> Tuple[List[int], int]:
+        """Finish a sequence. With ``defer_pages`` the slot is released
+        but the KV pages are detached and *returned* (with the count of
+        leading cache-registered pages) instead of freed — the engine
+        holds them until every in-flight device step that may still write
+        them has completed, then calls ``release_pages``."""
         seq.finish_reason = reason
-        pages = seq.pages if defer_pages else []
+        pages, cacheable = [], 0
         if defer_pages:
+            pages = seq.pages
+            cacheable = min(seq.cacheable_pages, len(pages))
             seq.pages = []
         self._release(seq)
-        return pages
+        return pages, cacheable
 
-    def release_pages(self, pages: List[int]) -> None:
-        """Return deferred pages (from ``finish(defer_pages=True)``)."""
-        if pages:
-            self.allocator.free(pages)
+    def release_pages(self, pages: List[int], cacheable: int = 0) -> None:
+        """Return deferred pages (from ``finish(defer_pages=True)``); the
+        leading ``cacheable`` pages park in the evictable prefix pool."""
+        if cacheable:
+            self.allocator.free(pages[:cacheable], cacheable=True)
+        if pages[cacheable:]:
+            self.allocator.free(pages[cacheable:])
 
     def _release(self, seq: Sequence) -> None:
         if seq.slot >= 0:
@@ -261,13 +440,15 @@ class Scheduler:
             seq.slot = -1
         self.running.pop(seq.rid, None)
         if seq.pages:
-            self.allocator.free(seq.pages)
+            self.release_pages(
+                seq.pages, min(seq.cacheable_pages, len(seq.pages))
+            )
             seq.pages = []
 
     # --- introspection ----------------------------------------------------
     def stats(self) -> Dict[str, float]:
         total_pages = self.config.num_pages - 1
-        return {
+        out = {
             "running": len(self.running),
             "waiting": len(self.waiting),
             "slots": self.config.max_num_seqs,
@@ -275,14 +456,25 @@ class Scheduler:
             "kv_page_utilization": (total_pages - self.allocator.available)
             / max(1, total_pages),
         }
+        if self.config.enable_prefix_caching:
+            out["prefix_cache_hit_pages"] = self.prefix_hits
+        return out
 
     def check_invariants(self) -> None:
         """Debug/test hook: assert the documented invariants."""
-        owned: List[int] = []
+        counts: Dict[int, int] = {}
         for seq in self.running.values():
             assert self.slots[seq.slot] is seq
             assert self._pages_needed(seq.num_tokens) <= len(seq.pages)
-            owned.extend(seq.pages)
-        assert 0 not in owned, "scratch page handed out"
-        assert len(owned) == len(set(owned)), "page owned twice"
-        assert len(owned) + self.allocator.available == self.config.num_pages - 1
+            for page in seq.pages:
+                counts[page] = counts.get(page, 0) + 1
+        assert 0 not in counts, "scratch page handed out"
+        for page, n in counts.items():
+            rc = self.allocator.refcount(page)
+            assert rc == n, f"page {page}: refcount {rc} != {n} owners"
+        if not self.config.enable_prefix_caching:
+            assert all(n == 1 for n in counts.values()), "page owned twice"
+        assert (
+            len(counts) + self.allocator.available
+            == self.config.num_pages - 1
+        )

@@ -492,3 +492,64 @@ class TestChunkedPrefill:
         a = self._run([("s", "same seed", seeded)], prefill_chunk_size=8)["s"]
         b = self._run([("s", "same seed", seeded)])["s"]
         assert a.token_ids == b.token_ids  # same slot, same base key
+
+
+class TestPrefixCaching:
+    """enable_prefix_caching through the full engine: identical leading
+    pages are computed once and shared; outputs match the uncached run."""
+
+    def _core(self, cache):
+        return make_core(
+            engine=dict(
+                prefill_chunk_size=8,
+                enable_prefix_caching=cache,
+                num_pages=60,
+                max_num_seqs=4,
+            )
+        )
+
+    def test_cached_matches_uncached(self):
+        shared = "common instruction prefix! " * 2  # > several pages
+        reqs = [
+            (f"r{i}", shared + f"document {i}", greedy(6)) for i in range(6)
+        ]
+        golden = run_sync(self._core(False), reqs)
+        core = self._core(True)
+        outs = run_sync(core, reqs)
+        for rid, out in golden.items():
+            assert outs[rid].token_ids == out.token_ids, rid
+        # later requests actually reused pages
+        assert core.scheduler.prefix_hits > 0
+        core.scheduler.check_invariants()
+
+    def test_prefix_survives_sharer_churn(self):
+        """Short cached requests finish and release while later ones are
+        still matching the same prefix — refcounts must stay consistent
+        through the deferred-release pipeline."""
+        shared = "x" * 20
+        core = self._core(True)
+        reqs = [(f"r{i}", shared + str(i), greedy(2 + i % 3)) for i in range(10)]
+        outs = run_sync(core, reqs)
+        assert len(outs) == 10
+        core.scheduler.check_invariants()
+        golden = run_sync(self._core(False), reqs)
+        for rid, out in golden.items():
+            assert outs[rid].token_ids == out.token_ids, rid
+
+    def test_requires_chunked_prefill(self):
+        with pytest.raises(ValueError):
+            make_core(engine=dict(enable_prefix_caching=True))
+
+    def test_abort_invalidates_prefix_cache(self):
+        """After abort_all rebuilds (zeroes) the KV buffers, stale prefix
+        hashes must not hand future requests empty context."""
+        core = self._core(True)
+        shared = "common instruction prefix! " * 2
+        run_sync(core, [("warm", shared + "tail", greedy(3))])
+        assert core.scheduler._prefix_cache  # cache is warm
+        core.abort_all("error")
+        assert not core.scheduler._prefix_cache
+        outs = run_sync(core, [("after", shared + "t2", greedy(3))])
+        assert core.scheduler.prefix_hits == 0  # recomputed, not matched
+        assert outs["after"].completion_tokens == 3
+        core.scheduler.check_invariants()

@@ -175,3 +175,113 @@ def test_randomized_invariants():
                 sched.finish(seq, "stop")
             live.remove(seq)
         sched.check_invariants()
+
+
+class TestPrefixCaching:
+    def _sched(self, **over):
+        from llmq_tpu.engine.scheduler import Scheduler, SchedulerConfig
+
+        cfg = dict(
+            max_num_seqs=4, num_pages=20, page_size=4, max_model_len=32,
+            enable_prefix_caching=True,
+        )
+        cfg.update(over)
+        return Scheduler(SchedulerConfig(**cfg))
+
+    def _seq(self, rid, ids, max_tokens=4):
+        from llmq_tpu.engine.sampling import SamplingParams
+        from llmq_tpu.engine.scheduler import Sequence
+
+        return Sequence(rid=rid, prompt_ids=list(ids),
+                        params=SamplingParams(max_tokens=max_tokens))
+
+    def test_allocator_refcounts_and_eviction(self):
+        from llmq_tpu.engine.scheduler import OutOfPages, PageAllocator
+
+        alloc = PageAllocator(6)  # pages 1..5 usable
+        evicted = []
+        alloc.on_evict = evicted.append
+        a = alloc.alloc(2)
+        alloc.share(a[0])
+        assert alloc.refcount(a[0]) == 2
+        alloc.free([a[0]], cacheable=True)  # rc 2 -> 1, still allocated
+        assert alloc.refcount(a[0]) == 1
+        alloc.free([a[0]], cacheable=True)  # rc 0 -> evictable pool
+        assert alloc.refcount(a[0]) == 0
+        assert alloc.available == 4  # 3 free + 1 cached
+        alloc.share(a[0])  # revive from the pool
+        assert alloc.refcount(a[0]) == 1 and not evicted
+        alloc.free([a[0]], cacheable=True)
+        alloc.alloc(4)  # forces eviction of the cached page
+        assert evicted == [a[0]]
+        with pytest.raises(OutOfPages):
+            alloc.alloc(1)
+        alloc.free([a[1]])
+        assert alloc.alloc(1)  # plain free-list reuse
+
+    def test_shared_prefix_pages_and_tail_divergence(self):
+        sched = self._sched()
+        shared = list(range(100, 109))  # 2 full pages + 1 extra token
+        s1 = self._seq("a", shared + [1, 2])
+        sched.add(s1)
+        sched.admit()
+        assert s1.prefix_len == 0  # cold cache
+        sched.register_prefix(s1)
+        assert s1.cacheable_pages == 2
+        s2 = self._seq("b", shared + [7, 8, 9])  # same prefix, new tail
+        sched.add(s2)
+        sched.admit()
+        assert s2.prefix_len == 8  # 2 pages x 4 reused
+        assert s2.pages[:2] == s1.pages[:2]
+        assert s2.pages[2] != s1.pages[2]  # tails stay private
+        assert sched.allocator.refcount(s1.pages[0]) == 2
+        sched.check_invariants()
+        # releasing one sharer keeps the other's pages valid
+        sched.finish(s1, "stop")
+        assert sched.allocator.refcount(s2.pages[0]) == 1
+        sched.check_invariants()
+        # a third request after s1 is gone still hits the cache
+        s3 = self._seq("c", shared)
+        sched.add(s3)
+        sched.admit()
+        assert s3.prefix_len == 8
+        sched.check_invariants()
+
+    def test_full_page_prompt_keeps_last_position_private(self):
+        sched = self._sched()
+        ids = list(range(50, 58))  # exactly 2 full pages
+        s1 = self._seq("a", ids)
+        sched.add(s1)
+        sched.admit()
+        sched.register_prefix(s1)
+        assert s1.cacheable_pages == 1  # (8-1)//4: last position recomputed
+        s2 = self._seq("b", ids)
+        sched.add(s2)
+        sched.admit()
+        assert s2.prefix_len == 4  # only the first page reused
+
+    def test_cached_pages_survive_release_and_get_evicted_under_pressure(self):
+        sched = self._sched(num_pages=8)  # 7 usable
+        s1 = self._seq("a", list(range(60, 69)))  # 3 pages (2 full)
+        sched.add(s1)
+        sched.admit()
+        sched.register_prefix(s1)
+        sched.finish(s1, "stop")
+        assert sched.allocator.available == 7  # 2 cached + 5 free
+        s2 = self._seq("b", list(range(60, 69)))
+        sched.add(s2)
+        sched.admit()
+        assert s2.prefix_len == 8  # revived from the evictable pool
+        sched.finish(s2, "stop")
+        # unrelated demand evicts the cached pages and drops their hashes
+        big = self._seq("c", list(range(200, 227)))  # 7 pages
+        sched.add(big)
+        sched.admit()
+        assert big.prefix_len == 0
+        sched.check_invariants()
+        sched.finish(big, "stop")
+        s3 = self._seq("d", list(range(60, 69)))
+        sched.add(s3)
+        sched.admit()
+        assert s3.prefix_len == 0  # cache was invalidated by eviction
+        sched.check_invariants()
