@@ -192,9 +192,16 @@ def worker() -> None:
 @click.option("--max-num-seqs", type=int, default=None, help="Engine batch slots")
 @click.option("--max-model-len", type=int, default=None, help="Context window cap")
 @click.option("--dtype", default="bfloat16", show_default=True)
+@click.option("--prefill-chunk", type=int, default=None,
+              help="Chunked prefill: positions per chunk (any prompt "
+                   "length through one executable; decode interleaves "
+                   "between chunks). Default: bucketed whole-prompt prefill")
+@click.option("--prefix-caching", is_flag=True,
+              help="Reuse cached KV for shared prompt prefixes "
+                   "(requires --prefill-chunk)")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
-               dtype):
+               dtype, prefill_chunk, prefix_caching):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -207,6 +214,8 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
         dtype=dtype,
+        prefill_chunk_size=prefill_chunk,
+        enable_prefix_caching=prefix_caching,
     )
 
 

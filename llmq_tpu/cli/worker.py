@@ -23,6 +23,8 @@ def run_tpu_worker(
     max_num_seqs: Optional[int] = None,
     max_model_len: Optional[int] = None,
     dtype: str = "bfloat16",
+    prefill_chunk_size: Optional[int] = None,
+    enable_prefix_caching: bool = False,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
@@ -42,6 +44,8 @@ def run_tpu_worker(
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
         dtype=dtype,
+        prefill_chunk_size=prefill_chunk_size,
+        enable_prefix_caching=enable_prefix_caching,
     )
     _run(worker)
 

@@ -119,6 +119,18 @@ class Config(BaseModel):
         description="Default max new tokens per request (per-job override allowed).",
     )
 
+    prefill_chunk_size: Optional[int] = Field(
+        default_factory=lambda: _env_int("LLMQ_PREFILL_CHUNK"),
+        description="Chunked prefill: positions per chunk (None = bucketed).",
+    )
+
+    enable_prefix_caching: bool = Field(
+        default_factory=lambda: (_env("LLMQ_PREFIX_CACHING") or "").lower()
+        in ("1", "true", "yes"),
+        description="Reuse cached KV for shared prompt prefixes "
+        "(requires prefill_chunk_size).",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

@@ -42,6 +42,8 @@ class TPUWorker(BaseWorker):
         dtype: str = "bfloat16",
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
+        prefill_chunk_size: Optional[int] = None,
+        enable_prefix_caching: bool = False,
         **kwargs,
     ) -> None:
         self.model = model
@@ -53,6 +55,8 @@ class TPUWorker(BaseWorker):
         self._dtype = dtype
         self._page_size = page_size
         self._num_pages = num_pages
+        self._prefill_chunk_size = prefill_chunk_size
+        self._enable_prefix_caching = enable_prefix_caching
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
@@ -64,6 +68,16 @@ class TPUWorker(BaseWorker):
         slots = max_num_seqs or self.config.max_num_seqs
         if kwargs.get("concurrency") is None and slots:
             self.concurrency = max(self.concurrency, slots + slots // 2)
+        # Fail the config contradiction NOW — EngineCore would also raise,
+        # but only after minutes of checkpoint streaming.
+        if (self._enable_prefix_caching or self.config.enable_prefix_caching) and not (
+            self._prefill_chunk_size or self.config.prefill_chunk_size
+        ):
+            raise ValueError(
+                "--prefix-caching requires --prefill-chunk (or "
+                "LLMQ_PREFILL_CHUNK): only chunked prefill can start "
+                "mid-prompt"
+            )
 
     # --- identity (reference vllm_worker.py:39-50) ------------------------
     def _generate_worker_id(self) -> str:
@@ -140,6 +154,11 @@ class TPUWorker(BaseWorker):
             overrides["page_size"] = self._page_size
         if self._num_pages:
             overrides["num_pages"] = self._num_pages
+        chunk = self._prefill_chunk_size or self.config.prefill_chunk_size
+        if chunk:
+            overrides["prefill_chunk_size"] = chunk
+        if self._enable_prefix_caching or self.config.enable_prefix_caching:
+            overrides["enable_prefix_caching"] = True
         engine_config = EngineConfig(
             hbm_utilization=self.config.hbm_utilization,
             kv_dtype=dtype,
