@@ -4,7 +4,6 @@ The round-3 finding: a small test pool fits in VMEM and makes any kernel
 look infinitely fast — benchmark only with the full stacked [L,P,...]
 pool (2.3 GiB per K and V at the 3B bench config).
 """
-import functools
 import time
 
 import jax
