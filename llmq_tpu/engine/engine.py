@@ -615,14 +615,28 @@ class EngineCore:
         latency is unchanged.
         """
         finished: List[RequestOutput] = []
+        # Sequences decodable BEFORE this wave: only they justify
+        # interleaving decode between admission chunks — a cold-start
+        # wave decoding its own fresh rows would pay full-cost steps at
+        # tiny occupancy, the exact waste batching the wave avoids.
+        pre_wave = [s.rid for s in self._decodable_seqs()]
         while self._try_admit(finished):
-            pass
+            if any(rid in self.scheduler.running for rid in pre_wave):
+                # Partial refill (e.g. 2 chunks admitted while 176 slots
+                # decode): the decoders pay short stalls between chunks
+                # instead of one long one.
+                self._dispatch_decode(finished)
         if self.scheduler.running:
             self._dispatch_decode(finished)
         elif self._pending:
             self._process_oldest(finished)
         self._flush_deferred()
         return finished
+
+    def _decodable_seqs(self) -> List[Sequence]:
+        """Running sequences the decode step actually advances (prefilled;
+        mid-prefill rows are in ``running`` but have no decode state)."""
+        return [s for s in self.scheduler.running.values() if s.prefilled]
 
     def _try_admit(self, finished: List[RequestOutput]) -> bool:
         """Admit + prefill up to one chunk; True if anything was admitted
@@ -807,6 +821,10 @@ class EngineCore:
             n = seq.num_tokens
             bucket = next(b for b in self._buckets if b >= n)
             by_bucket.setdefault(bucket, []).append(seq)
+        # Decode interleaving across a multi-chunk wave happens at the
+        # step() level (one decode per _try_admit round); per-chunk
+        # interleaving inside one call only matters for the chunked path,
+        # where a single long prompt spans many dispatches.
         for bucket, group in by_bucket.items():
             for i in range(0, len(group), self.cfg.max_prefill_batch):
                 self._prefill_chunk(group[i : i + self.cfg.max_prefill_batch],
@@ -823,6 +841,11 @@ class EngineCore:
         C = self.cfg.prefill_chunk_size
         B = self.cfg.max_prefill_batch
         repl = self._repl
+        # Interleave decode only for sequences decodable BEFORE this
+        # wave: a cold-start wave interleaving its own fresh rows would
+        # pay full-cost decode steps at tiny occupancy — the waste wave
+        # admission exists to avoid.
+        pre_wave = [s.rid for s in self._decodable_seqs()]
         for i in range(0, len(seqs), B):
             rows = seqs[i : i + B]
             # Snapshot every chunk-invariant per-row value ONCE, and ship
@@ -895,14 +918,13 @@ class EngineCore:
                     self._mode = sampling_mod.join_modes(
                         (self._mode, chunk_mode)
                     )
-                # Interleave: let already-DECODABLE sequences advance while
-                # the next chunk queues behind this one on the device
-                # stream. Mid-prefill rows are in `running` too, so the
-                # guard must ask for a prefilled one — an idle engine's
-                # long first prompt must not pay an empty decode step per
-                # chunk.
+                # Interleave: let pre-wave sequences advance while the
+                # next chunk queues behind this one on the device stream
+                # (an idle engine's long first prompt must not pay an
+                # empty decode step per chunk, and a cold-start wave must
+                # not decode its own fresh rows at tiny occupancy).
                 if lo + C < maxlen and any(
-                    s.prefilled for s in self.scheduler.running.values()
+                    rid in self.scheduler.running for rid in pre_wave
                 ):
                     self._dispatch_decode(finished)
 
@@ -981,9 +1003,7 @@ class EngineCore:
         # preempting/length-finishing a row whose chunk loop is still in
         # flight (zombie-slot corruption).
         lookahead = self._pending_decodes + 2
-        decodable = [
-            s for s in self.scheduler.running.values() if s.prefilled
-        ]
+        decodable = self._decodable_seqs()
         needs_pages = any(
             -(-self._page_target(seq, lookahead) // self.cfg.page_size)
             > len(seq.pages)
