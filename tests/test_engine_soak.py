@@ -1,0 +1,133 @@
+"""Randomized engine soak: every feature at once under page pressure.
+
+A chaos-style stability sweep (SURVEY §5 race-detection spirit): random
+prompt lengths, generation budgets, sampling modes, stop tokens, and a
+page pool tight enough to force preemption — through the chunked-prefill
++ prefix-caching engine — asserting every request completes, greedy
+outputs match a roomy reference engine, and the scheduler invariants
+hold at the end. Marked slow; CI runs it (it is seconds on the tiny
+model), but it is excluded from -m unit selections.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.slow
+
+CFG = ModelConfig.tiny(vocab_size=304)
+PARAMS = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+TEMPLATE = "shared soak template: "
+
+
+def _core(num_pages, **over):
+    eng = dict(
+        max_num_seqs=6,
+        max_model_len=64,
+        page_size=8,
+        num_pages=num_pages,
+        kv_dtype=jnp.float32,
+        min_prefill_bucket=16,
+        max_prefill_batch=2,
+    )
+    eng.update(over)
+    return EngineCore(
+        CFG, PARAMS, ByteTokenizer(), mesh=make_mesh(tensor_parallel=1),
+        engine_config=EngineConfig(**eng),
+    )
+
+
+def _requests(rng, n):
+    reqs = []
+    for i in range(n):
+        kind = rng.integers(0, 4)
+        prompt = TEMPLATE + "x" * int(rng.integers(0, 30)) + f" doc {i}"
+        if kind == 0:
+            p = SamplingParams(temperature=0.0, max_tokens=int(rng.integers(1, 9)),
+                               ignore_eos=True)
+        elif kind == 1:
+            p = SamplingParams(temperature=0.8, seed=int(rng.integers(0, 99)),
+                               max_tokens=int(rng.integers(1, 9)), ignore_eos=True)
+        elif kind == 2:
+            p = SamplingParams(temperature=0.5, top_k=8, top_p=0.9,
+                               seed=int(rng.integers(0, 99)),
+                               max_tokens=int(rng.integers(1, 9)), ignore_eos=True)
+        else:
+            p = SamplingParams(temperature=0.0, max_tokens=8,
+                               stop_token_ids=(int(rng.integers(1, 304)),),
+                               ignore_eos=True)
+        reqs.append((f"r{i}", prompt, p))
+    return reqs
+
+
+def _drive(core, reqs, rng):
+    """Feed requests in random dribbles (not one wave) and drain."""
+    outs = {}
+    pending = list(reqs)
+    for _ in range(3000):
+        for _ in range(int(rng.integers(0, 3))):
+            if pending:
+                rid, prompt, p = pending.pop(0)
+                core.add_request(rid, prompt=prompt, params=p)
+        for o in core.step():
+            outs[o.rid] = o
+        if not pending and not core.has_work:
+            break
+    assert not pending and len(outs) == len(reqs), (len(outs), len(reqs))
+    return outs
+
+
+def test_soak_preemption_under_cache_pressure():
+    """Pool small enough that decode growth preempts running sequences
+    while the prefix cache is live — preempted rows re-match the cache on
+    re-admission and still reach their full budgets."""
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(20):
+        prompt = TEMPLATE + "x" * int(rng.integers(0, 20)) + f" doc {i}"
+        reqs.append(
+            (f"r{i}", prompt,
+             SamplingParams(temperature=0.0,
+                            max_tokens=int(rng.integers(8, 24)),
+                            ignore_eos=True))
+        )
+    core = _core(14, prefill_chunk_size=8, enable_prefix_caching=True)
+    preempts = {"n": 0}
+    orig = core.scheduler.preempt
+    core.scheduler.preempt = lambda s: (
+        preempts.__setitem__("n", preempts["n"] + 1), orig(s))[1]
+    outs = _drive(core, reqs, np.random.default_rng(100))
+    core.scheduler.check_invariants()
+    assert preempts["n"] > 0, "pool was not tight enough to preempt"
+    roomy = _core(120)
+    golden = _drive(roomy, reqs, np.random.default_rng(100))
+    for rid, _, _ in reqs:
+        assert outs[rid].token_ids == golden[rid].token_ids, rid
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_tight_pool_chunked_cached(seed):
+    rng = np.random.default_rng(seed)
+    reqs = _requests(rng, 28)
+    tight = _core(  # ~3.2 pages/slot: preemption + cache eviction churn
+        20, prefill_chunk_size=8, enable_prefix_caching=True
+    )
+    outs = _drive(tight, reqs, np.random.default_rng(seed + 100))
+    tight.scheduler.check_invariants()
+    # greedy requests must match a roomy, uncached, bucketed engine
+    roomy = _core(120)
+    golden = _drive(roomy, reqs, np.random.default_rng(seed + 100))
+    for (rid, _, p) in reqs:
+        if p.temperature == 0.0:
+            assert outs[rid].token_ids == golden[rid].token_ids, rid
+    # completion budgets respected everywhere
+    for (rid, _, p) in reqs:
+        assert outs[rid].completion_tokens <= p.max_tokens
