@@ -706,12 +706,15 @@ class EngineCore:
         if kind == "decode":
             self._pending_decodes -= 1
         tokens = np.asarray(out)  # transfer started at dispatch; ~ready
-        for row, seq in snapshot:
+        for row, seq, epoch in snapshot:
             if (
                 seq.finish_reason is not None
                 or seq.rid not in self.scheduler.running
+                or seq.epoch != epoch
             ):
-                continue  # finished/preempted while this step was in flight
+                # Finished, preempted, or preempted-and-readmitted (epoch
+                # mismatch) while this step was in flight.
+                continue
             self._append_and_check(seq, int(tokens[row]), finished)
         self._processed_idx = idx
 
@@ -733,7 +736,12 @@ class EngineCore:
         self._dispatch_idx += 1
         if kind == "decode":
             self._pending_decodes += 1
-        self._pending.append((self._dispatch_idx, kind, out, snapshot))
+        # Stamp each row with its sequence's preemption epoch: a row
+        # snapshotted before a self-preemption must not be appended after
+        # the sequence is re-admitted (its token came from abandoned
+        # device state).
+        stamped = [(row, seq, seq.epoch) for row, seq in snapshot]
+        self._pending.append((self._dispatch_idx, kind, out, stamped))
 
     def _resync(self) -> None:
         """Rebuild the device decode state from scheduler truth. Only valid
@@ -1042,15 +1050,28 @@ class EngineCore:
                             preemptible=lambda s: s.prefilled,
                         )
                     except OutOfPages:
-                        # Alone and still short: the pool itself is the
-                        # cap. Must go through _finish_seq: pages stay
-                        # deferred while in-flight steps may write them,
-                        # and the dirty resync deactivates the device slot
-                        # (a zombie slot would keep scattering KV through
-                        # its stale block table into reallocated pages).
-                        self._finish_seq(seq, "length",
-                                         device_detected=False,
-                                         finished=finished)
+                        if len(self.scheduler.running) == 1:
+                            # Truly alone and still short: the pool
+                            # itself is the cap. Must go through
+                            # _finish_seq: pages stay deferred while
+                            # in-flight steps may write them, and the
+                            # dirty resync deactivates the device slot (a
+                            # zombie slot would keep scattering KV through
+                            # its stale block table into reallocated
+                            # pages).
+                            self._finish_seq(seq, "length",
+                                             device_detected=False,
+                                             finished=finished)
+                        else:
+                            # Others hold the pool (e.g. only mid-prefill
+                            # rows, which are never preemption victims):
+                            # self-preempt instead of truncating — the
+                            # request retries once pages free (vLLM
+                            # recompute-preemption parity), keeping its
+                            # generated tokens. Pages defer like a finish
+                            # (in-flight steps may still write them) and
+                            # the dirty resync deactivates the slot.
+                            self._self_preempt_deferred(seq)
                         continue
                     self._dirty = True
             if grown and not self._dirty:
@@ -1076,6 +1097,20 @@ class EngineCore:
         while len(self._pending) > self.cfg.runahead:
             self._process_oldest(finished)
 
+    def _self_preempt_deferred(self, seq: Sequence) -> None:
+        """Preempt ``seq`` itself with finish-style page deferral: its
+        pages return to the allocator only after every in-flight step
+        that may write them has been processed. Generated tokens are
+        kept; re-admission re-prefills prompt+output. The epoch bump in
+        ``Scheduler.preempt`` keeps stale in-flight results (snapshotted
+        before the preemption) from being appended after re-admission."""
+        pages, cacheable = self.scheduler.preempt(seq, defer_pages=True)
+        if pages:
+            self._deferred_pages.append(
+                (self._dispatch_idx, pages, cacheable)
+            )
+        self._dirty = True
+
     def _swap_block_tables(self) -> None:
         """Ship grown block tables into the device state without draining:
         one small h2d transfer, no dispatch, no resync."""
@@ -1100,18 +1135,11 @@ class EngineCore:
         self, seq: Sequence, token: int, finished: List[RequestOutput]
     ) -> None:
         seq.output_ids.append(token)
-        try:
-            # Pages were pre-allocated at dispatch time; this is a no-op
-            # except in pathological pool-exhaustion (no preemption here —
-            # in-flight steps forbid freeing a victim's pages).
-            self.scheduler.ensure_pages(
-                seq, seq.num_tokens + 1, allow_preempt=False
-            )
-        except OutOfPages:
-            self._finish_seq(seq, "length", device_detected=False,
-                             finished=finished)
-            return
         self.total_generated_tokens += 1
+        # Stops are checked BEFORE the page top-up: a stopping sequence
+        # needs no more pages, and the pool-pressure retry below must not
+        # swallow a stop/budget finish (a preempted-at-budget row would
+        # re-prefill and sample one token past max_tokens).
         reason = self._stop_reason(seq, token)
         if reason is not None:
             # The device detects token-based stops and length caps itself
@@ -1120,6 +1148,40 @@ class EngineCore:
             device_detected = seq.finish_text is None
             self._finish_seq(seq, reason, device_detected=device_detected,
                              finished=finished)
+            return
+        try:
+            # Pages were pre-allocated at dispatch time; this is a no-op
+            # except under pool exhaustion (no preemption here — in-flight
+            # steps forbid freeing a victim's pages).
+            self.scheduler.ensure_pages(
+                seq, seq.num_tokens + 1, allow_preempt=False
+            )
+        except OutOfPages:
+            # Release anything already past the watermark, then retry —
+            # an earlier finish/self-preempt in this very drain may have
+            # deferred exactly the pages we need.
+            self._flush_deferred()
+            try:
+                self.scheduler.ensure_pages(
+                    seq, seq.num_tokens + 1, allow_preempt=False
+                )
+                return
+            except OutOfPages:
+                pass
+            if (
+                len(self.scheduler.running) == 1
+                and not self._deferred_pages
+            ):
+                # Truly alone with nothing pending release: the pool is
+                # the cap and retrying would replay to this exact point
+                # forever — truncate.
+                self._finish_seq(seq, "length", device_detected=False,
+                                 finished=finished)
+            else:
+                # Others hold the pool (or deferred pages will free it):
+                # retry later instead of truncating (recompute
+                # preemption) — generated tokens are kept.
+                self._self_preempt_deferred(seq)
 
     def _finish_seq(
         self,

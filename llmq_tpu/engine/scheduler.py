@@ -145,6 +145,9 @@ class Sequence:
     # in the evictable pool instead of the free list when released).
     prefix_len: int = 0
     cacheable_pages: int = 0
+    # Bumped on every preemption: in-flight device results snapshotted
+    # under an older epoch must not be appended after re-admission.
+    epoch: int = 0
     slot: int = -1
     admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
     preempt_count: int = 0
@@ -400,14 +403,27 @@ class Scheduler:
             return None
         return max(candidates, key=lambda s: s.admitted_at)
 
-    def preempt(self, seq: Sequence) -> None:
+    def preempt(
+        self, seq: Sequence, *, defer_pages: bool = False
+    ) -> Tuple[List[int], int]:
         """Evict a running sequence back to the waiting queue (head, so it
         resumes first). Its generated tokens are kept; re-admission
-        re-prefills prompt+generated to rebuild the KV cache."""
+        re-prefills prompt+generated to rebuild the KV cache. With
+        ``defer_pages`` (self-preemption while steps are in flight) the
+        pages are detached and returned like ``finish(defer_pages=True)``
+        instead of freed — the engine releases them at the watermark."""
+        seq.epoch += 1  # stale in-flight results must not resurface
+        pages, cacheable = [], 0
+        if defer_pages:
+            pages = seq.pages
+            cacheable = min(seq.cacheable_pages, len(pages))
+            seq.pages = []
+            seq.cacheable_pages = 0
         self._release(seq)
         seq.preempt_count += 1
         seq.prefilled = False  # KV is gone; re-admission re-prefills
         self.waiting.appendleft(seq)
+        return pages, cacheable
 
     def finish(
         self, seq: Sequence, reason: str, *, defer_pages: bool = False

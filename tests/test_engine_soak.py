@@ -102,11 +102,14 @@ def test_soak_preemption_under_cache_pressure():
     core = _core(14, prefill_chunk_size=8, enable_prefix_caching=True)
     preempts = {"n": 0}
     orig = core.scheduler.preempt
-    core.scheduler.preempt = lambda s: (
-        preempts.__setitem__("n", preempts["n"] + 1), orig(s))[1]
+    core.scheduler.preempt = lambda s, **kw: (
+        preempts.__setitem__("n", preempts["n"] + 1), orig(s, **kw))[1]
     outs = _drive(core, reqs, np.random.default_rng(100))
     core.scheduler.check_invariants()
     assert preempts["n"] > 0, "pool was not tight enough to preempt"
+    # Recompute preemption must be LOSSLESS: every greedy output matches
+    # the roomy engine bit-for-bit despite ~10 preemptions (incl. the
+    # self-preempt path when only mid-prefill rows hold the pool).
     roomy = _core(120)
     golden = _drive(roomy, reqs, np.random.default_rng(100))
     for rid, _, _ in reqs:
