@@ -553,3 +553,12 @@ class TestPrefixCaching:
         assert core.scheduler.prefix_hits == 0  # recomputed, not matched
         assert outs["after"].completion_tokens == 3
         core.scheduler.check_invariants()
+
+
+def test_param_auto_layout_matches_default(monkeypatch):
+    """LLMQ_PARAM_AUTO_LAYOUT=1 (XLA-chosen parameter layouts) must not
+    change outputs — layout is memory order, not math."""
+    golden = run_sync(make_core(), [("r", "hello layout", greedy(5))])
+    monkeypatch.setenv("LLMQ_PARAM_AUTO_LAYOUT", "1")
+    outs = run_sync(make_core(), [("r", "hello layout", greedy(5))])
+    assert outs["r"].token_ids == golden["r"].token_ids
