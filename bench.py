@@ -224,20 +224,49 @@ def peak_flops_per_chip(devices) -> float:
     return 100e9  # CPU-ish placeholder so mfu stays finite
 
 
-def pick_decode_kernel(jax, config, *, max_seqs: int, page_size: int) -> str:
+def pick_decode_kernel() -> str:
     """Quick on-hardware A/B of the paged-decode kernels (v1 BlockSpec
-    pipeline vs v2 chunked manual-DMA) at an HBM-resident pool size, so
-    the headline run uses whichever is actually faster on this chip.
-    An explicit LLMQ_DECODE_KERNEL always wins; any failure → v1.
+    pipeline vs v2 chunked manual-DMA), run in a SUBPROCESS under a
+    deadline. Two reasons for the subprocess: a kernel hang on a flaky
+    tunnel must cost at most the A/B budget, never the headline run, and
+    on standard TPU VMs libtpu is EXCLUSIVE — the probe must run (and
+    exit) before this process initialises the backend. The child derives
+    its own preset/shape from the same env knobs main() uses. An explicit
+    LLMQ_DECODE_KERNEL always wins; any failure/timeout → v1.
+    """
+    import subprocess
+
+    explicit = os.environ.get("LLMQ_DECODE_KERNEL")
+    if explicit:
+        return explicit
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--kernel-ab-probe"],
+            timeout=float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420)),
+            capture_output=True,
+            text=True,
+        )
+        sys.stderr.write(proc.stderr[-600:])
+        choice = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode == 0 and choice in ("v1", "v2"):
+            return choice
+        print(f"bench: kernel A/B rc={proc.returncode}; using v1", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("bench: kernel A/B timed out; using v1", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"bench: kernel A/B failed ({exc!r}); using v1", file=sys.stderr)
+    return "v1"
+
+
+def _kernel_ab_probe(config, *, max_seqs: int, page_size: int) -> str:
+    """Child-process body of the A/B (see pick_decode_kernel).
 
     The pool must NOT fit in VMEM (~128 MB) or every kernel looks
     infinitely fast (round-3 finding); ~300 MB per side with per-layer
     distinct pages defeats caching while leaving the engine's HBM alone.
     """
-    explicit = os.environ.get("LLMQ_DECODE_KERNEL")
-    if explicit:
-        return explicit
     try:
+        import jax
         import jax.numpy as jnp
         import numpy as np
 
@@ -306,7 +335,54 @@ def pick_decode_kernel(jax, config, *, max_seqs: int, page_size: int) -> str:
         return "v1"
 
 
+def _kernel_ab_probe_main() -> None:
+    """Entry for `bench.py --kernel-ab-probe` (child process). Derives
+    the preset the same way main() will (same env knobs, same HBM), so
+    the A/B measures the shapes the headline run uses."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Testability off-TPU: the axon sitecustomize pins the platform at
+        # the CONFIG level, so the env var alone would still try (and hang
+        # on) the tunnel.
+        from llmq_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+
+    from llmq_tpu.models.presets import get_preset
+
+    devices = jax.devices()
+    try:
+        limit = (devices[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:  # noqa: BLE001
+        limit = None
+    preset = os.environ.get("LLMQ_BENCH_PRESET") or pick_preset(
+        limit, devices[0].platform
+    )
+    config = get_preset(preset)
+    choice = _kernel_ab_probe(
+        config,
+        max_seqs=int(os.environ.get("LLMQ_BENCH_SEQS", 192)),
+        page_size=128,
+    )
+    print(choice)
+
+
 def main() -> None:
+    # Kernel A/B FIRST, while no backend is initialised in this process:
+    # on standard TPU VMs libtpu is exclusive, so the probing child must
+    # own the chip briefly and exit before the parent claims it. Gated on
+    # a healthy backend probe so a dead tunnel costs one probe timeout,
+    # not the A/B budget too.
+    ab_choice = None
+    if (
+        os.environ.get("JAX_PLATFORMS", "") != "cpu"
+        and not os.environ.get("LLMQ_DECODE_KERNEL")
+        and _probe_backend_subprocess(
+            float(os.environ.get("LLMQ_BENCH_INIT_TIMEOUT", 120))
+        )
+    ):
+        ab_choice = pick_decode_kernel()
+
     jax, devices, backend_note = init_devices()
     if jax is None or not devices:
         _emit_failure("none", backend_note or "no devices")
@@ -346,10 +422,8 @@ def main() -> None:
         file=sys.stderr,
     )
     page_size = 8 if on_cpu else 128
-    if not on_cpu:
-        os.environ["LLMQ_DECODE_KERNEL"] = pick_decode_kernel(
-            jax, config, max_seqs=max_seqs, page_size=page_size
-        )
+    if not on_cpu and ab_choice:
+        os.environ["LLMQ_DECODE_KERNEL"] = ab_choice
     params = init_params(config, jax.random.key(0), dtype=dtype)
     mesh = make_mesh(devices=devices)  # all local devices, tp
     core = EngineCore(
@@ -423,7 +497,9 @@ def main() -> None:
     _emit(payload)
 
 
-if __name__ == "__main__":
+if __name__ == "__main__" and "--kernel-ab-probe" in sys.argv:
+    _kernel_ab_probe_main()
+elif __name__ == "__main__":
     # Whole-run watchdog: a tunnel can also wedge *after* init (first jit
     # compile / dispatch blocks in C). If the run exceeds the deadline,
     # the failure JSON still gets emitted before exiting.
