@@ -84,7 +84,8 @@ def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subs
 @cli.command()
 @click.argument("queue_or_pipeline")
 @click.option("-p", "--pipeline", "is_pipeline", is_flag=True, help="Arg is a pipeline YAML")
-@click.option("--timeout", type=float, default=None, help="Idle timeout seconds (exit when no results)")
+@click.option("--timeout", type=float, default=None,
+              help="Idle timeout seconds (exit when no results)")
 @click.option("--limit", type=int, default=None, help="Stop after N results")
 def receive(queue_or_pipeline, is_pipeline, timeout, limit):
     """Receive results as JSONL on stdout."""

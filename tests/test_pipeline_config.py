@@ -64,9 +64,13 @@ def test_stage_templates():
 
 def test_invalid_names():
     with pytest.raises(ValidationError):
-        PipelineConfig.from_yaml_string("name: 'bad name!'\nstages:\n  - name: a\n    worker: dummy\n")
+        PipelineConfig.from_yaml_string(
+            "name: 'bad name!'\nstages:\n  - name: a\n    worker: dummy\n"
+        )
     with pytest.raises(ValidationError):
-        PipelineConfig.from_yaml_string("name: ok\nstages:\n  - name: 'sp ace'\n    worker: dummy\n")
+        PipelineConfig.from_yaml_string(
+            "name: ok\nstages:\n  - name: 'sp ace'\n    worker: dummy\n"
+        )
 
 
 def test_duplicate_stage_names():
