@@ -31,6 +31,10 @@ TPU-native design differs from vLLM's CUDA core on purpose:
       them has been processed (watermark on the dispatch counter).
 - **Host scheduler, device compute.** `engine/scheduler.py` owns slots
   and KV pages in plain Python; resyncs rebuild the device state from it.
+  Pages are refcounted: automatic prefix caching shares the leading full
+  prompt pages of identical prefixes (blake2b chain match) and evicts
+  lazily, and pool exhaustion triggers recompute preemption (re-queue,
+  keep generated tokens, re-prefill later) rather than truncation.
 - **SPMD via the mesh.** Weights/KV are sharded with ``NamedSharding``
   (`parallel/sharding.py`); GSPMD inserts the ICI collectives. The same
   engine runs single-chip or tensor-parallel across a slice unchanged.
