@@ -248,7 +248,7 @@ def pick_decode_kernel() -> str:
         )
         sys.stderr.write(proc.stderr[-600:])
         choice = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
-        if proc.returncode == 0 and choice in ("v1", "v2"):
+        if proc.returncode == 0 and choice in ("v1", "v2", "v3"):
             return choice
         print(f"bench: kernel A/B rc={proc.returncode}; using v1", file=sys.stderr)
     except subprocess.TimeoutExpired:
@@ -266,13 +266,17 @@ def _kernel_ab_probe(config, *, max_seqs: int, page_size: int) -> str:
     distinct pages defeats caching while leaving the engine's HBM alone.
     """
     try:
+        import functools
+
         import jax
         import jax.numpy as jnp
         import numpy as np
 
+        from llmq_tpu.ops.attention import write_kv_pages
         from llmq_tpu.ops.pallas_attention import (
             paged_decode_attention_pallas,
             paged_decode_attention_pallas_v2,
+            paged_decode_attention_pallas_v3,
         )
 
         H, NKV, D = config.num_heads, config.num_kv_heads, config.head_dim_
@@ -288,45 +292,82 @@ def _kernel_ab_probe(config, *, max_seqs: int, page_size: int) -> str:
         q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
         kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
         vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
+        kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
+        vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
         rng = np.random.default_rng(0)
-        bt = jnp.asarray(
-            rng.integers(1, P, size=(S, PPS)).astype(np.int32)
-        )
+        # Pages WITHOUT replacement: all three candidates write the new
+        # row, and a cross-sequence page collision would make the scatter
+        # (one winner) and the fused kernel (own row each) legitimately
+        # disagree, spuriously tripping the numerics guard.
+        if P - 1 < S * PPS:
+            return "v1"  # pool too small for distinct pages per seq
+        perm = rng.permutation(np.arange(1, P))[: S * PPS]
+        bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
         cl = jnp.full((S,), ctx, jnp.int32)
+        positions = (cl - 1)[:, None]
         w = jnp.asarray([1 << 30], jnp.int32)
         scale = D**-0.5
 
-        def timeit(kern, n=2):
-            outs = [
-                kern(q, kp, vp, bt, cl, w, jnp.int32(li), scale=scale)
-                for li in range(L)
-            ]
-            jax.block_until_ready(outs)
+        # v1/v2 pay the separate XLA KV scatter the engine runs before
+        # them; v3 writes in-kernel. Time each candidate as the engine
+        # would actually run it, so the ranking is apples-to-apples.
+        # Donation matters: without it XLA must preserve the caller's
+        # pool, which forces a full-pool copy around v3's in-place alias
+        # and penalizes it artificially.
+        @functools.partial(
+            jax.jit, static_argnames=("which",), donate_argnums=(0, 1)
+        )
+        def step(kp, vp, li, *, which):
+            if which == "v3":
+                out, kp, vp = paged_decode_attention_pallas_v3(
+                    q, kp, vp, kn, vn, bt, cl, w, li, scale=scale
+                )
+                return out, kp, vp
+            kp, vp = write_kv_pages(
+                kp, vp, kn[:, None], vn[:, None], bt, positions, layer=li
+            )
+            kern = (
+                paged_decode_attention_pallas_v2
+                if which == "v2"
+                else paged_decode_attention_pallas
+            )
+            return kern(q, kp, vp, bt, cl, w, li, scale=scale), kp, vp
+
+        def timeit(which, n=2):
+            nonlocal kp, vp
+            for li in range(L):
+                out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
+            jax.block_until_ready(out)
             t0 = time.monotonic()
             for _ in range(n):
-                outs = [
-                    kern(q, kp, vp, bt, cl, w, jnp.int32(li), scale=scale)
-                    for li in range(L)
-                ]
-                jax.block_until_ready(outs)
+                for li in range(L):
+                    out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
+                jax.block_until_ready(out)
             return (time.monotonic() - t0) / (n * L)
 
-        v1 = timeit(paged_decode_attention_pallas)
-        v2 = timeit(paged_decode_attention_pallas_v2)
-        # numerics guard: never pick a kernel that disagrees
-        a = paged_decode_attention_pallas(
-            q, kp, vp, bt, cl, w, jnp.int32(0), scale=scale
-        )
-        b = paged_decode_attention_pallas_v2(
-            q, kp, vp, bt, cl, w, jnp.int32(0), scale=scale
-        )
-        diff = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
-        for arr in (q, kp, vp, a, b):
+        times = {which: timeit(which) for which in ("v1", "v2", "v3")}
+        # Numerics guard: per-candidate agreement with v1. Each guard call
+        # rewrites the same (kn, vn) row at the same position, so the pool
+        # state is identical for all three.
+        outs = {}
+        for which in ("v1", "v2", "v3"):
+            o, kp, vp = step(kp, vp, jnp.int32(0), which=which)
+            outs[which] = o.astype(jnp.float32)
+        diffs = {
+            a: float(jnp.max(jnp.abs(outs[a] - outs["v1"])))
+            for a in ("v2", "v3")
+        }
+        choice = "v1"
+        for cand in ("v2", "v3"):
+            if times[cand] < 0.92 * times[choice] and diffs[cand] < 0.05:
+                choice = cand
+        for arr in (q, kp, vp, kn, vn, *outs.values()):
             arr.delete()
-        choice = "v2" if (v2 < 0.92 * v1 and diff < 0.05) else "v1"
+        shown = " ".join(f"{k}={v*1e3:.3f}ms" for k, v in times.items())
+        dshown = " ".join(f"{k}|diff|={v:.2e}" for k, v in diffs.items())
         print(
-            f"bench: decode-kernel A/B v1={v1*1e3:.3f}ms v2={v2*1e3:.3f}ms "
-            f"per layer (max|diff|={diff:.2e}) -> {choice}",
+            f"bench: decode-kernel A/B {shown} per layer ({dshown}) "
+            f"-> {choice}",
             file=sys.stderr,
         )
         return choice

@@ -419,6 +419,14 @@ class Transformer:
         windows = self._window_for_layers()
         one_plus = cfg.model_type.startswith("gemma")
         ctx_incl = jnp.where(active, context_lens + 1, 0)
+        # Trace-time kernel plan: with the v3 (fused-write) kernel the XLA
+        # KV scatter is skipped — the kernel patches + persists the new
+        # row itself. ctx_incl already zeroes inactive slots, so the
+        # kernel's ctx>0 guard skips their writes (the scatter's -1
+        # position routing handled this for the XLA path).
+        _, fused_write = attn_dispatch.decode_kernel_plan(
+            cfg.num_heads, cfg.num_kv_heads, self.mesh, self.attn_backend
+        )
 
         def layer_fn(carry, xs):
             h, kps, vps = carry
@@ -427,22 +435,33 @@ class Transformer:
             q, k, v = self._qkv(lp, x[:, None, :], positions[:, None], inv_freq)
             # q/k/v: [S, 1, heads, d]. The KV stack is written and read
             # in place via the layer index — see prefill's layer_fn.
-            kps, vps = attn_ops.write_kv_pages(
-                kps, vps, k, v, block_tables, positions[:, None], layer=li
-            )
-            attn_out = attn_dispatch.decode_attention(
-                q[:, 0],
-                kps,
-                vps,
-                block_tables,
-                ctx_incl,
-                scale=cfg.attn_scale,
-                sliding_window=window,
-                softcap=cfg.attn_softcap,
-                mesh=self.mesh,
-                backend=self.attn_backend,
-                layer=li,
-            )
+            if fused_write:
+                attn_out, kps, vps = attn_dispatch.decode_attention_fused_write(
+                    q[:, 0], kps, vps, k[:, 0], v[:, 0],
+                    block_tables, ctx_incl,
+                    scale=cfg.attn_scale,
+                    sliding_window=window,
+                    softcap=cfg.attn_softcap,
+                    mesh=self.mesh,
+                    layer=li,
+                )
+            else:
+                kps, vps = attn_ops.write_kv_pages(
+                    kps, vps, k, v, block_tables, positions[:, None], layer=li
+                )
+                attn_out = attn_dispatch.decode_attention(
+                    q[:, 0],
+                    kps,
+                    vps,
+                    block_tables,
+                    ctx_incl,
+                    scale=cfg.attn_scale,
+                    sliding_window=window,
+                    softcap=cfg.attn_softcap,
+                    mesh=self.mesh,
+                    backend=self.attn_backend,
+                    layer=li,
+                )
             h = self._finish_layer(lp, h, attn_out)
             return (h, kps, vps), None
 

@@ -185,6 +185,85 @@ def chunked_prefill_attention(
     )
 
 
+def decode_kernel_plan(
+    n_heads: int, n_kv: int, mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+) -> tuple:
+    """(kernel_name, fused_write) the current env resolves to for these
+    shapes. ``fused_write`` (the v3 kernel) means the decode kernel writes
+    the step's new K/V row itself — the model must then SKIP its XLA
+    scatter and call :func:`decode_attention_fused_write` instead."""
+    backend = resolve_backend() if backend == "auto" else backend
+    # Empty string = unset (the `VAR= cmd` shell idiom must mean default).
+    kern = (os.environ.get("LLMQ_DECODE_KERNEL") or "v1").lower()
+    if kern not in ("v1", "v2", "v3"):
+        raise ValueError(f"LLMQ_DECODE_KERNEL={kern!r} (want v1|v2|v3)")
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    if backend != "pallas" or not tp_ok:
+        return "xla", False
+    return kern, kern == "v3"
+
+
+def decode_attention_fused_write(
+    q: jnp.ndarray,  # [S, n_heads, d]
+    k_pages: jnp.ndarray,  # [L, P, page, n_kv, d] (or unstacked)
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [S, n_kv, d] — this step's fresh K/V rows
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,  # [S] INCLUDING the new token
+    *,
+    scale: float,
+    sliding_window=None,
+    softcap: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    layer: Optional[jnp.ndarray] = None,
+) -> tuple:
+    """v3 decode path: attention + in-kernel KV write in one pallas call
+    (see paged_decode_attention_pallas_v3). Only valid when
+    :func:`decode_kernel_plan` returned ``fused_write=True`` — the caller
+    must not have scattered the new rows. Returns (out, k_pages, v_pages).
+    """
+    stacked = k_pages.ndim == 5
+    window = _window_scalar(sliding_window)
+    li = (
+        jnp.asarray(layer, jnp.int32).reshape(1)
+        if layer is not None
+        else jnp.zeros((1,), jnp.int32)
+    )
+
+    def call(q, kp, vp, kn, vn, bt, cl, window, li):
+        return pk.paged_decode_attention_pallas_v3(
+            q, kp, vp, kn, vn, bt, cl, window, li,
+            scale=scale, softcap=softcap, interpret=_interpret(),
+        )
+
+    tp = _tp_degree(mesh)
+    if tp > 1:
+        assert mesh is not None
+        kv_spec = (
+            P(None, None, None, TP_AXIS, None)
+            if stacked
+            else P(None, None, TP_AXIS, None)
+        )
+        row_spec = P(None, TP_AXIS, None)
+        call = jax.shard_map(
+            call,
+            mesh=mesh,
+            in_specs=(
+                P(None, TP_AXIS, None),
+                kv_spec, kv_spec, row_spec, row_spec,
+                P(), P(), P(), P(),
+            ),
+            out_specs=(P(None, TP_AXIS, None), kv_spec, kv_spec),
+        )
+    return call(
+        q, k_pages, v_pages, k_new, v_new, block_tables, context_lens,
+        window, li,
+    )
+
+
 def decode_attention(
     q: jnp.ndarray,  # [S, n_heads, d]
     k_pages: jnp.ndarray,  # [Pg, page_size, n_kv, d] or [L, Pg, ...]
@@ -219,11 +298,13 @@ def decode_attention(
 
     # Empty string = unset (the `VAR= cmd` shell idiom must mean default).
     kern_name = (os.environ.get("LLMQ_DECODE_KERNEL") or "v1").lower()
-    if kern_name not in ("v1", "v2"):
-        raise ValueError(f"LLMQ_DECODE_KERNEL={kern_name!r} (want v1|v2)")
+    if kern_name not in ("v1", "v2", "v3"):
+        raise ValueError(f"LLMQ_DECODE_KERNEL={kern_name!r} (want v1|v2|v3)")
+    # v3 (fused KV write) only exists on the decode_attention_fused_write
+    # path; a caller who scattered KV separately gets v3's base, v2.
     kern = (
         pk.paged_decode_attention_pallas_v2
-        if kern_name == "v2"
+        if kern_name in ("v2", "v3")
         else pk.paged_decode_attention_pallas
     )
 

@@ -409,3 +409,111 @@ def test_chunked_prefill_dispatch_pallas_matches_xla():
     np.testing.assert_allclose(
         outs["pallas"][1, :3], outs["xla"][1, :3], rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize(
+    "n_heads,n_kv,window,softcap,chunk",
+    [
+        (4, 4, None, None, 2),
+        (8, 2, 13, None, 2),  # sliding window
+        (6, 3, 7, 20.0, 3),  # window+softcap, padded pages_per_seq
+    ],
+)
+def test_paged_decode_v3_fused_write(n_heads, n_kv, window, softcap, chunk):
+    """v3 = v2 + in-kernel KV write: attention output AND the updated
+    page pool must equal the scatter-then-decode reference (including an
+    inactive ctx=0 slot, which must not write anywhere)."""
+    S, d, page_size, pages_per_seq, L = 5, 16, 8, 5, 3
+    ctx = [1, 7, 8, 23, 0]  # incl. page-boundary crossing and inactive
+    key = jax.random.key(20)
+    q = _rand(key, (S, n_heads, d))
+    P = 1 + S * pages_per_seq
+    k_pages = _rand(jax.random.key(21), (L, P, page_size, n_kv, d))
+    v_pages = _rand(jax.random.key(22), (L, P, page_size, n_kv, d))
+    k_new = _rand(jax.random.key(23), (S, n_kv, d))
+    v_new = _rand(jax.random.key(24), (S, n_kv, d))
+    bt = jnp.arange(1, 1 + S * pages_per_seq, dtype=jnp.int32).reshape(S, -1)
+    cl = jnp.asarray(ctx, jnp.int32)
+    li = jnp.asarray(1, jnp.int32)
+    scale = d**-0.5
+    win = jnp.asarray([window if window else _WINDOW_DISABLED], jnp.int32)
+
+    positions = jnp.where(cl > 0, cl - 1, -1)[:, None]
+    kp_ref, vp_ref = ref_ops.write_kv_pages(
+        k_pages, v_pages, k_new[:, None], v_new[:, None], bt, positions,
+        layer=li,
+    )
+    ref = ref_ops.paged_decode_attention(
+        q, kp_ref, vp_ref, bt, cl, scale=scale, sliding_window=window,
+        softcap=softcap, layer=li,
+    )
+    out, kp3, vp3 = pk.paged_decode_attention_pallas_v3(
+        q, k_pages, v_pages, k_new, v_new, bt, cl, win, li,
+        scale=scale, softcap=softcap, pages_per_chunk=chunk, interpret=True,
+    )
+    active = np.asarray([r for r in range(S) if ctx[r] > 0])
+    np.testing.assert_allclose(
+        np.asarray(out)[active], np.asarray(ref)[active], rtol=2e-5, atol=2e-5
+    )
+    assert np.isfinite(np.asarray(out)).all()
+    # pool: every non-scratch page identical to the scatter reference
+    # (the XLA reference also writes the inactive slot's row to scratch
+    # page 0; v3 skips it entirely — both are fine, page 0 is never read)
+    np.testing.assert_allclose(kp3[:, 1:], kp_ref[:, 1:], rtol=0, atol=0)
+    np.testing.assert_allclose(vp3[:, 1:], vp_ref[:, 1:], rtol=0, atol=0)
+
+
+def test_decode_v3_through_model():
+    """Full tiny-model decode with LLMQ_DECODE_KERNEL=v3 (fused write,
+    pallas backend): logits AND page pool must match the xla backend."""
+    import os
+
+    from llmq_tpu.models.config import ModelConfig
+    from llmq_tpu.models.transformer import (
+        Transformer,
+        init_params,
+        make_kv_pages,
+    )
+
+    config = ModelConfig.tiny(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64,
+    )
+    params = init_params(config, jax.random.key(0))
+    S, page_size, num_pages = 3, 8, 16
+    tokens = jnp.asarray([1, 2, 3], jnp.int32)
+    ctx = jnp.asarray([3, 5, 0], jnp.int32)
+    bt = jnp.arange(1, 13, dtype=jnp.int32).reshape(S, -1)
+    active = jnp.asarray([True, True, False])
+
+    outs = {}
+    old = os.environ.get("LLMQ_DECODE_KERNEL")
+    try:
+        for backend, kern in (("xla", None), ("pallas", "v3")):
+            if kern:
+                os.environ["LLMQ_DECODE_KERNEL"] = kern
+            else:
+                os.environ.pop("LLMQ_DECODE_KERNEL", None)
+            k_pages, v_pages = make_kv_pages(
+                config, num_pages, page_size, jnp.float32
+            )
+            model = Transformer(config, attn_backend=backend)
+            logits, kp, vp = model.decode(
+                params, tokens, ctx, k_pages, v_pages, bt, active
+            )
+            outs[backend] = (np.asarray(logits), np.asarray(kp), np.asarray(vp))
+    finally:
+        if old is None:
+            os.environ.pop("LLMQ_DECODE_KERNEL", None)
+        else:
+            os.environ["LLMQ_DECODE_KERNEL"] = old
+    np.testing.assert_allclose(
+        outs["pallas"][0][:2], outs["xla"][0][:2], rtol=1e-4, atol=1e-4
+    )
+    # pool parity on non-scratch pages (scratch page 0 differs by design)
+    np.testing.assert_allclose(
+        outs["pallas"][1][:, 1:], outs["xla"][1][:, 1:], rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        outs["pallas"][2][:, 1:], outs["xla"][2][:, 1:], rtol=1e-6, atol=1e-6
+    )
