@@ -179,11 +179,13 @@ def init_devices():
         return None, [], f"no backend at all: {exc}"
 
 
-def pick_preset(limit_bytes, platform: str) -> str:
+def pick_preset(limit_bytes, platform: str, *, int8: bool = False) -> str:
     if platform == "cpu":
         return "tiny"
     gb = (limit_bytes or 16 * 2**30) / 2**30
     # bf16 params ~2 bytes each; leave room for KV cache + activations.
+    # int8 weight-only quantization halves the parameter bytes — which is
+    # what fits tower-plus-9b (north-star architecture) on a 16 GB chip.
     for preset, param_gb in (
         ("tower-plus-9b", 20.5),
         ("qwen2.5-7b", 15.2),
@@ -191,6 +193,8 @@ def pick_preset(limit_bytes, platform: str) -> str:
         ("qwen2.5-1.5b", 3.6),
         ("qwen2.5-0.5b", 1.4),
     ):
+        if int8:
+            param_gb = param_gb / 2 + 0.3  # int8 bodies + scales/norms
         if gb * 0.92 > param_gb * 1.35:
             return preset
     return "qwen2.5-0.5b"
@@ -258,124 +262,6 @@ def pick_decode_kernel() -> str:
     return "v1"
 
 
-def _kernel_ab_probe(config, *, max_seqs: int, page_size: int) -> str:
-    """Child-process body of the A/B (see pick_decode_kernel).
-
-    The pool must NOT fit in VMEM (~128 MB) or every kernel looks
-    infinitely fast (round-3 finding); ~300 MB per side with per-layer
-    distinct pages defeats caching while leaving the engine's HBM alone.
-    """
-    try:
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-
-        from llmq_tpu.ops.attention import write_kv_pages
-        from llmq_tpu.ops.pallas_attention import (
-            paged_decode_attention_pallas,
-            paged_decode_attention_pallas_v2,
-            paged_decode_attention_pallas_v3,
-        )
-
-        H, NKV, D = config.num_heads, config.num_kv_heads, config.head_dim_
-        L = config.num_layers
-        S = max_seqs
-        PAGE = page_size
-        PPS = 4
-        per_page = PAGE * NKV * D * 2  # bf16
-        P = max(PPS * 4, min(300 * 2**20 // max(1, L * per_page), 961))
-        if P < PPS + 1:
-            return "v1"
-        ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
-        q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
-        kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
-        vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
-        kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
-        vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
-        rng = np.random.default_rng(0)
-        # Pages WITHOUT replacement: all three candidates write the new
-        # row, and a cross-sequence page collision would make the scatter
-        # (one winner) and the fused kernel (own row each) legitimately
-        # disagree, spuriously tripping the numerics guard.
-        if P - 1 < S * PPS:
-            return "v1"  # pool too small for distinct pages per seq
-        perm = rng.permutation(np.arange(1, P))[: S * PPS]
-        bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
-        cl = jnp.full((S,), ctx, jnp.int32)
-        positions = (cl - 1)[:, None]
-        w = jnp.asarray([1 << 30], jnp.int32)
-        scale = D**-0.5
-
-        # v1/v2 pay the separate XLA KV scatter the engine runs before
-        # them; v3 writes in-kernel. Time each candidate as the engine
-        # would actually run it, so the ranking is apples-to-apples.
-        # Donation matters: without it XLA must preserve the caller's
-        # pool, which forces a full-pool copy around v3's in-place alias
-        # and penalizes it artificially.
-        @functools.partial(
-            jax.jit, static_argnames=("which",), donate_argnums=(0, 1)
-        )
-        def step(kp, vp, li, *, which):
-            if which == "v3":
-                out, kp, vp = paged_decode_attention_pallas_v3(
-                    q, kp, vp, kn, vn, bt, cl, w, li, scale=scale
-                )
-                return out, kp, vp
-            kp, vp = write_kv_pages(
-                kp, vp, kn[:, None], vn[:, None], bt, positions, layer=li
-            )
-            kern = (
-                paged_decode_attention_pallas_v2
-                if which == "v2"
-                else paged_decode_attention_pallas
-            )
-            return kern(q, kp, vp, bt, cl, w, li, scale=scale), kp, vp
-
-        def timeit(which, n=2):
-            nonlocal kp, vp
-            for li in range(L):
-                out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
-            jax.block_until_ready(out)
-            t0 = time.monotonic()
-            for _ in range(n):
-                for li in range(L):
-                    out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
-                jax.block_until_ready(out)
-            return (time.monotonic() - t0) / (n * L)
-
-        times = {which: timeit(which) for which in ("v1", "v2", "v3")}
-        # Numerics guard: per-candidate agreement with v1. Each guard call
-        # rewrites the same (kn, vn) row at the same position, so the pool
-        # state is identical for all three.
-        outs = {}
-        for which in ("v1", "v2", "v3"):
-            o, kp, vp = step(kp, vp, jnp.int32(0), which=which)
-            outs[which] = o.astype(jnp.float32)
-        diffs = {
-            a: float(jnp.max(jnp.abs(outs[a] - outs["v1"])))
-            for a in ("v2", "v3")
-        }
-        choice = "v1"
-        for cand in ("v2", "v3"):
-            if times[cand] < 0.92 * times[choice] and diffs[cand] < 0.05:
-                choice = cand
-        for arr in (q, kp, vp, kn, vn, *outs.values()):
-            arr.delete()
-        shown = " ".join(f"{k}={v*1e3:.3f}ms" for k, v in times.items())
-        dshown = " ".join(f"{k}|diff|={v:.2e}" for k, v in diffs.items())
-        print(
-            f"bench: decode-kernel A/B {shown} per layer ({dshown}) "
-            f"-> {choice}",
-            file=sys.stderr,
-        )
-        return choice
-    except Exception as exc:  # noqa: BLE001 — never endanger the headline run
-        print(f"bench: kernel A/B failed ({exc!r}); using v1", file=sys.stderr)
-        return "v1"
-
-
 def _kernel_ab_probe_main() -> None:
     """Entry for `bench.py --kernel-ab-probe` (child process). Derives
     the preset the same way main() will (same env knobs, same HBM), so
@@ -389,6 +275,7 @@ def _kernel_ab_probe_main() -> None:
         force_cpu_platform()
     import jax
 
+    from llmq_tpu.engine.kernel_autotune import run_ab
     from llmq_tpu.models.presets import get_preset
 
     devices = jax.devices()
@@ -400,8 +287,11 @@ def _kernel_ab_probe_main() -> None:
         limit, devices[0].platform
     )
     config = get_preset(preset)
-    choice = _kernel_ab_probe(
-        config,
+    choice = run_ab(
+        num_heads=config.num_heads,
+        num_kv_heads=config.num_kv_heads,
+        head_dim=config.head_dim_,
+        num_layers=config.num_layers,
         max_seqs=int(os.environ.get("LLMQ_BENCH_SEQS", 192)),
         page_size=128,
     )
@@ -444,7 +334,12 @@ def main() -> None:
         limit = (devices[0].memory_stats() or {}).get("bytes_limit")
     except Exception:  # noqa: BLE001
         limit = None
-    preset = os.environ.get("LLMQ_BENCH_PRESET") or pick_preset(limit, platform)
+    # LLMQ_BENCH_DTYPE=int8 → weight-only quantization (bf16 compute):
+    # halves weight HBM bytes/bandwidth and admits the 9B preset on 16 GB.
+    int8 = os.environ.get("LLMQ_BENCH_DTYPE", "").lower() == "int8"
+    preset = os.environ.get("LLMQ_BENCH_PRESET") or pick_preset(
+        limit, platform, int8=int8
+    )
     on_cpu = platform == "cpu"
 
     n_requests = int(os.environ.get("LLMQ_BENCH_REQUESTS", 8 if on_cpu else 576))
@@ -465,7 +360,8 @@ def main() -> None:
     page_size = 8 if on_cpu else 128
     if not on_cpu and ab_choice:
         os.environ["LLMQ_DECODE_KERNEL"] = ab_choice
-    params = init_params(config, jax.random.key(0), dtype=dtype)
+    # quantize-at-init: the bf16 tree alone would not fit HBM at 9B.
+    params = init_params(config, jax.random.key(0), dtype=dtype, quantize=int8)
     mesh = make_mesh(devices=devices)  # all local devices, tp
     core = EngineCore(
         config,

@@ -252,8 +252,15 @@ class BrokerManager:
         depth = (await self.get_queue_stats(dlq)).message_count
         seen: set = set()
         moved = 0
+        # A broker whose stats carry no depth AND whose messages carry no
+        # message_id would leave an unlimited drain with no stop condition
+        # at all (a concurrently re-dead-lettering worker keeps feeding the
+        # loop its own requeued jobs); hard-cap that case.
+        cap = 10_000 if depth is None and limit is None else None
         while limit is None or moved < limit:
             if depth is not None and moved >= depth:
+                break
+            if cap is not None and moved >= cap:
                 break
             msg = await self.broker.get(dlq)
             if msg is None:

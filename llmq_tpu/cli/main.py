@@ -97,6 +97,49 @@ def receive(queue_or_pipeline, is_pipeline, timeout, limit):
         asyncio.run(run_receive(queue_or_pipeline, timeout=timeout, limit=limit))
 
 
+# Deprecated aliases (reference cli/main.py:152-254,375-408 parity):
+# `pipeline` / `receive-pipeline` predate the unified -p flag.
+
+
+@cli.command("pipeline", hidden=True)
+@click.argument("pipeline_path")
+@click.argument("source")
+@click.option("--map", "map_args", multiple=True)
+@click.option("--stream", is_flag=True)
+@click.option("--split", default="train", show_default=True)
+@click.option("--subset", default=None)
+@click.option("--limit", type=int, default=None)
+def pipeline_deprecated(pipeline_path, source, map_args, stream, split,
+                        subset, limit):
+    """[deprecated] Use `submit -p PIPELINE.yaml SOURCE`."""
+    from llmq_tpu.cli.submit import run_pipeline_submit
+
+    click.echo(
+        "Warning: `pipeline` is deprecated; use `submit -p`.", err=True
+    )
+    asyncio.run(
+        run_pipeline_submit(
+            pipeline_path, source, _parse_maps(map_args),
+            stream=stream, split=split, subset=subset, limit=limit,
+        )
+    )
+
+
+@cli.command("receive-pipeline", hidden=True)
+@click.argument("pipeline_path")
+@click.option("--timeout", type=float, default=None)
+@click.option("--limit", type=int, default=None)
+def receive_pipeline_deprecated(pipeline_path, timeout, limit):
+    """[deprecated] Use `receive -p PIPELINE.yaml`."""
+    from llmq_tpu.cli.receive import run_pipeline_receive
+
+    click.echo(
+        "Warning: `receive-pipeline` is deprecated; use `receive -p`.",
+        err=True,
+    )
+    asyncio.run(run_pipeline_receive(pipeline_path, timeout=timeout, limit=limit))
+
+
 # ---------------------------------------------------------------------------
 # monitoring / ops
 # ---------------------------------------------------------------------------
@@ -192,7 +235,10 @@ def worker() -> None:
               help="Override prefetch/in-flight job count")
 @click.option("--max-num-seqs", type=int, default=None, help="Engine batch slots")
 @click.option("--max-model-len", type=int, default=None, help="Context window cap")
-@click.option("--dtype", default="bfloat16", show_default=True)
+@click.option("--dtype", default="bfloat16", show_default=True,
+              type=click.Choice(["bfloat16", "float32", "int8"]),
+              help="int8 = weight-only quantization (bf16 compute); "
+                   "halves HBM footprint and weight bandwidth")
 @click.option("--prefill-chunk", type=int, default=None,
               help="Chunked prefill: positions per chunk (any prompt "
                    "length through one executable; decode interleaves "
@@ -239,11 +285,18 @@ def worker_dummy(queue, concurrency, delay):
               default="dedup", show_default=True)
 @click.option("--threshold", type=float, default=0.9, show_default=True,
               help="Similarity threshold for duplicate detection")
-def worker_dedup(queue, batch_size, mode, threshold):
+@click.option("--embedding", type=click.Choice(["lexical", "model"]),
+              default="lexical", show_default=True,
+              help="Similarity backend: lexical n-grams, or a model's "
+                   "embedding table (catches paraphrases; needs --model)")
+@click.option("--model", default=None,
+              help="Local HF checkpoint dir for --embedding model")
+def worker_dedup(queue, batch_size, mode, threshold, embedding, model):
     """Run a semantic dedup/filter worker (reference: semhash worker)."""
     from llmq_tpu.cli.worker import run_dedup_worker
 
-    run_dedup_worker(queue, batch_size=batch_size, mode=mode, threshold=threshold)
+    run_dedup_worker(queue, batch_size=batch_size, mode=mode,
+                     threshold=threshold, embedding=embedding, model=model)
 
 
 @worker.command("pipeline")

@@ -71,6 +71,89 @@ def iter_source(
     return _iter_hf_dataset(source, split=split, subset=subset)
 
 
+class _SubmitProgress:
+    """Live submit/complete progress with rates (reference Rich progress,
+    submit.py:350-364,437-449 — the operator UX for million-job drains).
+
+    Renders a Rich display when stderr is a terminal; under batch/SLURM
+    logs (non-TTY) it degrades to the plain carriage-return line so logs
+    stay grep-able. ``total`` may be None (HF streaming source of unknown
+    size) — the bar is indeterminate but counts and rates still tick.
+    """
+
+    def __init__(self, *, stream: bool, total: Optional[int] = None) -> None:
+        self.stream = stream
+        self.total = total
+        self._rich = None
+        self._submit_task = None
+        self._complete_task = None
+        self._start = time.monotonic()
+        if sys.stderr.isatty():
+            from rich.console import Console
+            from rich.progress import (
+                BarColumn,
+                MofNCompleteColumn,
+                Progress,
+                TextColumn,
+                TimeRemainingColumn,
+            )
+
+            self._rich = Progress(
+                TextColumn("[progress.description]{task.description}"),
+                BarColumn(),
+                MofNCompleteColumn(),
+                TextColumn("[cyan]{task.fields[rate]:>7.1f}/s"),
+                TimeRemainingColumn(),
+                console=Console(file=sys.stderr),
+            )
+            self._submit_task = self._rich.add_task(
+                "Submitting", total=total, rate=0.0
+            )
+            if stream:
+                self._complete_task = self._rich.add_task(
+                    "Completing", total=total, rate=0.0
+                )
+
+    def __enter__(self) -> "_SubmitProgress":
+        if self._rich is not None:
+            self._rich.start()
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._rich is not None:
+            self._rich.stop()
+        elif not self.stream:
+            print(file=sys.stderr)  # finish the \r line
+
+    def _rate(self, count: int) -> float:
+        elapsed = time.monotonic() - self._start
+        return count / elapsed if elapsed > 0 else 0.0
+
+    def submitted(self, count: int) -> None:
+        if self._rich is not None:
+            self._rich.update(
+                self._submit_task, completed=count, rate=self._rate(count)
+            )
+        else:
+            print(
+                f"\rsubmitted {count} jobs", end="", file=sys.stderr, flush=True
+            )
+
+    def submit_done(self, count: int) -> None:
+        """Submission finished: the completion target is now exact."""
+        if self._rich is not None:
+            self._rich.update(self._submit_task, total=count, completed=count)
+            if self._complete_task is not None:
+                self._rich.update(self._complete_task, total=count)
+
+    def completed(self, count: int) -> None:
+        if self._rich is not None and self._complete_task is not None:
+            self._rich.update(
+                self._complete_task, completed=count, rate=self._rate(count)
+            )
+
+
 class JobSubmitter:
     """Chunked concurrent submission + optional result streaming
     (reference JobSubmitter, submit.py:28-606)."""
@@ -102,6 +185,7 @@ class JobSubmitter:
         self.submitted = 0
         self.received = 0
         self._last_result_at = 0.0
+        self._progress: Optional[_SubmitProgress] = None
 
     async def run(self) -> int:
         await self.broker.connect()
@@ -112,13 +196,19 @@ class JobSubmitter:
                 consumer_tag = await self.broker.consume_results(
                     self.queue, self._on_result
                 )
-            await self._submit_all()
-            if self.stream:
-                await self._wait_for_results()
-                if consumer_tag:
-                    await self.broker.cancel(consumer_tag)
+            with _SubmitProgress(
+                stream=self.stream, total=self.limit
+            ) as progress:
+                self._progress = progress
+                await self._submit_all()
+                progress.submit_done(self.submitted)
+                if self.stream:
+                    await self._wait_for_results()
+                    if consumer_tag:
+                        await self.broker.cancel(consumer_tag)
             return self.submitted
         finally:
+            self._progress = None
             if self._owns_broker:
                 await self.broker.disconnect()
 
@@ -163,9 +253,8 @@ class JobSubmitter:
             *(self.broker.publish_job(self.queue, job) for job in jobs)
         )
         self.submitted += len(jobs)
-        print(
-            f"\rsubmitted {self.submitted} jobs", end="", file=sys.stderr, flush=True
-        )
+        if self._progress is not None:
+            self._progress.submitted(self.submitted)
         await asyncio.sleep(0.01)  # let the loop breathe between chunks
 
     # --- streaming --------------------------------------------------------
@@ -179,6 +268,8 @@ class JobSubmitter:
         sys.stdout.flush()
         self.received += 1
         self._last_result_at = time.monotonic()
+        if self._progress is not None:
+            self._progress.completed(self.received)
         await message.ack()
 
     async def _wait_for_results(self) -> None:
@@ -258,19 +349,25 @@ class PipelineSubmitter:
                 broker=self.broker,
             )
             # Reuse connection; submitter must not tear down pipeline infra.
-            submitted = 0
-            await submitter._submit_all()
-            submitted = submitter.submitted
-            if self.stream:
-                last = time.monotonic()
-                while receiver.count < submitted:
-                    if receiver.count > 0:
-                        last = max(last, receiver.last_at)
-                    if time.monotonic() - last > 30.0:
-                        break
-                    await asyncio.sleep(0.1)
-                if consumer_tag:
-                    await self.broker.cancel(consumer_tag)
+            with _SubmitProgress(
+                stream=self.stream, total=self.limit
+            ) as progress:
+                submitter._progress = progress
+                await submitter._submit_all()
+                submitted = submitter.submitted
+                progress.submit_done(submitted)
+                if self.stream:
+                    last = time.monotonic()
+                    while receiver.count < submitted:
+                        progress.completed(receiver.count)
+                        if receiver.count > 0:
+                            last = max(last, receiver.last_at)
+                        if time.monotonic() - last > 30.0:
+                            break
+                        await asyncio.sleep(0.1)
+                    progress.completed(receiver.count)
+                    if consumer_tag:
+                        await self.broker.cancel(consumer_tag)
             return submitted
         finally:
             await self.broker.disconnect()

@@ -66,18 +66,25 @@ def run_dedup_worker(
     batch_size: int = 256,
     mode: str = "dedup",
     threshold: float = 0.9,
+    embedding: str = "lexical",
+    model: Optional[str] = None,
     concurrency: Optional[int] = None,
 ) -> None:
     setup_logging(structured=True)
     from llmq_tpu.workers.dedup import DedupWorker
 
-    click.echo(f"Starting dedup worker ({mode}) on queue '{queue}'", err=True)
+    click.echo(
+        f"Starting dedup worker ({mode}, {embedding}) on queue '{queue}'",
+        err=True,
+    )
     _run(
         DedupWorker(
             queue,
             batch_size=batch_size,
             mode=mode,
             threshold=threshold,
+            embedding=embedding,
+            model=model,
             concurrency=concurrency,
         )
     )
@@ -132,6 +139,8 @@ def run_pipeline_worker(
             batch_size=int(stage_cfg.config.get("batch_size", 256)),
             mode=stage_cfg.config.get("mode", "dedup"),
             threshold=float(stage_cfg.config.get("threshold", 0.9)),
+            embedding=stage_cfg.config.get("embedding", "lexical"),
+            model=stage_cfg.config.get("model"),
             **common,
         )
     else:

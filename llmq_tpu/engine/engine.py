@@ -66,7 +66,12 @@ from jax.experimental.layout import Format, Layout
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.engine import sampling as sampling_mod
-from llmq_tpu.engine.sampling import SamplingParams, make_base_key, sample_tokens
+from llmq_tpu.engine.sampling import (
+    SamplingParams,
+    make_base_key,
+    request_tag,
+    sample_tokens,
+)
 from llmq_tpu.engine.scheduler import (
     OutOfPages,
     Scheduler,
@@ -770,7 +775,7 @@ class EngineCore:
             self._h_temp[i] = p.temperature
             self._h_topk[i] = p.top_k
             self._h_topp[i] = p.top_p
-            self._h_keys[i] = np.asarray(make_base_key(p.seed, i))
+            self._h_keys[i] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))
             self._h_steps[i] = len(seq.output_ids)
             self._h_limits[i] = p.max_tokens
             self._h_mins[i] = p.min_tokens
@@ -958,7 +963,7 @@ class EngineCore:
         for r, seq in enumerate(rows):
             p = seq.params
             slots[r] = seq.slot
-            keys[r] = np.asarray(make_base_key(p.seed, seq.slot))
+            keys[r] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))
             steps[r] = len(seq.output_ids)
             temps[r] = p.temperature
             topks[r] = p.top_k

@@ -1,0 +1,248 @@
+"""On-hardware A/B selection of the paged-decode attention kernel.
+
+Three candidates exist (``ops/pallas_attention.py``): v1 (BlockSpec page
+pipeline), v2 (chunked manual-DMA, live pages only) and v3 (v2 plus the
+step's KV write fused into the kernel). Which one wins depends on the
+chip generation, page size and pool residency — so the choice is made by
+*measuring* on the deployment hardware, not hardcoded. Both ``bench.py``
+and the TPU worker (``workers/tpu_worker.py``) call this module so
+production workers get the same self-calibration the benchmark does —
+throughput must not depend on an operator knowing ``LLMQ_DECODE_KERNEL``.
+
+The probe always runs in a SUBPROCESS (``python -m
+llmq_tpu.engine.kernel_autotune``): on standard TPU VMs libtpu is
+exclusive, so the probing child must own the chip briefly and exit
+before the parent process initialises the backend, and a kernel hang on
+a flaky tunnel must cost at most the probe budget, never the caller.
+
+An explicit ``LLMQ_DECODE_KERNEL`` env var always wins; any probe
+failure or timeout falls back to v1 (the conservative default).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def run_ab(
+    *,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    num_layers: int,
+    max_seqs: int,
+    page_size: int,
+) -> str:
+    """In-process kernel A/B (the child-process body).
+
+    The pool must NOT fit in VMEM (~128 MB) or every kernel looks
+    infinitely fast (round-3 finding); ~300 MB per side with per-layer
+    distinct pages defeats caching while leaving the caller's HBM alone.
+    Returns ``v1`` on any failure — never raises.
+    """
+    try:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from llmq_tpu.ops.attention import write_kv_pages
+        from llmq_tpu.ops.pallas_attention import (
+            paged_decode_attention_pallas,
+            paged_decode_attention_pallas_v2,
+            paged_decode_attention_pallas_v3,
+        )
+
+        if jax.devices()[0].platform != "tpu":
+            return "v1"  # the Pallas candidates only differ on real TPUs
+
+        H, NKV, D = num_heads, num_kv_heads, head_dim
+        L = num_layers
+        S = max_seqs
+        PAGE = page_size
+        PPS = 4
+        per_page = PAGE * NKV * D * 2  # bf16
+        P = max(PPS * 4, min(300 * 2**20 // max(1, L * per_page), 961))
+        if P < PPS + 1:
+            return "v1"
+        ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
+        q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
+        kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
+        vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
+        kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
+        vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
+        rng = np.random.default_rng(0)
+        # Pages WITHOUT replacement: all three candidates write the new
+        # row, and a cross-sequence page collision would make the scatter
+        # (one winner) and the fused kernel (own row each) legitimately
+        # disagree, spuriously tripping the numerics guard.
+        if P - 1 < S * PPS:
+            return "v1"  # pool too small for distinct pages per seq
+        perm = rng.permutation(np.arange(1, P))[: S * PPS]
+        bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
+        cl = jnp.full((S,), ctx, jnp.int32)
+        positions = (cl - 1)[:, None]
+        w = jnp.asarray([1 << 30], jnp.int32)
+        scale = D**-0.5
+
+        # v1/v2 pay the separate XLA KV scatter the engine runs before
+        # them; v3 writes in-kernel. Time each candidate as the engine
+        # would actually run it, so the ranking is apples-to-apples.
+        # Donation matters: without it XLA must preserve the caller's
+        # pool, which forces a full-pool copy around v3's in-place alias
+        # and penalizes it artificially.
+        @functools.partial(
+            jax.jit, static_argnames=("which",), donate_argnums=(0, 1)
+        )
+        def step(kp, vp, li, *, which):
+            if which == "v3":
+                out, kp, vp = paged_decode_attention_pallas_v3(
+                    q, kp, vp, kn, vn, bt, cl, w, li, scale=scale
+                )
+                return out, kp, vp
+            kp, vp = write_kv_pages(
+                kp, vp, kn[:, None], vn[:, None], bt, positions, layer=li
+            )
+            kern = (
+                paged_decode_attention_pallas_v2
+                if which == "v2"
+                else paged_decode_attention_pallas
+            )
+            return kern(q, kp, vp, bt, cl, w, li, scale=scale), kp, vp
+
+        def timeit(which, n=2):
+            nonlocal kp, vp
+            for li in range(L):
+                out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
+            jax.block_until_ready(out)
+            t0 = time.monotonic()
+            for _ in range(n):
+                for li in range(L):
+                    out, kp, vp = step(kp, vp, jnp.int32(li), which=which)
+                jax.block_until_ready(out)
+            return (time.monotonic() - t0) / (n * L)
+
+        times = {which: timeit(which) for which in ("v1", "v2", "v3")}
+        # Numerics guard: per-candidate agreement with v1. Each guard call
+        # rewrites the same (kn, vn) row at the same position, so the pool
+        # state is identical for all three.
+        outs = {}
+        for which in ("v1", "v2", "v3"):
+            o, kp, vp = step(kp, vp, jnp.int32(0), which=which)
+            outs[which] = o.astype(jnp.float32)
+        diffs = {
+            a: float(jnp.max(jnp.abs(outs[a] - outs["v1"])))
+            for a in ("v2", "v3")
+        }
+        choice = "v1"
+        for cand in ("v2", "v3"):
+            if times[cand] < 0.92 * times[choice] and diffs[cand] < 0.05:
+                choice = cand
+        for arr in (q, kp, vp, kn, vn, *outs.values()):
+            arr.delete()
+        shown = " ".join(f"{k}={v*1e3:.3f}ms" for k, v in times.items())
+        dshown = " ".join(f"{k}|diff|={v:.2e}" for k, v in diffs.items())
+        print(
+            f"kernel-autotune: decode A/B {shown} per layer ({dshown}) "
+            f"-> {choice}",
+            file=sys.stderr,
+        )
+        return choice
+    except Exception as exc:  # noqa: BLE001 — never endanger the caller
+        print(f"kernel-autotune: A/B failed ({exc!r}); using v1", file=sys.stderr)
+        return "v1"
+
+
+def autotune_decode_kernel(
+    *,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    num_layers: int,
+    max_seqs: int = 192,
+    page_size: int = 128,
+    timeout_s: Optional[float] = None,
+    logger=None,
+) -> Optional[str]:
+    """Subprocess A/B driver for callers that have NOT yet initialised a
+    JAX backend (libtpu exclusivity — see module docstring).
+
+    Returns the winning kernel name, or ``None`` when the probe does not
+    apply (explicit ``LLMQ_DECODE_KERNEL`` set, CPU-pinned platform, or
+    ``LLMQ_KERNEL_AUTOTUNE=0``). Failures and timeouts return ``"v1"``.
+    The caller is expected to export the choice via ``LLMQ_DECODE_KERNEL``
+    before building its engine.
+    """
+    if os.environ.get("LLMQ_DECODE_KERNEL"):
+        return None
+    if os.environ.get("LLMQ_KERNEL_AUTOTUNE", "1").lower() in ("0", "false"):
+        return None
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None  # CPU runs take the XLA attention path anyway
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420))
+    argv = [
+        sys.executable,
+        "-m",
+        "llmq_tpu.engine.kernel_autotune",
+        str(num_heads),
+        str(num_kv_heads),
+        str(head_dim),
+        str(num_layers),
+        str(max_seqs),
+        str(page_size),
+    ]
+    try:
+        proc = subprocess.run(
+            argv, timeout=timeout_s, capture_output=True, text=True
+        )
+        sys.stderr.write(proc.stderr[-600:])
+        choice = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode == 0 and choice in ("v1", "v2", "v3"):
+            if logger is not None:
+                logger.info(
+                    "decode kernel: %s (A/B %s)",
+                    choice,
+                    (proc.stderr.strip().splitlines() or ["no detail"])[-1],
+                )
+            return choice
+        msg = f"kernel A/B rc={proc.returncode}; using v1"
+    except subprocess.TimeoutExpired:
+        msg = "kernel A/B timed out; using v1"
+    except Exception as exc:  # noqa: BLE001
+        msg = f"kernel A/B failed ({exc!r}); using v1"
+    if logger is not None:
+        logger.warning(msg)
+    else:
+        print(f"kernel-autotune: {msg}", file=sys.stderr)
+    return "v1"
+
+
+def _main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Testability off-TPU: the axon sitecustomize pins the platform at
+        # the CONFIG level, so the env var alone would still try (and hang
+        # on) the tunnel.
+        from llmq_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    h, kv, d, layers, seqs, page = (int(a) for a in sys.argv[1:7])
+    print(
+        run_ab(
+            num_heads=h,
+            num_kv_heads=kv,
+            head_dim=d,
+            num_layers=layers,
+            max_seqs=seqs,
+            page_size=page,
+        )
+    )
+
+
+if __name__ == "__main__":
+    _main()

@@ -201,12 +201,22 @@ def _key_data_host(eff_seed: int) -> "np.ndarray":
         return np.asarray(jax.random.key_data(jax.random.key(eff_seed)))
 
 
-def make_base_key(seed: Optional[int], slot: int) -> "np.ndarray":
-    """Key data for one slot, computed once at admission (host-side).
+def make_base_key(seed: Optional[int], request_tag: int) -> "np.ndarray":
+    """Key data for one request, computed once at admission (host-side).
 
-    Seeded requests are reproducible across runs; unseeded ones derive
-    from the slot index (distinct streams, arbitrary — vLLM semantics).
+    Seeded requests derive from the seed alone and are reproducible
+    across runs. Unseeded ones derive from ``request_tag`` — a stable
+    per-request integer (the engine passes a CRC of the request id), so
+    a recompute-preempted request re-admitted into a *different* slot
+    continues the same stream; keys never depend on slot placement.
     """
-    return _key_data_host(seed if seed is not None else 0x5EED ^ slot)
+    return _key_data_host(seed if seed is not None else 0x5EED ^ request_tag)
+
+
+def request_tag(rid: str) -> int:
+    """Stable integer stream tag for an unseeded request id."""
+    import zlib
+
+    return zlib.crc32(rid.encode("utf-8", "surrogatepass"))
 
 

@@ -196,6 +196,7 @@ class Scheduler:
         self._prefix_cache: Dict[bytes, int] = {}
         self._prefix_rev: Dict[int, List[bytes]] = {}
         self.prefix_hits = 0  # pages reused via the cache (stats)
+        self.preemptions = 0  # recompute preemptions (stats)
         self.allocator.on_evict = self._drop_page_hashes
 
     # --- prefix caching ---------------------------------------------------
@@ -421,6 +422,7 @@ class Scheduler:
             seq.cacheable_pages = 0
         self._release(seq)
         seq.preempt_count += 1
+        self.preemptions += 1
         seq.prefilled = False  # KV is gone; re-admission re-prefills
         self.waiting.appendleft(seq)
         return pages, cacheable
@@ -471,6 +473,7 @@ class Scheduler:
             "batch_occupancy": len(self.running) / self.config.max_num_seqs,
             "kv_page_utilization": (total_pages - self.allocator.available)
             / max(1, total_pages),
+            "preemptions": self.preemptions,
         }
         if self.config.enable_prefix_caching:
             out["prefix_cache_hit_pages"] = self.prefix_hits

@@ -161,6 +161,8 @@ class _Streamer:
             return None
         node: Any = self.specs
         for part in name.split("."):
+            if not isinstance(node, dict):
+                break  # "<name>.q" reuses the weight's own spec (int8 path)
             node = node[part]
         return NamedSharding(self.mesh, node)
 
@@ -221,6 +223,7 @@ def load_checkpoint(
     *,
     dtype=jnp.bfloat16,
     mesh: Optional[Mesh] = None,
+    quantize: bool = False,
 ) -> Params:
     """Load an HF checkpoint directory into the stacked param layout.
 
@@ -229,7 +232,16 @@ def load_checkpoint(
     in place, so neither the host nor any single device ever holds an
     unsharded copy. Without a mesh, buffers stream onto the default
     device (single-device use; tests).
+
+    ``quantize`` (``--dtype int8``) quantizes the big matmul weights to
+    symmetric per-channel int8 *while streaming* — blocks are quantized
+    on the host and land on device already int8, so the full-precision
+    copy never exists in HBM (the point: a ~9B bf16 model that can't fit
+    a 16 GB chip loads at ~half the bytes). ``dtype`` remains the
+    compute/scale dtype. See ``models/quant.py``.
     """
+    from llmq_tpu.models import quant as qm
+
     model_path = Path(model_path)
     if config is None:
         config = ModelConfig.from_pretrained(model_path)
@@ -245,21 +257,58 @@ def load_checkpoint(
         specs = param_pspecs(config, int(mesh.shape.get(TP_AXIS, 1)))
     streamer = _Streamer(mesh, specs)
 
+    def _finish_quant(buf, scales: np.ndarray, name: str, *, row_wise: bool):
+        """Pair an int8 device buffer with its host-accumulated scales.
+        The scale keeps the surviving axes of the weight's spec: drop the
+        reduced axis (contraction for weights, features for embed)."""
+        weight_spec = streamer._sharding(name + ".q")
+        host = scales.astype(np_dtype)
+        if weight_spec is None:
+            return {"q": buf, "scale": jax.device_put(host)}
+        parts = list(weight_spec.spec)
+        parts = parts[:-1] if row_wise else parts[:-2] + parts[-1:]
+        sdev = jax.device_put(host, NamedSharding(mesh, P(*parts)))
+        return {"q": buf, "scale": sdev}
+
+    def _np_quant(arr: np.ndarray, axis: int):
+        """Host-side symmetric int8 quantization of one block."""
+        a32 = np.asarray(arr, np.float32)
+        amax = np.abs(a32).max(axis=axis)
+        scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(
+            np.rint(a32 / np.expand_dims(scale, axis)), -127, 127
+        ).astype(np.int8)
+        return q, scale
+
     def stacked(our_name: str, fmt: str, *, transpose: bool = False):
         """Stream layer tensors into a [L, ...] device stack."""
         shape0 = reader.shape(fmt.format(i=0))
         if transpose:
             shape0 = shape0[::-1]
         full = (L, *shape0)
+        quant = quantize and our_name in qm.QUANTIZED_LAYER_KEYS
+
+        scales = np.ones((L, *shape0[:-2], shape0[-1]), np.float32) if quant else None
 
         def blocks():
             for i in range(L):
                 arr = reader.get(fmt.format(i=i))
                 if transpose:
                     arr = arr.T
+                if quant:
+                    arr, s = _np_quant(arr, axis=-2)
+                    scales[i] = s
                 yield i, arr[None]
 
-        return streamer.stream(f"layers.{our_name}", full, dtype, blocks())
+        buf = streamer.stream(
+            f"layers.{our_name}" + (".q" if quant else ""),
+            full,
+            jnp.int8 if quant else dtype,
+            blocks(),
+        )
+        if not quant:
+            return buf
+        return _finish_quant(buf, scales, f"layers.{our_name}", row_wise=False)
 
     def big2d(our_name: str, hf_name: str, *, transpose: bool = False):
         """Stream a large 2-D tensor in bounded row chunks."""
@@ -277,14 +326,31 @@ def load_checkpoint(
         chunk = max(1, _CHUNK_BYTES // max(1, cols * itemsize))
         shape = (cols, rows) if transpose else (rows, cols)
         axis = 1 if transpose else 0
+        quant = quantize and our_name in qm.QUANTIZED_TOP_KEYS
+        # embed quantizes per ROW (lookup axis); lm_head (streamed
+        # transposed, [H, V]) per output column — both reduce over the
+        # stored tensor's column axis, so the block math is identical.
+        scales = np.ones((rows,), np.float32) if quant else None
 
         def blocks():
             for lo in range(0, rows, chunk):
                 hi = min(rows, lo + chunk)
                 arr = reader.get_rows(hf_name, lo, hi)
+                if quant:
+                    arr, s = _np_quant(arr, axis=1)
+                    scales[lo:hi] = s
                 yield lo, arr.T if transpose else arr
 
-        return streamer.stream(our_name, shape, dtype, blocks(), axis=axis)
+        buf = streamer.stream(
+            our_name + (".q" if quant else ""),
+            shape,
+            jnp.int8 if quant else dtype,
+            blocks(),
+            axis=axis,
+        )
+        if not quant:
+            return buf
+        return _finish_quant(buf, scales, our_name, row_wise=not transpose)
 
     def has(name: str) -> bool:
         return name in reader.index
@@ -322,14 +388,29 @@ def load_checkpoint(
             tensor at a time — host RSS stays ~1 expert tensor."""
             shape0 = reader.shape(fmt.format(i=0, e=0))[::-1]  # transposed
             full = (L, E, *shape0)
+            quant = quantize and our_name in qm.QUANTIZED_LAYER_KEYS
+            scales = np.ones((L, E, shape0[-1]), np.float32) if quant else None
 
             def blocks():
                 for i in range(L):
                     for e in range(E):
                         arr = reader.get(fmt.format(i=i, e=e)).T
+                        if quant:
+                            arr, s = _np_quant(arr, axis=-2)
+                            scales[i, e] = s
                         yield (i, e), arr[None, None]
 
-            return streamer.stream(f"layers.{our_name}", full, dtype, blocks())
+            buf = streamer.stream(
+                f"layers.{our_name}" + (".q" if quant else ""),
+                full,
+                jnp.int8 if quant else dtype,
+                blocks(),
+            )
+            if not quant:
+                return buf
+            return _finish_quant(
+                buf, scales, f"layers.{our_name}", row_wise=False
+            )
 
         layers["router"] = stacked(
             "router", "model.layers.{i}.mlp.gate.weight", transpose=True

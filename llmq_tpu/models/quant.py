@@ -1,0 +1,186 @@
+"""Weight-only int8 quantization (``--dtype int8``).
+
+Replaces the capability the reference inherited from vLLM's quantization
+support: int8 weight storage halves HBM footprint AND HBM bandwidth —
+decode is weight-bound once attention runs at the bandwidth floor
+(PERF_NOTES round 4), and it is what lets a ~9B bf16 model (~18 GB)
+fit a single 16 GB v5e chip.
+
+Representation — a quantized weight is a plain nested dict
+
+    {"q": int8[..., in, out], "scale": float32[..., out]}
+
+with symmetric per-output-channel scales (``w ≈ q * scale``). Using a
+dict (not a custom pytree class) means the whole machinery — ``lax.scan``
+leading-axis slicing, ``device_put`` with sharding trees, donation, the
+weight streamer — handles quantized params with zero special cases; only
+the matmul call sites and the sharding-spec builder know the shape.
+
+Math: per-column scales commute with the contraction, so
+
+    x @ (q * scale) == (x @ q_as_bf16) * scale
+
+and the kernel runs as a bf16 MXU matmul whose weight operand is
+converted from int8 on the fly (XLA fuses the convert into the dot
+operand read — the HBM side stays int8).
+
+Embeddings quantize per ROW (the lookup axis): ``q[ids] * scale[ids]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# Keys quantized under --dtype int8: every large matmul operand. Norms,
+# biases, the MoE router and the tiny shared-expert gate stay bf16 (their
+# bytes are noise; router logits are precision-sensitive).
+QUANTIZED_LAYER_KEYS = (
+    "q_proj",
+    "k_proj",
+    "v_proj",
+    "o_proj",
+    "gate_proj",
+    "up_proj",
+    "down_proj",
+    "expert_gate_proj",
+    "expert_up_proj",
+    "expert_down_proj",
+    "shared_gate_proj",
+    "shared_up_proj",
+    "shared_down_proj",
+)
+QUANTIZED_TOP_KEYS = ("embed", "lm_head")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "scale" in w
+
+
+def quantize_array(
+    w: jnp.ndarray, *, axis: int, scale_dtype=jnp.float32
+) -> Params:
+    """Symmetric int8 quantization with the scale reduced over ``axis``
+    (the contraction dim for weights, the feature dim for embeddings).
+    ``scale_dtype`` should be the model's compute dtype — matmul outputs
+    and embedding lookups inherit it."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(w32 / jnp.expand_dims(scale, axis))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(scale_dtype)}
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("axis", "scale_dtype"))
+def quantize_array_donated(w, *, axis: int, scale_dtype=jnp.float32) -> Params:
+    """``quantize_array`` freeing the input buffer on dispatch — for
+    init/load flows where the full-precision tree would not fit HBM."""
+    return quantize_array(w, axis=axis, scale_dtype=scale_dtype)
+
+
+def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``x @ w`` for a plain array or an int8-quantized weight."""
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+def dequantize(w: Any, dtype) -> jnp.ndarray:
+    """Materialize the full-precision weight (grouped-matmul operands —
+    ``lax.ragged_dot`` takes a real array). One layer's slice at a time
+    inside the scan, so the transient stays small."""
+    if is_quantized(w):
+        return w["q"].astype(dtype) * w["scale"].astype(dtype)[..., None, :]
+    return w
+
+
+def embed_lookup(w: Any, ids: jnp.ndarray) -> jnp.ndarray:
+    """Embedding-table row lookup for plain or row-quantized tables. The
+    scale's dtype IS the model compute dtype (set at quantize time), so
+    the lookup result matches what a plain bf16 table would produce."""
+    if is_quantized(w):
+        dtype = w["scale"].dtype
+        return w["q"][ids].astype(dtype) * w["scale"][ids][..., None]
+    return w[ids]
+
+
+def tied_head_matmul(h: jnp.ndarray, embed: Any) -> jnp.ndarray:
+    """``h @ embed.T`` for tied-embedding LM heads. The embedding's
+    per-row scale becomes the head's per-column scale."""
+    if is_quantized(embed):
+        return (h @ embed["q"].T.astype(h.dtype)) * embed["scale"].astype(h.dtype)
+    return h @ embed.T
+
+
+def quantize_params(
+    params: Params, scale_dtype=jnp.float32, *, donate: bool = False
+) -> Params:
+    """Quantize a loaded/initialized param tree (returns a new tree).
+    Used by the preset / random-init path and tests; checkpoint loads
+    quantize while streaming (``engine/weights.py``) so the bf16 copy
+    never exists on device.
+
+    ``donate=True`` frees each full-precision buffer as it is consumed —
+    required when the bf16 tree alone nearly fills HBM (a 9B preset on a
+    16 GB chip): peak HBM is then one tensor's bf16+int8, not two whole
+    trees. The input tree's quantized leaves are unusable afterwards."""
+    donate_args = (0,) if donate else ()
+
+    @partial(jax.jit, donate_argnums=donate_args)
+    def _quant_w(w):
+        return quantize_array(w, axis=-2, scale_dtype=scale_dtype)
+
+    @partial(jax.jit, donate_argnums=donate_args)
+    def _quant_rows(w):
+        return quantize_array(w, axis=-1, scale_dtype=scale_dtype)
+
+    out: Params = dict(params)
+    layers = dict(params["layers"])
+    for key in QUANTIZED_LAYER_KEYS:
+        if key in layers:
+            layers[key] = _quant_w(layers[key])
+    out["layers"] = layers
+    out["embed"] = _quant_rows(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = _quant_w(params["lm_head"])
+    return out
+
+
+def quantized_specs(specs: Params, params: Params) -> Params:
+    """Mirror a PartitionSpec tree onto a (possibly) quantized param
+    tree: wherever the params hold ``{"q", "scale"}``, the weight's spec
+    applies to ``q`` and the scale keeps the spec of the surviving axes
+    (the reduced axis's entry is dropped)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(spec_node, param_node, key):
+        if is_quantized(param_node):
+            spec = spec_node
+            parts = list(spec) + [None] * (param_node["q"].ndim - len(spec))
+            # The reduced axis is structural, not inferable from shapes
+            # (square weights are common): only "embed" quantizes per ROW
+            # (last axis reduced); every weight reduces the contraction
+            # (second-to-last) axis.
+            if key == "embed":
+                scale_parts = parts[:-1]
+            else:
+                scale_parts = parts[:-2] + parts[-1:]
+            return {"q": spec, "scale": P(*scale_parts)}
+        if isinstance(param_node, dict):
+            return {
+                k: walk(
+                    spec_node[k] if isinstance(spec_node, dict) else spec_node,
+                    v,
+                    k,
+                )
+                for k, v in param_node.items()
+            }
+        return spec_node
+
+    return walk(specs, params, "")

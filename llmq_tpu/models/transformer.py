@@ -26,6 +26,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from llmq_tpu.models import quant as qm
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.ops import attention as attn_ops
 from llmq_tpu.ops import dispatch as attn_dispatch
@@ -95,13 +96,13 @@ def apply_rope(
 
 
 def _mlp(h: jnp.ndarray, lp: Params, activation: str) -> jnp.ndarray:
-    gate = h @ lp["gate_proj"]
-    up = h @ lp["up_proj"]
+    gate = qm.matmul(h, lp["gate_proj"])
+    up = qm.matmul(h, lp["up_proj"])
     if activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
         act = jax.nn.silu(gate)
-    return (act * up) @ lp["down_proj"]
+    return qm.matmul(act * up, lp["down_proj"])
 
 
 def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
@@ -134,13 +135,22 @@ def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
     xs = x[token_of]  # [N*k, H] gathered, grouped by expert
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
 
-    gate = jax.lax.ragged_dot(xs, lp["expert_gate_proj"], group_sizes)
-    up = jax.lax.ragged_dot(xs, lp["expert_up_proj"], group_sizes)
+    # ragged_dot takes a real array operand: int8 expert stacks are
+    # dequantized per layer-scan step (a transient one-layer bf16 copy;
+    # HBM-resident storage stays int8).
+    gate = jax.lax.ragged_dot(
+        xs, qm.dequantize(lp["expert_gate_proj"], x.dtype), group_sizes
+    )
+    up = jax.lax.ragged_dot(
+        xs, qm.dequantize(lp["expert_up_proj"], x.dtype), group_sizes
+    )
     if config.activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True) * up
     else:
         act = jax.nn.silu(gate) * up
-    down = jax.lax.ragged_dot(act, lp["expert_down_proj"], group_sizes)
+    down = jax.lax.ragged_dot(
+        act, qm.dequantize(lp["expert_down_proj"], x.dtype), group_sizes
+    )
 
     w_sorted = top_w.reshape(-1)[order].astype(down.dtype)  # [N*k]
     out = jax.ops.segment_sum(
@@ -187,9 +197,9 @@ class Transformer:
         cfg = self.config
         d = cfg.head_dim_
         *lead, _ = h.shape
-        q = h @ lp["q_proj"]
-        k = h @ lp["k_proj"]
-        v = h @ lp["v_proj"]
+        q = qm.matmul(h, lp["q_proj"])
+        k = qm.matmul(h, lp["k_proj"])
+        v = qm.matmul(h, lp["v_proj"])
         if cfg.attention_bias:
             q = q + lp["q_bias"]
             k = k + lp["k_bias"]
@@ -211,7 +221,7 @@ class Transformer:
         one_plus = cfg.model_type.startswith("gemma")
         *lead, _, _ = attn_out.shape
         attn_flat = attn_out.reshape(*lead, cfg.num_heads * cfg.head_dim_)
-        attn_proj = attn_flat @ lp["o_proj"]
+        attn_proj = qm.matmul(attn_flat, lp["o_proj"])
         if cfg.post_norms:
             attn_proj = rms_norm(
                 attn_proj, lp["post_attn_norm"], cfg.rms_norm_eps, one_plus=one_plus
@@ -245,7 +255,7 @@ class Transformer:
 
     def _embed(self, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
-        h = params["embed"][tokens]
+        h = qm.embed_lookup(params["embed"], tokens)
         if cfg.scale_embeddings:
             h = h * jnp.asarray(
                 math.sqrt(cfg.hidden_size), dtype=h.dtype
@@ -258,8 +268,9 @@ class Transformer:
         h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, one_plus=one_plus)
         head = params.get("lm_head")
         if head is None:
-            head = params["embed"].T
-        logits = (h @ head).astype(jnp.float32)
+            logits = qm.tied_head_matmul(h, params["embed"]).astype(jnp.float32)
+        else:
+            logits = qm.matmul(h, head).astype(jnp.float32)
         if cfg.logit_softcap is not None:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
         return logits
@@ -480,43 +491,55 @@ class Transformer:
 
 
 def init_params(
-    config: ModelConfig, key: jax.Array, dtype=jnp.float32
+    config: ModelConfig, key: jax.Array, dtype=jnp.float32,
+    *, quantize: bool = False,
 ) -> Params:
-    """Random init (testing / benchmarks without a checkpoint)."""
+    """Random init (testing / benchmarks without a checkpoint).
+
+    ``quantize`` produces the int8 weight-only tree (``models/quant.py``)
+    directly: each big weight is quantized with a donated jit the moment
+    it is created, so peak HBM is the int8 tree plus ONE full-precision
+    tensor — a 9B preset quantizes on a 16 GB chip where init-then-
+    quantize would OOM on the bf16 tree alone."""
     cfg = config
     d = cfg.head_dim_
     L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     keys = iter(jax.random.split(key, 16))
 
-    def w(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(
-            dtype
-        )
+    def w(key, shape, fan_in, *, q: bool = False, axis: int = -2):
+        arr = (
+            jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+        ).astype(dtype)
+        if quantize and q:
+            return qm.quantize_array_donated(arr, axis=axis, scale_dtype=dtype)
+        return arr
 
     layers: Params = {
         "ln1": jnp.ones((L, H), dtype),
         "ln2": jnp.ones((L, H), dtype),
-        "q_proj": w(next(keys), (L, H, cfg.num_heads * d), H),
-        "k_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
-        "v_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H),
-        "o_proj": w(next(keys), (L, cfg.num_heads * d, H), cfg.num_heads * d),
+        "q_proj": w(next(keys), (L, H, cfg.num_heads * d), H, q=True),
+        "k_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H, q=True),
+        "v_proj": w(next(keys), (L, H, cfg.num_kv_heads * d), H, q=True),
+        "o_proj": w(
+            next(keys), (L, cfg.num_heads * d, H), cfg.num_heads * d, q=True
+        ),
     }
     if cfg.num_experts:
         E, Im = cfg.num_experts, cfg.moe_intermediate_size
         layers["router"] = w(next(keys), (L, H, E), H)
-        layers["expert_gate_proj"] = w(next(keys), (L, E, H, Im), H)
-        layers["expert_up_proj"] = w(next(keys), (L, E, H, Im), H)
-        layers["expert_down_proj"] = w(next(keys), (L, E, Im, H), Im)
+        layers["expert_gate_proj"] = w(next(keys), (L, E, H, Im), H, q=True)
+        layers["expert_up_proj"] = w(next(keys), (L, E, H, Im), H, q=True)
+        layers["expert_down_proj"] = w(next(keys), (L, E, Im, H), Im, q=True)
         if cfg.shared_expert_intermediate_size:
             Is = cfg.shared_expert_intermediate_size
-            layers["shared_gate_proj"] = w(next(keys), (L, H, Is), H)
-            layers["shared_up_proj"] = w(next(keys), (L, H, Is), H)
-            layers["shared_down_proj"] = w(next(keys), (L, Is, H), Is)
+            layers["shared_gate_proj"] = w(next(keys), (L, H, Is), H, q=True)
+            layers["shared_up_proj"] = w(next(keys), (L, H, Is), H, q=True)
+            layers["shared_down_proj"] = w(next(keys), (L, Is, H), Is, q=True)
             layers["shared_expert_gate"] = w(next(keys), (L, H, 1), H)
     else:
-        layers["gate_proj"] = w(next(keys), (L, H, I), H)
-        layers["up_proj"] = w(next(keys), (L, H, I), H)
-        layers["down_proj"] = w(next(keys), (L, I, H), I)
+        layers["gate_proj"] = w(next(keys), (L, H, I), H, q=True)
+        layers["up_proj"] = w(next(keys), (L, H, I), H, q=True)
+        layers["down_proj"] = w(next(keys), (L, I, H), I, q=True)
     if cfg.attention_bias:
         layers["q_bias"] = jnp.zeros((L, cfg.num_heads * d), dtype)
         layers["k_bias"] = jnp.zeros((L, cfg.num_kv_heads * d), dtype)
@@ -528,12 +551,12 @@ def init_params(
         layers["post_attn_norm"] = jnp.ones((L, H), dtype)
         layers["post_mlp_norm"] = jnp.ones((L, H), dtype)
     params: Params = {
-        "embed": w(next(keys), (cfg.vocab_size, H), H),
+        "embed": w(next(keys), (cfg.vocab_size, H), H, q=True, axis=-1),
         "layers": layers,
         "final_norm": jnp.ones((H,), dtype),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w(next(keys), (H, cfg.vocab_size), H)
+        params["lm_head"] = w(next(keys), (H, cfg.vocab_size), H, q=True)
     return params
 
 

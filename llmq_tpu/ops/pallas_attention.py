@@ -848,6 +848,25 @@ def paged_prefill_attention_pallas(
         n_kv=n_kv,
         softcap=softcap,
     )
+    # K/V page index, clamped to the [window, causal] live frontier of
+    # this (row, q-block). Dead grid steps resolve to the same block index
+    # as the nearest live one, and Mosaic's pipeline skips the re-DMA when
+    # consecutive steps fetch the same block — so per-chunk attention
+    # bandwidth scales with the LIVE context, not max_model_len (compute
+    # over dead pages was already masked; this kills their DMAs too).
+    def _kv_index(b, iq, p, li, bt, st, nv, w):
+        start = st[b] + iq * block_q  # absolute pos of q row 0
+        nhere = jnp.minimum(nv[b] - iq * block_q, block_q)
+        hi = start + jnp.maximum(nhere, 1) - 1  # highest live key pos
+        # Clamp to the table width too: for DEAD q-blocks in the padded
+        # tail, `start` (and with sliding windows `first_live`) can land
+        # past max_model_len — the raw frontier would then index
+        # block_tables out of bounds.
+        last_live = jnp.clip(hi // page_size, 0, pages_per_seq - 1)
+        first_live = jnp.clip((start - w[0]) // page_size, 0, pages_per_seq - 1)
+        pc = jnp.clip(p, jnp.minimum(first_live, last_live), last_live)
+        return (li[0], bt[b, pc], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(B, nq, pages_per_seq),
@@ -856,14 +875,8 @@ def paged_prefill_attention_pallas(
                 (1, block_q, n_heads, d),
                 lambda b, iq, p, li, bt, st, nv, w: (b, iq, 0, 0),
             ),
-            pl.BlockSpec(
-                (1, 1, page_size, n_kv, d),
-                lambda b, iq, p, li, bt, st, nv, w: (li[0], bt[b, p], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, page_size, n_kv, d),
-                lambda b, iq, p, li, bt, st, nv, w: (li[0], bt[b, p], 0, 0, 0),
-            ),
+            pl.BlockSpec((1, 1, page_size, n_kv, d), _kv_index),
+            pl.BlockSpec((1, 1, page_size, n_kv, d), _kv_index),
         ],
         out_specs=pl.BlockSpec(
             (1, block_q, n_heads, d),

@@ -111,12 +111,17 @@ def param_shardings(
     """NamedSharding pytree for the full param tree.
 
     When ``params`` is given, the spec tree is pruned to exactly the keys
-    present (e.g. a tied-embedding checkpoint without ``lm_head``).
+    present (e.g. a tied-embedding checkpoint without ``lm_head``) and
+    int8-quantized weights (``models/quant.py`` dicts) expand into
+    matching {q, scale} spec nodes.
     """
+    from llmq_tpu.models import quant as qm
+
     tp = mesh.shape[TP_AXIS]
     specs = param_pspecs(config, tp)
     if params is not None:
         specs = _prune_like(specs, params)
+        specs = qm.quantized_specs(specs, params)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
         specs,
@@ -125,10 +130,15 @@ def param_shardings(
 
 
 def _prune_like(specs: Params, params: Params) -> Params:
+    from llmq_tpu.models import quant as qm
+
     out: Params = {}
     for key, value in params.items():
         spec = specs[key]
-        out[key] = _prune_like(spec, value) if isinstance(value, dict) else spec
+        if isinstance(value, dict) and not qm.is_quantized(value):
+            out[key] = _prune_like(spec, value)
+        else:
+            out[key] = spec  # quantized leaves expanded by quantized_specs
     return out
 
 

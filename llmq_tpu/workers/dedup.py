@@ -2,9 +2,14 @@
 
 Capability-parity with the reference's SemHashWorker (``llmq/workers/
 semhash_worker.py:10-191``), which delegated to the MinishLab ``semhash``
-library. That dependency isn't available here, so the similarity engine is
-implemented natively: hashed character-n-gram TF vectors (a SimHash-family
-representation) + cosine similarity in numpy. Same worker contract:
+library. That dependency isn't available here, so the similarity engine
+is implemented natively with two backends: ``lexical`` — hashed
+character-n-gram TF vectors (a SimHash-family representation, no model
+required) — and ``model`` — mean-pooled vectors from a checkpoint's
+input-embedding table (:class:`ModelEmbedder`, the static
+bag-of-embeddings baseline model2vec distills), which catches the
+paraphrase duplicates n-grams cannot. Cosine similarity in numpy either
+way. Same worker contract:
 
 - accumulate jobs into batches of ``batch_size`` and process per batch,
 - three modes: ``dedup`` (drop near-duplicates), ``outliers`` (drop texts
@@ -72,6 +77,78 @@ def embed(texts: List[str], dim: int = _DIM, n: int = _NGRAM) -> np.ndarray:
     return out
 
 
+class ModelEmbedder:
+    """Semantic text embedding from a language model's input-embedding
+    table: tokenize, mean-pool the token vectors, L2-normalise.
+
+    This is the *semantic* counterpart of :func:`embed` (capability
+    parity with the reference's embedding-based semhash/model2vec stack,
+    ``llmq/workers/semhash_worker.py:60-157``, which isn't available
+    offline): a trained embedding table places synonyms near each other,
+    so a paraphrase pair with near-zero character-n-gram overlap still
+    scores high — exactly what the lexical mode cannot catch. Mean-pooled
+    bag-of-embeddings is the standard static baseline (model2vec is the
+    same idea distilled).
+    """
+
+    def __init__(self, tokenize, table: np.ndarray) -> None:
+        self._tokenize = tokenize  # str -> List[int]
+        table = np.asarray(table, np.float32)
+        # Centering removes the dominant shared direction of embedding
+        # tables (the "common discourse" component) that would otherwise
+        # push ALL cosine similarities toward 1.
+        self._table = table - table.mean(axis=0, keepdims=True)
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "ModelEmbedder":
+        """Load just the embedding table (not the model) from a local HF
+        checkpoint directory: any safetensors tensor named like
+        ``*embed_tokens.weight`` / ``*wte.weight``."""
+        import json
+        from pathlib import Path
+
+        from safetensors import safe_open
+
+        from llmq_tpu.engine.tokenizer import HFTokenizer
+
+        root = Path(path)
+        names = ("embed_tokens.weight", "wte.weight", "word_embeddings.weight")
+        index = root / "model.safetensors.index.json"
+        if index.exists():
+            weight_map = json.loads(index.read_text())["weight_map"]
+            candidates = {
+                key: root / fname
+                for key, fname in weight_map.items()
+                if key.endswith(names)
+            }
+        else:
+            candidates = {}
+            for fname in sorted(root.glob("*.safetensors")):
+                with safe_open(fname, framework="np") as f:
+                    for key in f.keys():
+                        if key.endswith(names):
+                            candidates[key] = fname
+        if not candidates:
+            raise ValueError(f"no embedding table found under {root}")
+        key, fname = sorted(candidates.items())[0]
+        # framework="np": torch-free, same reader the checkpoint loader
+        # uses (engine/weights.py) — bf16 comes through via ml_dtypes.
+        with safe_open(fname, framework="np") as f:
+            table = np.asarray(f.get_tensor(key), dtype=np.float32)
+        tokenizer = HFTokenizer(str(root))
+        return cls(tokenizer.encode, table)
+
+    def __call__(self, texts: List[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self._table.shape[1]), np.float32)
+        for i, t in enumerate(texts):
+            ids = [j for j in self._tokenize(t) if 0 <= j < len(self._table)]
+            if ids:
+                out[i] = self._table[ids].mean(axis=0)
+        norms = np.linalg.norm(out, axis=1, keepdims=True)
+        np.divide(out, norms, out=out, where=norms > 0)
+        return out
+
+
 def select_keep_mask(
     vectors: np.ndarray, mode: str, threshold: float
 ) -> np.ndarray:
@@ -125,11 +202,31 @@ class DedupWorker(BaseWorker):
         batch_size: int = 256,
         mode: str = "dedup",
         threshold: float = 0.9,
+        embedding: str = "lexical",
+        model: Optional[str] = None,
+        embedder=None,
         **kwargs,
     ) -> None:
         self.batch_size = batch_size
         self.mode = mode
         self.threshold = threshold
+        # Similarity backend: "lexical" = hashed char-n-gram TF (no model
+        # needed, catches near-verbatim duplicates); "model" = mean-pooled
+        # embedding-table vectors from --model (catches paraphrases).
+        # ``embedder`` injects a ready callable (tests).
+        if embedder is not None:
+            self._embed = embedder
+        elif embedding == "model":
+            if not model:
+                raise ValueError("--embedding model requires --model PATH")
+            self._embed = ModelEmbedder.from_checkpoint(model)
+        elif embedding == "lexical":
+            self._embed = embed
+        else:
+            raise ValueError(
+                f"Unknown embedding backend: {embedding!r} (want lexical|model)"
+            )
+        self.embedding = embedding if embedder is None else "injected"
         self.idle_flush_s = 5.0
         self._pending: List[_Pending] = []
         self._last_arrival = 0.0
@@ -180,7 +277,7 @@ class DedupWorker(BaseWorker):
 
     def _process_batch(self, batch: List[_Pending]) -> None:
         texts = [text_of(p.job) for p in batch]
-        vectors = embed(texts)
+        vectors = self._embed(texts)
         keep = select_keep_mask(vectors, self.mode, self.threshold)
         for pending, kept, text in zip(batch, keep, texts):
             if not pending.future.done():
@@ -199,6 +296,7 @@ class DedupWorker(BaseWorker):
     def _engine_stats(self) -> Optional[Dict]:
         return {
             "mode": self.mode,
+            "embedding": self.embedding,
             "batch_size": self.batch_size,
             "pending": len(self._pending),
         }

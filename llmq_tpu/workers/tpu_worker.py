@@ -91,10 +91,49 @@ class TPUWorker(BaseWorker):
     async def _initialize_processor(self) -> None:
         # Engine construction compiles XLA programs and possibly loads a
         # multi-GB checkpoint: run off the event loop so broker heartbeats
-        # and signals stay live.
+        # and signals stay live. The kernel A/B runs FIRST, while no JAX
+        # backend is initialised in this process (libtpu is exclusive).
         loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._autotune_kernel)
         self.engine = await loop.run_in_executor(None, self._build_engine)
         self.logger.info("Engine ready: %s", self.engine.stats())
+
+    def _model_config_host(self):
+        """Resolve the model architecture host-side (no device contact):
+        preset lookup or the checkpoint's config.json."""
+        try:
+            if self.model.startswith(PRESET_SCHEMES):
+                from llmq_tpu.models.presets import get_preset
+
+                return get_preset(self.model.split("://", 1)[1] or "tiny")
+            from llmq_tpu.models.config import ModelConfig
+
+            return ModelConfig.from_pretrained(Path(self.model))
+        except Exception:  # noqa: BLE001 — _build_engine reports properly
+            return None
+
+    def _autotune_kernel(self) -> None:
+        """Self-calibrate the paged-decode kernel (v1/v2/v3) by measuring
+        on this host's chip — same A/B ``bench.py`` runs, so production
+        throughput doesn't depend on an operator knowing the
+        ``LLMQ_DECODE_KERNEL`` env var. No-op when that var is already
+        set, when pinned to CPU, or under ``LLMQ_KERNEL_AUTOTUNE=0``."""
+        from llmq_tpu.engine.kernel_autotune import autotune_decode_kernel
+
+        cfg = self._model_config_host()
+        if cfg is None:
+            return
+        choice = autotune_decode_kernel(
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim_,
+            num_layers=cfg.num_layers,
+            max_seqs=self._max_num_seqs or self.config.max_num_seqs or 192,
+            page_size=self._page_size or 128,
+            logger=self.logger,
+        )
+        if choice is not None:
+            os.environ["LLMQ_DECODE_KERNEL"] = choice
 
     def _build_engine(self):
         import jax.numpy as jnp
@@ -109,7 +148,15 @@ class TPUWorker(BaseWorker):
             data_parallel=self.data_parallel,
             sequence_parallel=self.sequence_parallel,
         )
-        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self._dtype]
+        # int8 = weight-only quantization: weights stored int8 (half the
+        # HBM footprint/bandwidth — what fits a ~9B model on one 16 GB
+        # chip), compute and KV stay bf16 (models/quant.py).
+        quantize = self._dtype == "int8"
+        dtype = {
+            "bfloat16": jnp.bfloat16,
+            "float32": jnp.float32,
+            "int8": jnp.bfloat16,
+        }[self._dtype]
 
         spec = self.model
         if spec.startswith(PRESET_SCHEMES):
@@ -120,7 +167,9 @@ class TPUWorker(BaseWorker):
             import jax
 
             self.logger.info("Preset model %s (random weights)", name)
-            params = init_params(model_config, jax.random.key(0), dtype=dtype)
+            params = init_params(
+                model_config, jax.random.key(0), dtype=dtype, quantize=quantize
+            )
             tokenizer = ByteTokenizer()
         else:
             from llmq_tpu.engine.weights import load_checkpoint
@@ -135,6 +184,7 @@ class TPUWorker(BaseWorker):
                 model_config,
                 dtype=dtype,
                 mesh=mesh,
+                quantize=quantize,
             )
             tokenizer = HFTokenizer(spec)
 
@@ -152,6 +202,16 @@ class TPUWorker(BaseWorker):
             )
         if self._page_size:
             overrides["page_size"] = self._page_size
+        else:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                # 128-token pages: the decode kernel moves one page per
+                # grid step, and 16 KB transfers are latency-bound ~6x
+                # off the HBM bandwidth floor (measured round 2); 128
+                # tokens make them 64 KB and quarter the grid. The
+                # engine's 32-token default is CPU-test-friendly only.
+                overrides["page_size"] = 128
         if self._num_pages:
             overrides["num_pages"] = self._num_pages
         chunk = self._prefill_chunk_size or self.config.prefill_chunk_size

@@ -18,6 +18,12 @@ from llmq_tpu.engine.weights import load_checkpoint
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.models.transformer import Transformer, make_kv_pages
 
+# Torch-oracle numerics gates: ~5 min of CPU on their own, so they run
+# in CI's dedicated `slow` job (alongside the engine soaks) rather than
+# on every push's fast leg. The Pallas-vs-XLA and engine parity tests
+# remain per-push gates.
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 

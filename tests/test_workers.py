@@ -188,6 +188,107 @@ class TestDedupMath:
         assert not keep[3]
 
 
+class TestSemanticDedup:
+    """The model-embedding backend catches paraphrases the lexical
+    n-gram mode cannot (reference semhash_worker.py:60-157 capability)."""
+
+    # Paraphrase pair: same meaning, near-zero character-n-gram overlap.
+    PARA_A = "the cat sat on the mat"
+    PARA_B = "a feline rested upon a rug"
+    UNRELATED = "quantum flux generator"
+
+    @staticmethod
+    def _embedder():
+        import numpy as np
+
+        from llmq_tpu.workers.dedup import ModelEmbedder
+
+        # A tiny embedding table that encodes synonymy the way a trained
+        # table does: synonym words share a vector. The test verifies the
+        # *mechanism* (tokenize → mean-pool → cosine); a real checkpoint
+        # supplies real synonymy through the identical code path.
+        groups = [
+            ("the", "a"),
+            ("cat", "feline"),
+            ("sat", "rested"),
+            ("on", "upon"),
+            ("mat", "rug"),
+            ("quantum",),
+            ("flux",),
+            ("generator",),
+        ]
+        vocab = {}
+        rows = []
+        for gi, words in enumerate(groups):
+            vec = np.zeros(len(groups), np.float32)
+            vec[gi] = 1.0
+            for w in words:
+                vocab[w] = len(rows)
+                rows.append(vec)
+        table = np.stack(rows)
+        tokenize = lambda t: [  # noqa: E731
+            vocab[w] for w in t.lower().split() if w in vocab
+        ]
+        return ModelEmbedder(tokenize, table)
+
+    def test_paraphrase_defeats_lexical_mode(self):
+        texts = [self.PARA_A, self.PARA_B, self.UNRELATED]
+        keep = select_keep_mask(embed(texts), "dedup", threshold=0.8)
+        assert keep.tolist() == [True, True, True]  # lexical: all "unique"
+
+    def test_model_embedding_catches_paraphrase(self):
+        texts = [self.PARA_A, self.PARA_B, self.UNRELATED]
+        vectors = self._embedder()(texts)
+        sims = vectors @ vectors.T
+        assert sims[0, 1] > 0.95  # paraphrases land together
+        assert sims[0, 2] < 0.5  # unrelated text stays apart
+        keep = select_keep_mask(vectors, "dedup", threshold=0.8)
+        assert keep.tolist() == [True, False, True]
+
+    async def test_semantic_worker_end_to_end(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("sd")
+            texts = [self.PARA_A, self.PARA_B, self.UNRELATED]
+            for i, t in enumerate(texts):
+                await mgr.publish_job("sd", Job(id=f"s{i}", prompt="{text}", text=t))
+            worker = DedupWorker(
+                "sd",
+                batch_size=3,
+                threshold=0.8,
+                embedder=self._embedder(),
+                config=cfg,
+                concurrency=8,
+            )
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 3)
+            results = await _drain_results(mgr, "sd.results", 3)
+            by_id = {r.id: r.result for r in results}
+            assert by_id["s0"] == self.PARA_A
+            assert by_id["s1"] == DROPPED_MARKER  # caught only semantically
+            assert by_id["s2"] == self.UNRELATED
+
+    def test_from_checkpoint_loads_embedding_table(self, tmp_path):
+        """The --embedding model loading path against a genuine offline
+        HF checkpoint (sharded safetensors + tokenizer.json)."""
+        import pytest
+
+        pytest.importorskip("torch")  # fixture builds with torch
+        pytest.importorskip("transformers")
+        pytest.importorskip("tokenizers")
+        from tests.make_hf_fixture import build
+
+        from llmq_tpu.workers.dedup import ModelEmbedder
+
+        import numpy as np
+
+        path = build(tmp_path / "hf-micro")
+        emb = ModelEmbedder.from_checkpoint(str(path))
+        v = emb(["hello world", "hello world", "completely different"])
+        assert v.shape[0] == 3
+        assert np.allclose(np.linalg.norm(v, axis=1), 1.0, atol=1e-5)
+        assert float(v[0] @ v[1]) > 0.999  # identical text, identical vector
+
+
 class TestDedupWorker:
     async def test_batch_dedup_end_to_end(self, mem_url):
         cfg = Config(broker_url=mem_url)

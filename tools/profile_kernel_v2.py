@@ -78,6 +78,54 @@ a = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scal
 b = paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
 print("max|diff| v2 vs v1 on TPU:", float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))))
 
+# v3 fused-KV-write vs v1/v2 + their separate XLA scatter — the engine's
+# actual per-layer cost for each choice (same framing as the bench A/B:
+# donation so v3's in-place alias isn't penalized by a pool copy).
+import functools
+
+from llmq_tpu.ops.attention import write_kv_pages
+from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas_v3
+
+kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
+vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
+positions = (cl - 1)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("which",), donate_argnums=(0, 1))
+def engine_step(kp, vp, li, *, which):
+    if which == "v3":
+        out, kp, vp = paged_decode_attention_pallas_v3(
+            q, kp, vp, kn, vn, bt, cl, w, li, scale=scale)
+        return out, kp, vp
+    kp, vp = write_kv_pages(kp, vp, kn[:, None], vn[:, None], bt, positions,
+                            layer=li)
+    kern = (paged_decode_attention_pallas_v2 if which == "v2"
+            else paged_decode_attention_pallas)
+    return kern(q, kp, vp, bt, cl, w, li, scale=scale), kp, vp
+
+
+def timeit_engine(which, n=3):
+    global kp, vp
+    for li in range(L):
+        out, kp, vp = engine_step(kp, vp, jnp.int32(li), which=which)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(n):
+        for li in range(L):
+            out, kp, vp = engine_step(kp, vp, jnp.int32(li), which=which)
+        jax.block_until_ready(out)
+    return (time.monotonic() - t0) / (n * L) * 1000
+
+
+for which in ("v1", "v2", "v3"):
+    ms = timeit_engine(which)
+    print(f"{which} incl. KV write: {ms:.3f} ms/layer -> x{L}: "
+          f"{ms*L:.1f} ms/step")
+o3, kp, vp = engine_step(kp, vp, jnp.int32(0), which="v3")
+o1, kp, vp = engine_step(kp, vp, jnp.int32(0), which="v1")
+print("max|diff| v3 vs v1 (incl. write):",
+      float(jnp.max(jnp.abs(o3.astype(jnp.float32) - o1.astype(jnp.float32)))))
+
 # partial-occupancy case: half the slots empty (bench tail / mixed load)
 cl_half = jnp.where(jnp.arange(S) % 2 == 0, CTX, 0)
 ms = timeit_layers(
