@@ -186,6 +186,38 @@ def autotune_decode_kernel(
         return None  # CPU runs take the XLA attention path anyway
     if timeout_s is None:
         timeout_s = float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420))
+    # Per-host cache: fleets restart workers constantly (SLURM arrays,
+    # preemption recovery) and the chip doesn't change under them — only
+    # a successful measured probe is ever cached, never a failure
+    # fallback. LLMQ_AUTOTUNE_CACHE=0 disables; any other value is the
+    # cache path.
+    cache_env = os.environ.get("LLMQ_AUTOTUNE_CACHE", "")
+    cache_path = None
+    if cache_env.lower() not in ("0", "false"):
+        from pathlib import Path
+
+        cache_path = Path(
+            cache_env or "~/.cache/llmq_tpu/autotune.json"
+        ).expanduser()
+    key = (
+        f"decode:h{num_heads}:kv{num_kv_heads}:d{head_dim}:l{num_layers}"
+        f":s{max_seqs}:p{page_size}"
+    )
+    if cache_path is not None and cache_path.exists():
+        try:
+            import json
+
+            entry = json.loads(cache_path.read_text()).get(key)
+            if entry and entry.get("choice") in ("v1", "v2", "v3"):
+                if logger is not None:
+                    logger.info(
+                        "decode kernel: %s (cached A/B, %s)",
+                        entry["choice"],
+                        cache_path,
+                    )
+                return entry["choice"]
+        except Exception:  # noqa: BLE001 — corrupt cache = re-measure
+            pass
     argv = [
         sys.executable,
         "-m",
@@ -204,12 +236,26 @@ def autotune_decode_kernel(
         sys.stderr.write(proc.stderr[-600:])
         choice = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
         if proc.returncode == 0 and choice in ("v1", "v2", "v3"):
+            detail = (proc.stderr.strip().splitlines() or ["no detail"])[-1]
             if logger is not None:
-                logger.info(
-                    "decode kernel: %s (A/B %s)",
-                    choice,
-                    (proc.stderr.strip().splitlines() or ["no detail"])[-1],
-                )
+                logger.info("decode kernel: %s (A/B %s)", choice, detail)
+            # Cache only MEASURED results: run_ab also prints "v1" (rc 0)
+            # on its internal failure fallbacks, but only a real A/B
+            # emits the timing detail line.
+            if cache_path is not None and "decode A/B" in detail:
+                try:
+                    import json
+
+                    cache_path.parent.mkdir(parents=True, exist_ok=True)
+                    data = (
+                        json.loads(cache_path.read_text())
+                        if cache_path.exists()
+                        else {}
+                    )
+                    data[key] = {"choice": choice, "detail": detail}
+                    cache_path.write_text(json.dumps(data, indent=1))
+                except Exception:  # noqa: BLE001 — cache is best-effort
+                    pass
             return choice
         msg = f"kernel A/B rc={proc.returncode}; using v1"
     except subprocess.TimeoutExpired:

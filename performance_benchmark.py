@@ -96,10 +96,21 @@ def _fallback_tokens(text: str) -> int:
 
 
 def device_inventory() -> Dict[str, object]:
-    """TPU counterpart of the reference's nvidia-smi inventory (114-154)."""
+    """TPU counterpart of the reference's nvidia-smi inventory (114-154).
+
+    When the harness is pinned to CPU (JAX_PLATFORMS=cpu — dummy-worker
+    runs, CI), the env var alone does NOT stop a hanging TPU-tunnel init:
+    this image's sitecustomize pins the platform list at the CONFIG
+    level, so ``jax.devices()`` here wedged the whole harness for minutes
+    after every point. Honor the pin before touching the backend.
+    """
     try:
         import jax
 
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            from llmq_tpu.utils.platform import force_cpu_platform
+
+            force_cpu_platform()
         devs = jax.devices()
         return {
             "platform": devs[0].platform,
@@ -368,6 +379,9 @@ def main(argv=None) -> int:
     report = asyncio.run(PerformanceBenchmark(args).run())
     out = json.dumps(report, indent=2)
     if args.output:
+        from pathlib import Path as _Path
+
+        _Path(args.output).parent.mkdir(parents=True, exist_ok=True)
         with open(args.output, "w") as f:
             f.write(out + "\n")
         print(f"results written to {args.output}", file=sys.stderr)

@@ -1,0 +1,109 @@
+"""Autotune driver logic (``engine/kernel_autotune.py``): gating, the
+subprocess contract, and the per-host cache. The measured A/B itself is
+hardware-only; here the child is mocked."""
+
+import json
+import subprocess
+import types
+
+import pytest
+
+from llmq_tpu.engine import kernel_autotune as ka
+
+SHAPES = dict(num_heads=8, num_kv_heads=2, head_dim=64, num_layers=4)
+
+
+def _fake_run(choice="v2", rc=0, detail="kernel-autotune: decode A/B v1=1ms v2=0.5ms v3=0.6ms per layer -> v2"):
+    def run(argv, timeout, capture_output, text):
+        return types.SimpleNamespace(
+            returncode=rc, stdout=choice + "\n", stderr=detail + "\n"
+        )
+
+    return run
+
+
+def test_respects_explicit_env(monkeypatch):
+    monkeypatch.setenv("LLMQ_DECODE_KERNEL", "v3")
+    assert ka.autotune_decode_kernel(**SHAPES) is None
+
+
+def test_skips_on_cpu_pin(monkeypatch):
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert ka.autotune_decode_kernel(**SHAPES) is None
+
+
+def test_disabled_by_flag(monkeypatch):
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("LLMQ_KERNEL_AUTOTUNE", "0")
+    assert ka.autotune_decode_kernel(**SHAPES) is None
+
+
+def test_probe_choice_and_cache_roundtrip(monkeypatch, tmp_path):
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # pretend: probe applies
+    monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
+    cache = tmp_path / "autotune.json"
+    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
+
+    calls = []
+    fake = _fake_run("v2")
+
+    def counting(*a, **k):
+        calls.append(1)
+        return fake(*a, **k)
+
+    monkeypatch.setattr(subprocess, "run", counting)
+    assert ka.autotune_decode_kernel(**SHAPES) == "v2"
+    assert len(calls) == 1
+    data = json.loads(cache.read_text())
+    (key,) = data.keys()
+    assert key.startswith("decode:h8:kv2:d64:l4")
+    assert data[key]["choice"] == "v2"
+
+    # Second call: served from cache, no subprocess.
+    assert ka.autotune_decode_kernel(**SHAPES) == "v2"
+    assert len(calls) == 1
+
+    # Different shapes: cache miss, probe again.
+    assert ka.autotune_decode_kernel(
+        num_heads=16, num_kv_heads=4, head_dim=64, num_layers=8
+    ) == "v2"
+    assert len(calls) == 2
+
+
+def test_failure_fallback_not_cached(monkeypatch, tmp_path):
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
+    cache = tmp_path / "autotune.json"
+    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
+
+    # run_ab's internal failure path prints v1 with rc 0 but NO timing
+    # detail line — must not be cached as a measured result.
+    monkeypatch.setattr(
+        subprocess,
+        "run",
+        _fake_run("v1", detail="kernel-autotune: A/B failed (boom); using v1"),
+    )
+    assert ka.autotune_decode_kernel(**SHAPES) == "v1"
+    assert not cache.exists()
+
+    # Hard failure (rc != 0) falls back to v1 and caches nothing.
+    monkeypatch.setattr(subprocess, "run", _fake_run("junk", rc=3))
+    assert ka.autotune_decode_kernel(**SHAPES) == "v1"
+    assert not cache.exists()
+
+
+def test_timeout_falls_back(monkeypatch):
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
+    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", "0")
+
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert ka.autotune_decode_kernel(**SHAPES) == "v1"

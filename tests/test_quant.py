@@ -211,6 +211,36 @@ class TestQuantLoad:
         assert not qm.is_quantized(quant["layers"]["ln1"])
         assert quant["layers"]["q_bias"].dtype == jnp.float32
 
+    def test_streaming_quantized_load_sharded(self, hf_dir):
+        """Quantize-on-load onto a tp=2 mesh: int8 buffers land sharded
+        via the weight's own spec (the ``<name>.q`` walk), scales on the
+        surviving axes, and the loaded tree matches the unsharded one."""
+        from llmq_tpu.engine.weights import load_checkpoint
+        from llmq_tpu.parallel import make_mesh
+
+        config = ModelConfig.from_pretrained(hf_dir)
+        mesh = make_mesh(tensor_parallel=2)
+        sharded = load_checkpoint(
+            hf_dir, config, dtype=jnp.float32, mesh=mesh, quantize=True
+        )
+        plain = load_checkpoint(
+            hf_dir, config, dtype=jnp.float32, quantize=True
+        )
+        for key in ("q_proj", "down_proj"):
+            node = sharded["layers"][key]
+            assert qm.is_quantized(node)
+            np.testing.assert_array_equal(
+                np.asarray(node["q"]), np.asarray(plain["layers"][key]["q"])
+            )
+            np.testing.assert_allclose(
+                np.asarray(node["scale"]),
+                np.asarray(plain["layers"][key]["scale"]),
+                rtol=1e-6,
+            )
+        np.testing.assert_array_equal(
+            np.asarray(sharded["embed"]["q"]), np.asarray(plain["embed"]["q"])
+        )
+
     def test_quantized_checkpoint_runs_engine(self, hf_dir):
         from llmq_tpu.engine.tokenizer import HFTokenizer
         from llmq_tpu.engine.weights import load_checkpoint
