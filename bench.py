@@ -347,7 +347,16 @@ def main() -> None:
     gen_len = int(os.environ.get("LLMQ_BENCH_GEN", 16 if on_cpu else 128))
     # 192 slots is the measured sweet spot for a ~3B model on one 16 GB
     # chip (256 OOMs next to the weights; 128 leaves throughput behind).
-    max_seqs = int(os.environ.get("LLMQ_BENCH_SEQS", 4 if on_cpu else 192))
+    # Unset → try 224 first (weight-stream amortization suggests ~+5%,
+    # untested only because the chip went away) and fall back to 192 if
+    # the build/warmup exhausts HBM.
+    seqs_env = os.environ.get("LLMQ_BENCH_SEQS")
+    if seqs_env:
+        seqs_candidates = [int(seqs_env)]
+    elif on_cpu:
+        seqs_candidates = [4]
+    else:
+        seqs_candidates = [224, 192]
 
     config = get_preset(preset)
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
@@ -363,33 +372,12 @@ def main() -> None:
     # quantize-at-init: the bf16 tree alone would not fit HBM at 9B.
     params = init_params(config, jax.random.key(0), dtype=dtype, quantize=int8)
     mesh = make_mesh(devices=devices)  # all local devices, tp
-    core = EngineCore(
-        config,
-        params,
-        ByteTokenizer(),
-        mesh=mesh,
-        engine_config=EngineConfig(
-            max_num_seqs=max_seqs,
-            max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
-            kv_dtype=dtype,
-            num_pages=256 if on_cpu else None,
-            # 128-token pages: the decode kernel DMAs one page per grid
-            # step, and 16 KB transfers are latency-bound on the order of
-            # 6x the bandwidth floor (measured round 2); 128-token pages
-            # make the transfers 64 KB and quarter the grid.
-            page_size=page_size,
-            # 8-prompt prefill chunks: 2048-token batches amortize the
-            # weight stream ~24% better than the default 4 (measured).
-            max_prefill_batch=int(
-                os.environ.get("LLMQ_BENCH_PREFILL_BATCH", 2 if on_cpu else 8)
-            ),
-        ),
-    )
 
     rng = np.random.default_rng(0)
     sp = lambda: SamplingParams(  # noqa: E731
         temperature=0.0, max_tokens=gen_len, ignore_eos=True
     )
+    core = None
 
     def run(n, tag):
         for i in range(n):
@@ -403,12 +391,58 @@ def main() -> None:
         assert done == n, f"{done}/{n} finished"
         return elapsed
 
-    # Compile every executable the timed run will hit: the B=1 prefill
-    # variant (singleton admissions as slots trickle free), the padded
-    # max_prefill_batch variant, and the decode step. A mid-run jit trace
-    # would otherwise eat tens of seconds of the measured window.
-    run(1, "warmup-single")
-    run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+    def is_oom(exc) -> bool:
+        s = str(exc)
+        return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+
+    # Slot-count ladder: build + warm up at each candidate, dropping to
+    # the next on HBM exhaustion (the warmups force every allocation and
+    # compile the timed run will hit — the B=1 prefill variant, the
+    # padded max_prefill_batch variant, and the decode step; a mid-run
+    # jit trace would otherwise eat tens of seconds of the window).
+    for max_seqs in seqs_candidates:
+        try:
+            core = EngineCore(
+                config,
+                params,
+                ByteTokenizer(),
+                mesh=mesh,
+                engine_config=EngineConfig(
+                    max_num_seqs=max_seqs,
+                    max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
+                    kv_dtype=dtype,
+                    num_pages=256 if on_cpu else None,
+                    # 128-token pages: the decode kernel DMAs one page
+                    # per grid step, and 16 KB transfers are
+                    # latency-bound ~6x off the bandwidth floor (measured
+                    # round 2); 128-token pages make them 64 KB and
+                    # quarter the grid.
+                    page_size=page_size,
+                    # 8-prompt prefill chunks: 2048-token batches
+                    # amortize the weight stream ~24% better than the
+                    # default 4 (measured).
+                    max_prefill_batch=int(
+                        os.environ.get(
+                            "LLMQ_BENCH_PREFILL_BATCH", 2 if on_cpu else 8
+                        )
+                    ),
+                ),
+            )
+            run(1, "warmup-single")
+            run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+            break
+        except Exception as exc:  # noqa: BLE001 — retry only on OOM
+            if max_seqs == seqs_candidates[-1] or not is_oom(exc):
+                raise
+            print(
+                f"bench: {max_seqs} slots exhausted HBM; retrying at "
+                f"{seqs_candidates[seqs_candidates.index(max_seqs) + 1]}",
+                file=sys.stderr,
+            )
+            core = None
+            import gc
+
+            gc.collect()
     gen_before = core.total_generated_tokens
     elapsed = run(n_requests, "bench")
     out_tokens = core.total_generated_tokens - gen_before
@@ -428,6 +462,9 @@ def main() -> None:
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s_chip / baseline, 4),
         "mfu": round(mfu, 4),
+        "dtype": "int8" if int8 else str(jnp.dtype(dtype)),
+        "max_seqs": max_seqs,
+        "decode_kernel": ab_choice or os.environ.get("LLMQ_DECODE_KERNEL") or "v1",
     }
     if backend_note:
         payload["note"] = backend_note

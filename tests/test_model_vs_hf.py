@@ -124,6 +124,45 @@ def test_prefill_logits_match_hf(family, tmp_path):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("family", ["llama", "qwen2"])
+def test_prefill_logits_int8_close_to_hf(family, tmp_path):
+    """Int8 weight-only quantization (--dtype int8) against the HF fp32
+    oracle on real-architecture weights: logits stay well-correlated and
+    the greedy argmax at the final position is preserved."""
+    from llmq_tpu.models import quant as qm
+
+    path, hf_model = _hf_tiny(family, tmp_path)
+    config, model, params = _our_model(path)
+    qparams = qm.quantize_params(params)
+
+    rng = np.random.default_rng(1)
+    T = 21
+    tokens = rng.integers(0, config.vocab_size, size=(1, T))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()[0, T - 1]
+
+    k_pages, v_pages = make_kv_pages(
+        config, 1 + PAGES_PER_SEQ, PAGE_SIZE, dtype=jnp.float32
+    )
+    padded = np.zeros((1, 32), dtype=np.int32)
+    padded[0, :T] = tokens
+    logits, _, _ = model.prefill(
+        qparams,
+        jnp.asarray(padded),
+        jnp.asarray([T], jnp.int32),
+        k_pages,
+        v_pages,
+        _sequential_block_table(1),
+    )
+    ours = np.asarray(logits[0])
+    cos = float(
+        (ours * hf_logits).sum()
+        / (np.linalg.norm(ours) * np.linalg.norm(hf_logits) + 1e-9)
+    )
+    assert cos > 0.999, f"int8 logit cosine vs HF fp32: {cos:.5f}"
+    assert int(ours.argmax()) == int(hf_logits.argmax())
+
+
 @pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2", "qwen2_moe"])
 def test_decode_matches_hf_stepwise(family, tmp_path):
     """Prefill a prompt, then greedy-decode 6 tokens; every step's logits
