@@ -4,6 +4,7 @@ Qwen2-based, plus Llama-3.2 and Gemma-2 from BASELINE.json configs).
 """
 
 from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.quant import quantize_params
 from llmq_tpu.models.transformer import Transformer, init_params
 
-__all__ = ["ModelConfig", "Transformer", "init_params"]
+__all__ = ["ModelConfig", "Transformer", "init_params", "quantize_params"]

@@ -71,3 +71,62 @@ def test_errors_empty(mem_url, monkeypatch):
     result = CliRunner().invoke(cli, ["errors", "someq"])
     assert result.exit_code == 0
     assert "No dead-lettered" in result.output
+
+
+async def test_submit_stream_consumes_results(mem_url, monkeypatch, tmp_path, capsys):
+    """`submit --stream`: results are consumed while submitting and the
+    progress accounting (submitted/received) closes the loop."""
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli.submit import JobSubmitter
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.core.models import Result
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    jobs_file = tmp_path / "jobs.jsonl"
+    jobs_file.write_text(
+        "\n".join(json.dumps({"id": f"r{i}", "prompt": "p"}) for i in range(4))
+    )
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("sq")
+        # Results land before/while the submitter streams: its consumer
+        # registers first, so these are delivered to it.
+        for i in range(4):
+            await mgr.publish_result(
+                "sq",
+                Result(
+                    id=f"r{i}", prompt="p", result=f"out{i}",
+                    worker_id="w", duration_ms=1.0,
+                ),
+            )
+        sub = JobSubmitter(
+            "sq", str(jobs_file), stream=True, broker=mgr,
+            stream_idle_timeout=2.0,
+        )
+        submitted = await sub.run()
+    assert submitted == 4
+    assert sub.received == 4
+    out = capsys.readouterr().out
+    lines = [json.loads(line) for line in out.strip().splitlines()]
+    assert {r["id"] for r in lines} == {f"r{i}" for i in range(4)}
+
+
+def test_submit_progress_tty_rendering(monkeypatch):
+    """_SubmitProgress with a (faked) TTY drives the Rich display without
+    error and tracks rates; non-TTY mode prints the plain counter."""
+    import sys
+
+    from llmq_tpu.cli.submit import _SubmitProgress
+
+    monkeypatch.setattr(sys.stderr, "isatty", lambda: True, raising=False)
+    with _SubmitProgress(stream=True, total=100) as p:
+        assert p._rich is not None
+        p.submitted(50)
+        p.completed(10)
+        p.submit_done(100)
+        p.completed(100)
+
+    monkeypatch.setattr(sys.stderr, "isatty", lambda: False, raising=False)
+    with _SubmitProgress(stream=False, total=None) as p:
+        assert p._rich is None
+        p.submitted(7)  # plain \r counter path

@@ -13,7 +13,10 @@ from llmq_tpu.engine import kernel_autotune as ka
 SHAPES = dict(num_heads=8, num_kv_heads=2, head_dim=64, num_layers=4)
 
 
-def _fake_run(choice="v2", rc=0, detail="kernel-autotune: decode A/B v1=1ms v2=0.5ms v3=0.6ms per layer -> v2"):
+_DETAIL = "kernel-autotune: decode A/B v1=1ms v2=0.5ms v3=0.6ms per layer -> v2"
+
+
+def _fake_run(choice="v2", rc=0, detail=_DETAIL):
     def run(argv, timeout, capture_output, text):
         return types.SimpleNamespace(
             returncode=rc, stdout=choice + "\n", stderr=detail + "\n"
