@@ -60,6 +60,11 @@ def _hf_tiny(family: str, tmp_path):
             final_logit_softcapping=30.0,
         )
         model = transformers.Gemma2ForCausalLM(cfg)
+    elif family == "qwen3":
+        cfg = transformers.Qwen3Config(
+            **common, rope_theta=10000.0, head_dim=16
+        )
+        model = transformers.Qwen3ForCausalLM(cfg)
     elif family == "qwen2_moe":
         cfg = transformers.Qwen2MoeConfig(
             **common,
@@ -73,6 +78,19 @@ def _hf_tiny(family: str, tmp_path):
             mlp_only_layers=[],
         )
         model = transformers.Qwen2MoeForCausalLM(cfg)
+    elif family == "qwen3_moe":
+        cfg = transformers.Qwen3MoeConfig(
+            **common,
+            rope_theta=10000.0,
+            head_dim=16,
+            num_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            norm_topk_prob=True,
+            decoder_sparse_step=1,
+            mlp_only_layers=[],
+        )
+        model = transformers.Qwen3MoeForCausalLM(cfg)
     else:
         raise ValueError(family)
     model = model.eval().to(torch.float32)
@@ -94,7 +112,9 @@ def _sequential_block_table(num_seqs):
     ).reshape(num_seqs, PAGES_PER_SEQ)
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2", "qwen2_moe"])
+@pytest.mark.parametrize(
+    "family", ["llama", "qwen2", "qwen3", "gemma2", "qwen2_moe", "qwen3_moe"]
+)
 def test_prefill_logits_match_hf(family, tmp_path):
     path, hf_model = _hf_tiny(family, tmp_path)
     config, model, params = _our_model(path)
@@ -163,7 +183,9 @@ def test_prefill_logits_int8_close_to_hf(family, tmp_path):
     assert int(ours.argmax()) == int(hf_logits.argmax())
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "gemma2", "qwen2_moe"])
+@pytest.mark.parametrize(
+    "family", ["llama", "qwen2", "qwen3", "gemma2", "qwen2_moe"]
+)
 def test_decode_matches_hf_stepwise(family, tmp_path):
     """Prefill a prompt, then greedy-decode 6 tokens; every step's logits
     must match HF's full-context forward at that position."""
