@@ -1,0 +1,90 @@
+"""Does XLA fuse the int8→bf16 weight convert into the MXU dot?
+
+The whole int8 decode-throughput claim (models/quant.py) rests on the
+weight operand staying int8 in HBM: `x @ q.astype(bf16) * scale` must
+read q AS int8 and convert on-chip. If XLA instead materializes a bf16
+copy, traffic is 2.5x the int8 bytes and int8 decode is SLOWER than
+bf16. This micro-bench answers it in one run at decode shapes:
+
+    int8 time ≈ 0.5-0.6x bf16 time  -> fused (ship int8 for decode)
+    int8 time ≥ 1x bf16 time        -> not fused (needs a Pallas
+                                       dequant-in-kernel matmul before
+                                       int8 helps decode; it still
+                                       halves FOOTPRINT either way)
+
+Shapes mirror the 3B bench config's per-layer MLP matmul (the dominant
+weight stream): x [192, 2048] @ W [2048, 11008], plus a layer-stacked
+scan variant matching how the engine actually reads weights.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # The image's sitecustomize pins the platform list at the CONFIG
+    # level; without this, any backend query hangs on the TPU tunnel.
+    from llmq_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform()
+
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() == "cpu":  # smoke-testable off-TPU
+    S, H, I, L = 32, 256, 512, 2
+else:
+    S, H, I, L = 192, 2048, 11008, 8
+S = int(os.environ.get("PROF_S", S))
+H = int(os.environ.get("PROF_H", H))
+I = int(os.environ.get("PROF_I", I))  # noqa: E741
+L = int(os.environ.get("PROF_L", L))
+
+x = jax.random.normal(jax.random.key(0), (S, H), jnp.bfloat16)
+w_bf16 = jax.random.normal(jax.random.key(1), (L, H, I), jnp.bfloat16)
+w_q = jax.random.randint(jax.random.key(2), (L, H, I), -127, 127, jnp.int8)
+scale = jax.random.uniform(jax.random.key(3), (L, I), jnp.bfloat16)
+
+
+@jax.jit
+def scan_bf16(x, w):
+    def body(c, wl):
+        return c, x @ wl
+
+    _, ys = jax.lax.scan(body, 0, w)
+    return ys
+
+
+@jax.jit
+def scan_int8(x, wq, sc):
+    def body(c, xs):
+        wl, sl = xs
+        return c, (x @ wl.astype(x.dtype)) * sl
+
+    _, ys = jax.lax.scan(body, 0, (wq, sc))
+    return ys
+
+
+def timeit(f, *args, n=20):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n / L * 1e3  # ms per layer
+
+
+ms_bf16 = timeit(scan_bf16, x, w_bf16)
+ms_int8 = timeit(scan_int8, x, w_q, scale)
+bytes_bf16 = H * I * 2
+bytes_int8 = H * I * 1
+print(f"bf16: {ms_bf16:.3f} ms/layer ({bytes_bf16/ms_bf16*1e3/2**30:.0f} GiB/s eff)")
+print(f"int8: {ms_int8:.3f} ms/layer ({bytes_int8/ms_int8*1e3/2**30:.0f} GiB/s int8-eff)")
+ratio = ms_int8 / ms_bf16
+verdict = "FUSED (int8 wins)" if ratio < 0.8 else (
+    "NOT fused — bf16 copy materializes; needs Pallas dequant matmul"
+    if ratio > 0.95 else "marginal"
+)
+print(f"int8/bf16 = {ratio:.2f} -> {verdict}")
