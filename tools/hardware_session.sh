@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# The round-5 hardware perf session, runnable in one command the moment
+# the chip answers (it was unreachable the whole round — same tunnel
+# hang as the end of round 4). Runs the measurement ladder from
+# PERF_NOTES, saving everything under PERF_RESULTS/:
+#
+#   1. kernel micro-bench: v1 vs v2 vs v3 incl. the XLA KV-write cost
+#   2. int8 matmul fusion check (decides whether int8 helps DECODE)
+#   3. headline bench, bf16 (kernel A/B + 224->192 slot ladder built in)
+#   4. int8 3B bench (weight-bandwidth-bound decode should gain ~directly)
+#   5. int8 9B bench — the north-star architecture on ONE 16 GB chip
+#   6. param auto-layout A/B (flip the default if it holds)
+#
+# Each step has its own timeout so one hang doesn't eat the session.
+set -u
+cd "$(dirname "$0")/.."
+OUT=PERF_RESULTS
+mkdir -p "$OUT"
+run() {  # run <timeout-s> <name> <cmd...>
+    local t="$1" name="$2"; shift 2
+    echo "=== $name ($(date +%H:%M:%S))"
+    timeout "$t" "$@" > "$OUT/$name.log" 2>&1
+    echo "    rc=$? -> $OUT/$name.log"
+    tail -3 "$OUT/$name.log" | sed 's/^/    /'
+}
+
+run 60  probe         python -c "import jax; d=jax.devices(); print(len(d), d[0].platform, d[0].device_kind)"
+grep -q tpu "$OUT/probe.log" || { echo "chip unreachable; aborting"; exit 1; }
+
+run 900 kernel_v123   python tools/profile_kernel_v2.py
+run 300 int8_fusion   python tools/profile_int8_matmul.py
+# NB: `VAR=x run ...` would leak past the function call in bash — use
+# `env` so each override dies with its step.
+run 1800 bench_bf16   python bench.py
+run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
+run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
+    LLMQ_BENCH_PRESET=tower-plus-9b python bench.py
+run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
+
+echo "=== summary"
+grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null
+echo "Next: compare bench_autolayout vs bench_bf16; if auto-layout holds,"
+echo "default LLMQ_PARAM_AUTO_LAYOUT=1 on TPU in engine.py; flip the"
+echo "LLMQ_DECODE_KERNEL fallback in ops/dispatch.py to kernel_v123's"
+echo "winner; record the best line in PERF_NOTES."
