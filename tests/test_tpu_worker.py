@@ -106,3 +106,40 @@ async def test_tpu_worker_sampling_options_object(mem_url):
 def test_worker_id_encodes_topology():
     worker = make_worker("memory://wid-test", tensor_parallel=2)
     assert "-tp2-dp1" in worker.worker_id
+
+
+def test_worker_exports_autotuned_kernel(monkeypatch):
+    """_autotune_kernel resolves the model architecture host-side and
+    exports the measured winner via LLMQ_DECODE_KERNEL; a None verdict
+    (explicit env / CPU pin / disabled) leaves the env alone."""
+    import os
+
+    import llmq_tpu.engine.kernel_autotune as ka
+
+    worker = make_worker("memory://at-test", max_num_seqs=8)
+    seen = {}
+
+    def fake_autotune(**kw):
+        seen.update(kw)
+        return "v3"
+
+    monkeypatch.setattr(ka, "autotune_decode_kernel", fake_autotune)
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    worker._autotune_kernel()
+    assert os.environ.get("LLMQ_DECODE_KERNEL") == "v3"
+    # Shapes came from the preset's host-side config, engine knobs from
+    # the worker's.
+    assert seen["num_layers"] >= 1 and seen["num_heads"] >= 1
+    assert seen["max_seqs"] == 8
+    assert seen["page_size"] == 8  # explicit --page-size wins
+    # Without an explicit page size the probe uses the worker's TPU
+    # default of 128-token pages.
+    bare = make_worker("memory://at-test2", page_size=None)
+    monkeypatch.setattr(ka, "autotune_decode_kernel", fake_autotune)
+    bare._autotune_kernel()
+    assert seen["page_size"] == 128
+
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    monkeypatch.setattr(ka, "autotune_decode_kernel", lambda **kw: None)
+    worker._autotune_kernel()
+    assert "LLMQ_DECODE_KERNEL" not in os.environ
