@@ -134,3 +134,38 @@ def test_soak_tight_pool_chunked_cached(seed):
     # completion budgets respected everywhere
     for (rid, _, p) in reqs:
         assert outs[rid].completion_tokens <= p.max_tokens
+
+
+def test_soak_int8_tight_pool_matches_int8_golden():
+    """Int8 weight-only quantization composes losslessly with the whole
+    feature stack: a tight-pool chunked+cached+preempting int8 engine
+    must match a roomy bucketed int8 engine bit-for-bit on greedy rows
+    (same quantized params — the machinery, not the quantization, is
+    under test)."""
+    from llmq_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(PARAMS)
+    rng = np.random.default_rng(7)
+    reqs = _requests(rng, 24)
+
+    def qcore(num_pages, **over):
+        eng = dict(
+            max_num_seqs=6, max_model_len=64, page_size=8,
+            num_pages=num_pages, kv_dtype=jnp.float32,
+            min_prefill_bucket=16, max_prefill_batch=2,
+        )
+        eng.update(over)
+        return EngineCore(
+            CFG, qparams, ByteTokenizer(), mesh=make_mesh(tensor_parallel=1),
+            engine_config=EngineConfig(**eng),
+        )
+
+    tight = qcore(20, prefill_chunk_size=8, enable_prefix_caching=True)
+    outs = _drive(tight, reqs, np.random.default_rng(107))
+    tight.scheduler.check_invariants()
+    roomy = qcore(120)
+    golden = _drive(roomy, reqs, np.random.default_rng(107))
+    for rid, _, p in reqs:
+        if p.temperature == 0.0:
+            assert outs[rid].token_ids == golden[rid].token_ids, rid
+        assert outs[rid].completion_tokens <= p.max_tokens
