@@ -84,10 +84,34 @@ def quantize_array_donated(w, *, axis: int, scale_dtype=jnp.float32) -> Params:
     return quantize_array(w, axis=axis, scale_dtype=scale_dtype)
 
 
+def _pallas_int8_enabled() -> bool:
+    """``LLMQ_INT8_MATMUL=pallas``: route int8 matmuls through the
+    dequantize-in-VMEM Pallas kernel (``ops/pallas_matmul.py``) instead
+    of relying on XLA fusing the convert into the dot. tp==1 scope — see
+    the kernel module docstring."""
+    import os
+
+    return os.environ.get("LLMQ_INT8_MATMUL", "").lower() == "pallas"
+
+
 def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """``x @ w`` for a plain array or an int8-quantized weight."""
     if is_quantized(w):
-        return (x @ w["q"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+        if _pallas_int8_enabled() and w["q"].ndim == 2:
+            from llmq_tpu.ops.pallas_matmul import int8_matmul_pallas
+
+            lead = x.shape[:-1]
+            out = int8_matmul_pallas(
+                x.reshape(-1, x.shape[-1]),
+                w["q"],
+                w["scale"],
+                interpret=jax.default_backend() != "tpu",
+            )
+            return out.reshape(*lead, out.shape[-1])
+        s = w["scale"].astype(x.dtype)
+        if w["q"].ndim > 2:  # stacked weights: scale is [..., N], out [..., M, N]
+            s = s[..., None, :]
+        return (x @ w["q"].astype(x.dtype)) * s
     return x @ w
 
 

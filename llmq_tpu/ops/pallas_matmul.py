@@ -1,0 +1,103 @@
+"""Pallas TPU int8 weight-only matmul: dequantize in VMEM, never in HBM.
+
+The int8 decode win (``models/quant.py``) assumes XLA fuses the
+``q.astype(bf16)`` convert into the dot operand read so the HBM side
+stays int8. ``tools/profile_int8_matmul.py`` measures whether it does on
+the deployment chip; THIS kernel is the guaranteed path if it doesn't:
+weight tiles are DMA'd to VMEM as int8 (half the bytes of bf16) and
+converted + scaled on-chip, so weight HBM traffic is halved by
+construction.
+
+Enabled with ``LLMQ_INT8_MATMUL=pallas`` (checked at trace time by
+``models/quant.py::matmul``). Scope: tp == 1 meshes — the dense matmuls
+are partitioned by GSPMD, which cannot split an opaque ``pallas_call``;
+single-chip deployments (e.g. the int8 9B-on-16GB config) are exactly
+where the weight stream dominates. Off-TPU the kernel runs in interpret
+mode for the numerics tests.
+
+Tiling: grid ``(M/bm, N/bn, K/bk)`` with a float32 VMEM accumulator per
+(m, n) tile; K is innermost so the accumulator lives across the
+contraction. The per-output-channel scale is applied once on the final
+K step, then cast to the activation dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...].astype(jnp.float32)  # [bm, bk]
+    w = q_ref[...].astype(jnp.float32)  # [bk, bn] — int8 converts in VMEM
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        scale = s_ref[...].astype(jnp.float32)  # [1, bn]
+        o_ref[...] = (acc_ref[...] * scale).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_m", "block_n", "block_k", "interpret"),
+)
+def int8_matmul_pallas(
+    x: jnp.ndarray,  # [M, K] bf16/f32 activations
+    q: jnp.ndarray,  # [K, N] int8 weight
+    scale: jnp.ndarray,  # [N] per-output-channel scale
+    *,
+    block_m: int = 256,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``(x @ q) * scale`` with q read from HBM as int8. Returns x.dtype.
+
+    Ragged edges are zero-padded to the block grid (padding contributes
+    zeros to the contraction, and padded output rows/cols are sliced off).
+    """
+    M, K = x.shape
+    K2, N = q.shape
+    assert K == K2 and scale.shape == (N,), (x.shape, q.shape, scale.shape)
+    bm = min(block_m, M)
+    bn = min(block_n, N)
+    bk = min(block_k, K)
+    mp, np_, kp = -(-M // bm) * bm, -(-N // bn) * bn, -(-K // bk) * bk
+    if (mp, kp) != (M, K):
+        x = jnp.pad(x, ((0, mp - M), (0, kp - K)))
+    if (kp, np_) != (K, N):
+        q = jnp.pad(q, ((0, kp - K), (0, np_ - N)))
+    if np_ != N:
+        scale = jnp.pad(scale, (0, np_ - N))
+    nk = kp // bk
+
+    out = pl.pallas_call(
+        functools.partial(_int8_matmul_kernel, nk=nk),
+        grid=(mp // bm, np_ // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, np_))
+    return out[:M, :N]
