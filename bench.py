@@ -287,7 +287,7 @@ def _kernel_ab_probe_main() -> None:
         limit, devices[0].platform
     )
     config = get_preset(preset)
-    choice = run_ab(
+    choice, _measured = run_ab(
         num_heads=config.num_heads,
         num_kv_heads=config.num_kv_heads,
         head_dim=config.head_dim_,

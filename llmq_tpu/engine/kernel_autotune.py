@@ -36,7 +36,7 @@ def run_ab(
     num_layers: int,
     max_seqs: int,
     page_size: int,
-) -> str:
+) -> tuple:
     """In-process kernel A/B (the child-process body).
 
     The pool must NOT fit in VMEM (~128 MB) or every kernel looks
@@ -59,7 +59,7 @@ def run_ab(
         )
 
         if jax.devices()[0].platform != "tpu":
-            return "v1"  # the Pallas candidates only differ on real TPUs
+            return "v1", False  # Pallas candidates only differ on real TPUs
 
         H, NKV, D = num_heads, num_kv_heads, head_dim
         L = num_layers
@@ -69,7 +69,7 @@ def run_ab(
         per_page = PAGE * NKV * D * 2  # bf16
         P = max(PPS * 4, min(300 * 2**20 // max(1, L * per_page), 961))
         if P < PPS + 1:
-            return "v1"
+            return "v1", False
         ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
         q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
         kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
@@ -82,7 +82,7 @@ def run_ab(
         # (one winner) and the fused kernel (own row each) legitimately
         # disagree, spuriously tripping the numerics guard.
         if P - 1 < S * PPS:
-            return "v1"  # pool too small for distinct pages per seq
+            return "v1", False  # pool too small for distinct pages per seq
         perm = rng.permutation(np.arange(1, P))[: S * PPS]
         bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
         cl = jnp.full((S,), ctx, jnp.int32)
@@ -152,10 +152,10 @@ def run_ab(
             f"-> {choice}",
             file=sys.stderr,
         )
-        return choice
+        return choice, True
     except Exception as exc:  # noqa: BLE001 — never endanger the caller
         print(f"kernel-autotune: A/B failed ({exc!r}); using v1", file=sys.stderr)
-        return "v1"
+        return "v1", False
 
 
 def autotune_decode_kernel(
@@ -186,38 +186,6 @@ def autotune_decode_kernel(
         return None  # CPU runs take the XLA attention path anyway
     if timeout_s is None:
         timeout_s = float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420))
-    # Per-host cache: fleets restart workers constantly (SLURM arrays,
-    # preemption recovery) and the chip doesn't change under them — only
-    # a successful measured probe is ever cached, never a failure
-    # fallback. LLMQ_AUTOTUNE_CACHE=0 disables; any other value is the
-    # cache path.
-    cache_env = os.environ.get("LLMQ_AUTOTUNE_CACHE", "")
-    cache_path = None
-    if cache_env.lower() not in ("0", "false"):
-        from pathlib import Path
-
-        cache_path = Path(
-            cache_env or "~/.cache/llmq_tpu/autotune.json"
-        ).expanduser()
-    key = (
-        f"decode:h{num_heads}:kv{num_kv_heads}:d{head_dim}:l{num_layers}"
-        f":s{max_seqs}:p{page_size}"
-    )
-    if cache_path is not None and cache_path.exists():
-        try:
-            import json
-
-            entry = json.loads(cache_path.read_text()).get(key)
-            if entry and entry.get("choice") in ("v1", "v2", "v3"):
-                if logger is not None:
-                    logger.info(
-                        "decode kernel: %s (cached A/B, %s)",
-                        entry["choice"],
-                        cache_path,
-                    )
-                return entry["choice"]
-        except Exception:  # noqa: BLE001 — corrupt cache = re-measure
-            pass
     argv = [
         sys.executable,
         "-m",
@@ -239,23 +207,6 @@ def autotune_decode_kernel(
             detail = (proc.stderr.strip().splitlines() or ["no detail"])[-1]
             if logger is not None:
                 logger.info("decode kernel: %s (A/B %s)", choice, detail)
-            # Cache only MEASURED results: run_ab also prints "v1" (rc 0)
-            # on its internal failure fallbacks, but only a real A/B
-            # emits the timing detail line.
-            if cache_path is not None and "decode A/B" in detail:
-                try:
-                    import json
-
-                    cache_path.parent.mkdir(parents=True, exist_ok=True)
-                    data = (
-                        json.loads(cache_path.read_text())
-                        if cache_path.exists()
-                        else {}
-                    )
-                    data[key] = {"choice": choice, "detail": detail}
-                    cache_path.write_text(json.dumps(data, indent=1))
-                except Exception:  # noqa: BLE001 — cache is best-effort
-                    pass
             return choice
         msg = f"kernel A/B rc={proc.returncode}; using v1"
     except subprocess.TimeoutExpired:
@@ -269,6 +220,67 @@ def autotune_decode_kernel(
     return "v1"
 
 
+# --- per-host result cache (lives in the CHILD: only it knows which
+# chip + toolchain it measured on) ------------------------------------------
+
+
+def cache_path_from_env():
+    """None when disabled (``LLMQ_AUTOTUNE_CACHE=0``); default under
+    ~/.cache. Fleets restart workers constantly (SLURM arrays, preemption
+    recovery) and the chip doesn't change under them — but ~/.cache is
+    often NFS-shared ACROSS a fleet mixing chip generations, so entries
+    carry the measuring chip + jax version in the key (see
+    :func:`resolve_choice`) and never match foreign hardware."""
+    from pathlib import Path
+
+    env = os.environ.get("LLMQ_AUTOTUNE_CACHE", "")
+    if env.lower() in ("0", "false"):
+        return None
+    return Path(env or "~/.cache/llmq_tpu/autotune.json").expanduser()
+
+
+def _cache_key(shapes: tuple, identity: str) -> str:
+    h, kv, d, layers, seqs, page = shapes
+    return (
+        f"decode:h{h}:kv{kv}:d{d}:l{layers}:s{seqs}:p{page}:{identity}"
+    )
+
+
+def resolve_choice(shapes: tuple, identity: str, measure) -> str:
+    """Cache-or-measure for the probing child. ``measure()`` must return
+    ``(choice, measured)`` — only MEASURED results are ever stored (the
+    A/B's internal failure fallbacks must not pin a stale v1)."""
+    import json
+
+    path = cache_path_from_env()
+    key = _cache_key(shapes, identity)
+    if path is not None and path.exists():
+        try:
+            entry = json.loads(path.read_text()).get(key)
+            if entry and entry.get("choice") in ("v1", "v2", "v3"):
+                print(
+                    f"kernel-autotune: cached A/B for this chip -> "
+                    f"{entry['choice']} ({path})",
+                    file=sys.stderr,
+                )
+                return entry["choice"]
+        except Exception:  # noqa: BLE001 — corrupt cache = re-measure
+            pass
+    choice, measured = measure()
+    if path is not None and measured:
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                data = json.loads(path.read_text()) if path.exists() else {}
+            except Exception:  # noqa: BLE001 — corrupt file: start over
+                data = {}
+            data[key] = {"choice": choice}
+            path.write_text(json.dumps(data, indent=1))
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            pass
+    return choice
+
+
 def _main() -> None:
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # Testability off-TPU: the axon sitecustomize pins the platform at
@@ -277,9 +289,15 @@ def _main() -> None:
         from llmq_tpu.utils.platform import force_cpu_platform
 
         force_cpu_platform()
-    h, kv, d, layers, seqs, page = (int(a) for a in sys.argv[1:7])
-    print(
-        run_ab(
+    import jax
+
+    shapes = tuple(int(a) for a in sys.argv[1:7])
+    h, kv, d, layers, seqs, page = shapes
+    dev = jax.devices()[0]
+    identity = f"{dev.device_kind or dev.platform}/jax{jax.__version__}"
+
+    def measure():
+        return run_ab(
             num_heads=h,
             num_kv_heads=kv,
             head_dim=d,
@@ -287,7 +305,8 @@ def _main() -> None:
             max_seqs=seqs,
             page_size=page,
         )
-    )
+
+    print(resolve_choice(shapes, identity, measure))
 
 
 if __name__ == "__main__":

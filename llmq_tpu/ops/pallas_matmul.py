@@ -50,6 +50,19 @@ def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
         o_ref[...] = (acc_ref[...] * scale).astype(o_ref.dtype)
 
 
+def _pick_block(dim: int, *prefs: int) -> int:
+    """Largest preferred tile that DIVIDES dim. Padding the weight to a
+    non-dividing grid would materialize a padded int8 copy inside the
+    jitted graph on every call — tripling the very HBM traffic this
+    kernel exists to halve (real MLP dims like 11008 = 256*43 don't
+    divide 512). Falls back to the smallest preference (padding path,
+    correct but copy-paying) only when nothing divides."""
+    for p in prefs:
+        if dim % p == 0:
+            return p
+    return min(prefs[-1], dim)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_m", "block_n", "block_k", "interpret"),
@@ -60,21 +73,25 @@ def int8_matmul_pallas(
     scale: jnp.ndarray,  # [N] per-output-channel scale
     *,
     block_m: int = 256,
-    block_n: int = 512,
-    block_k: int = 512,
+    block_n: int = 0,  # 0 = auto: largest of 512/256/128 dividing N
+    block_k: int = 0,  # 0 = auto: largest of 512/256/128 dividing K
     interpret: bool = False,
 ) -> jnp.ndarray:
     """``(x @ q) * scale`` with q read from HBM as int8. Returns x.dtype.
 
     Ragged edges are zero-padded to the block grid (padding contributes
-    zeros to the contraction, and padded output rows/cols are sliced off).
+    zeros to the contraction, and padded output rows/cols are sliced
+    off) — activation-side padding is cheap; weight-side padding is
+    avoided by the auto block picker (see ``_pick_block``).
     """
     M, K = x.shape
     K2, N = q.shape
     assert K == K2 and scale.shape == (N,), (x.shape, q.shape, scale.shape)
     bm = min(block_m, M)
-    bn = min(block_n, N)
-    bk = min(block_k, K)
+    bn = block_n or _pick_block(N, 512, 256, 128)
+    bk = block_k or _pick_block(K, 512, 256, 128)
+    bn = min(bn, N)
+    bk = min(bk, K)
     mp, np_, kp = -(-M // bm) * bm, -(-N // bn) * bn, -(-K // bk) * bk
     if (mp, kp) != (M, K):
         x = jnp.pad(x, ((0, mp - M), (0, kp - K)))

@@ -1,6 +1,6 @@
 """Autotune driver logic (``engine/kernel_autotune.py``): gating, the
-subprocess contract, and the per-host cache. The measured A/B itself is
-hardware-only; here the child is mocked."""
+subprocess contract, and the child-side per-chip cache. The measured A/B
+itself is hardware-only; here children/measurers are mocked."""
 
 import json
 import subprocess
@@ -11,8 +11,7 @@ import pytest
 from llmq_tpu.engine import kernel_autotune as ka
 
 SHAPES = dict(num_heads=8, num_kv_heads=2, head_dim=64, num_layers=4)
-
-
+SHAPE_TUPLE = (8, 2, 64, 4, 192, 128)
 _DETAIL = "kernel-autotune: decode A/B v1=1ms v2=0.5ms v3=0.6ms per layer -> v2"
 
 
@@ -43,70 +42,99 @@ def test_disabled_by_flag(monkeypatch):
     assert ka.autotune_decode_kernel(**SHAPES) is None
 
 
-def test_probe_choice_and_cache_roundtrip(monkeypatch, tmp_path):
+def test_probe_choice_from_child(monkeypatch):
     monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # pretend: probe applies
     monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
-    cache = tmp_path / "autotune.json"
-    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
-
-    calls = []
-    fake = _fake_run("v2")
-
-    def counting(*a, **k):
-        calls.append(1)
-        return fake(*a, **k)
-
-    monkeypatch.setattr(subprocess, "run", counting)
+    monkeypatch.setattr(subprocess, "run", _fake_run("v2"))
     assert ka.autotune_decode_kernel(**SHAPES) == "v2"
-    assert len(calls) == 1
-    data = json.loads(cache.read_text())
-    (key,) = data.keys()
-    assert key.startswith("decode:h8:kv2:d64:l4")
-    assert data[key]["choice"] == "v2"
-
-    # Second call: served from cache, no subprocess.
-    assert ka.autotune_decode_kernel(**SHAPES) == "v2"
-    assert len(calls) == 1
-
-    # Different shapes: cache miss, probe again.
-    assert ka.autotune_decode_kernel(
-        num_heads=16, num_kv_heads=4, head_dim=64, num_layers=8
-    ) == "v2"
-    assert len(calls) == 2
 
 
-def test_failure_fallback_not_cached(monkeypatch, tmp_path):
+def test_child_failure_falls_back(monkeypatch):
     monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")
     monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
-    cache = tmp_path / "autotune.json"
-    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
-
-    # run_ab's internal failure path prints v1 with rc 0 but NO timing
-    # detail line — must not be cached as a measured result.
-    monkeypatch.setattr(
-        subprocess,
-        "run",
-        _fake_run("v1", detail="kernel-autotune: A/B failed (boom); using v1"),
-    )
-    assert ka.autotune_decode_kernel(**SHAPES) == "v1"
-    assert not cache.exists()
-
-    # Hard failure (rc != 0) falls back to v1 and caches nothing.
     monkeypatch.setattr(subprocess, "run", _fake_run("junk", rc=3))
     assert ka.autotune_decode_kernel(**SHAPES) == "v1"
-    assert not cache.exists()
-
-
-def test_timeout_falls_back(monkeypatch):
-    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
-    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
-    monkeypatch.delenv("LLMQ_KERNEL_AUTOTUNE", raising=False)
-    monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", "0")
 
     def boom(*a, **k):
         raise subprocess.TimeoutExpired(cmd="x", timeout=1)
 
     monkeypatch.setattr(subprocess, "run", boom)
     assert ka.autotune_decode_kernel(**SHAPES) == "v1"
+
+
+class TestChildCache:
+    """resolve_choice: the child-side cache keyed by shapes AND the
+    measuring chip/toolchain identity (~/.cache may be NFS-shared across
+    a fleet mixing chip generations)."""
+
+    def test_measure_then_cache_roundtrip(self, monkeypatch, tmp_path):
+        cache = tmp_path / "autotune.json"
+        monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
+        calls = []
+
+        def measure():
+            calls.append(1)
+            return "v2", True
+
+        got = ka.resolve_choice(SHAPE_TUPLE, "TPU_v5e/jax0.9", measure)
+        assert got == "v2" and len(calls) == 1
+        (key,) = json.loads(cache.read_text()).keys()
+        assert key.startswith("decode:h8:kv2:d64:l4:s192:p128")
+        assert key.endswith("TPU_v5e/jax0.9")
+
+        # Same shapes + same identity: served from cache, no re-measure.
+        got = ka.resolve_choice(SHAPE_TUPLE, "TPU_v5e/jax0.9", measure)
+        assert got == "v2" and len(calls) == 1
+
+        # Same shapes, DIFFERENT chip: cache miss, measured again.
+        got = ka.resolve_choice(SHAPE_TUPLE, "TPU_v4/jax0.9", measure)
+        assert got == "v2" and len(calls) == 2
+        assert len(json.loads(cache.read_text())) == 2
+
+        # Toolchain upgrade: also a miss.
+        ka.resolve_choice(SHAPE_TUPLE, "TPU_v5e/jax0.10", measure)
+        assert len(calls) == 3
+
+    def test_unmeasured_fallback_not_cached(self, monkeypatch, tmp_path):
+        cache = tmp_path / "autotune.json"
+        monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
+        got = ka.resolve_choice(
+            SHAPE_TUPLE, "TPU_v5e/jax0.9", lambda: ("v1", False)
+        )
+        assert got == "v1"
+        assert not cache.exists()
+
+    def test_disabled_cache_always_measures(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", "0")
+        calls = []
+
+        def measure():
+            calls.append(1)
+            return "v3", True
+
+        assert ka.resolve_choice(SHAPE_TUPLE, "x/y", measure) == "v3"
+        assert ka.resolve_choice(SHAPE_TUPLE, "x/y", measure) == "v3"
+        assert len(calls) == 2
+
+    def test_corrupt_cache_re_measures(self, monkeypatch, tmp_path):
+        cache = tmp_path / "autotune.json"
+        cache.write_text("{not json")
+        monkeypatch.setenv("LLMQ_AUTOTUNE_CACHE", str(cache))
+        got = ka.resolve_choice(
+            SHAPE_TUPLE, "TPU_v5e/jax0.9", lambda: ("v2", True)
+        )
+        assert got == "v2"
+        assert json.loads(cache.read_text())  # rewritten valid
+
+
+def test_run_ab_off_tpu_is_unmeasured():
+    """On the CPU backend run_ab must report measured=False so the child
+    never caches the v1 fallback."""
+    pytest.importorskip("jax")
+    choice, measured = ka.run_ab(
+        num_heads=4, num_kv_heads=2, head_dim=8, num_layers=1,
+        max_seqs=2, page_size=8,
+    )
+    assert choice == "v1" and measured is False

@@ -124,7 +124,11 @@ def test_worker_exports_autotuned_kernel(monkeypatch):
         return "v3"
 
     monkeypatch.setattr(ka, "autotune_decode_kernel", fake_autotune)
-    monkeypatch.delenv("LLMQ_DECODE_KERNEL", raising=False)
+    # setenv-then-delenv records the ORIGINAL (absent) state with
+    # monkeypatch, so the worker's direct os.environ write below is
+    # rolled back at teardown even if an assert fails mid-test.
+    monkeypatch.setenv("LLMQ_DECODE_KERNEL", "sentinel")
+    monkeypatch.delenv("LLMQ_DECODE_KERNEL")
     worker._autotune_kernel()
     assert os.environ.get("LLMQ_DECODE_KERNEL") == "v3"
     # Shapes came from the preset's host-side config, engine knobs from
