@@ -6,5 +6,10 @@ The reference inherited CUDA PagedAttention from vLLM
 - ``ops.attention`` — pure-XLA reference implementations (run anywhere,
   used for CPU tests and as the numerical oracle for the kernels)
 - ``ops.pallas_attention`` — Pallas TPU kernels (flash prefill,
-  paged-KV decode) compiled via Mosaic
+  paged-KV decode v1/v2/v3, chunked prefill) compiled via Mosaic
+- ``ops.pallas_matmul`` — int8 dequantize-in-VMEM matmul
+  (``LLMQ_INT8_MATMUL=pallas``; see ``models/quant.py``)
+- ``ops.ring_attention`` — ring/context-parallel prefill over the
+  ``sp`` mesh axis (long-context sequence parallelism)
+- ``ops.dispatch`` — backend selection + ``shard_map`` tp wrapping
 """
