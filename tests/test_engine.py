@@ -562,3 +562,26 @@ def test_param_auto_layout_matches_default(monkeypatch):
     monkeypatch.setenv("LLMQ_PARAM_AUTO_LAYOUT", "1")
     outs = run_sync(make_core(), [("r", "hello layout", greedy(5))])
     assert outs["r"].token_ids == golden["r"].token_ids
+
+def test_param_auto_layout_with_int8(monkeypatch):
+    """Auto-layout re-puts a QUANTIZED param tree ({q, scale} dict
+    nodes) without changing outputs — the layout probe and leaf-by-leaf
+    re-put must handle int8 leaves."""
+    from llmq_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(PARAMS)
+
+    def qcore():
+        return EngineCore(
+            CFG, qparams, ByteTokenizer(), mesh=make_mesh(tensor_parallel=1),
+            engine_config=EngineConfig(
+                max_num_seqs=4, max_model_len=64, page_size=8, num_pages=40,
+                kv_dtype=jnp.float32, min_prefill_bucket=16,
+            ),
+        )
+
+    golden = run_sync(qcore(), [("r", "hello int8 layout", greedy(5))])
+    monkeypatch.setenv("LLMQ_PARAM_AUTO_LAYOUT", "1")
+    outs = run_sync(qcore(), [("r", "hello int8 layout", greedy(5))])
+    assert outs["r"].token_ids == golden["r"].token_ids
+
