@@ -67,24 +67,54 @@ def run_ab(
         PAGE = page_size
         PPS = 4
         per_page = PAGE * NKV * D * 2  # bf16
-        P = max(PPS * 4, min(300 * 2**20 // max(1, L * per_page), 961))
-        if P < PPS + 1:
-            return "v1", False
         ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
+        # Pool sizing. Two constraints pull apart: the pool must NOT fit
+        # in VMEM (~128 MB) or every kernel looks infinitely fast, and
+        # the page each sequence WRITES must be distinct across sequences
+        # (all three candidates write the step's KV row; a collision on
+        # the written page makes the XLA scatter — one winner — and the
+        # fused v3 kernel — own row each — legitimately disagree,
+        # spuriously tripping the numerics guard). READ pages may collide
+        # freely: TPU DMAs stream from HBM either way, so timing is
+        # unaffected. Prefer fully-distinct pages when a probe-sized HBM
+        # budget allows; otherwise distinct written pages only (GQA
+        # models with few KV heads have small pages — 300 MB is only
+        # ~127 pages at qwen2.5-3b shapes, far under S*PPS).
+        try:
+            limit = (jax.devices()[0].memory_stats() or {}).get("bytes_limit")
+        except Exception:  # noqa: BLE001
+            limit = None
+        budget = int(0.4 * limit) if limit else 6 * 2**30
+        per_pool_page = 2 * L * per_page  # K and V sides, all layers
+        p_full = S * PPS + 1
+        p_budget = max(PPS * 4, min(budget // max(1, per_pool_page), 4096))
+        # VMEM-defeating floor (~300 MiB pool): below it every kernel
+        # times as cache-resident and the ranking is meaningless
+        # (round-3 finding).
+        p_floor = 300 * 2**20 // max(1, per_pool_page)
+        wcol = (ctx - 1) // PAGE  # the page column the step writes into
+        rng = np.random.default_rng(0)
+        if p_budget >= p_full:
+            P = max(p_full, p_floor)
+            perm = rng.permutation(np.arange(1, P))[: S * PPS]
+            bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
+        elif p_budget >= S + 1:
+            P = max(p_budget, p_floor, S + 1)
+            pages = rng.integers(1, P, size=(S, PPS))
+            pages[:, wcol] = rng.permutation(np.arange(1, P))[:S]
+            bt = jnp.asarray(pages.astype(np.int32))
+        else:
+            print(
+                f"kernel-autotune: pool budget {budget >> 20} MiB < "
+                f"{S + 1} pages x {per_pool_page >> 10} KiB; skipping A/B",
+                file=sys.stderr,
+            )
+            return "v1", False
         q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
         kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
         vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
         kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
         vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
-        rng = np.random.default_rng(0)
-        # Pages WITHOUT replacement: all three candidates write the new
-        # row, and a cross-sequence page collision would make the scatter
-        # (one winner) and the fused kernel (own row each) legitimately
-        # disagree, spuriously tripping the numerics guard.
-        if P - 1 < S * PPS:
-            return "v1", False  # pool too small for distinct pages per seq
-        perm = rng.permutation(np.arange(1, P))[: S * PPS]
-        bt = jnp.asarray(perm.reshape(S, PPS).astype(np.int32))
         cl = jnp.full((S,), ctx, jnp.int32)
         positions = (cl - 1)[:, None]
         w = jnp.asarray([1 << 30], jnp.int32)

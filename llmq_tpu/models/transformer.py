@@ -489,6 +489,14 @@ class Transformer:
 # Initialization
 # ---------------------------------------------------------------------------
 
+# Quantized random init generates + quantizes stacked weights one
+# leading-axis slice at a time past this full-precision size — a 9B
+# gate_proj is ~11 GB in f32, which alone exhausts a 16 GB chip
+# (measured: the r05 int8-9B bench died inside init_params before the
+# chunked path existed). Patchable so tests exercise the chunked path
+# on tiny models.
+CHUNKED_INIT_F32_BYTES = 1 << 30
+
 
 def init_params(
     config: ModelConfig, key: jax.Array, dtype=jnp.float32,
@@ -507,6 +515,28 @@ def init_params(
     keys = iter(jax.random.split(key, 16))
 
     def w(key, shape, fan_in, *, q: bool = False, axis: int = -2):
+        f32_bytes = 4 * math.prod(shape)
+        if quantize and q and f32_bytes > CHUNKED_INIT_F32_BYTES and len(shape) > 2:
+            # Big stacked weights (a 9B gate_proj is ~11 GB in f32):
+            # generate + quantize one leading-axis slice at a time so the
+            # full-precision transient is one LAYER, not the whole stack —
+            # then stack the int8 results. Small weights keep the
+            # single-shot path (and its exact random stream).
+            parts = []
+            for k in jax.random.split(key, shape[0]):
+                arr = (
+                    jax.random.normal(k, shape[1:], jnp.float32)
+                    / math.sqrt(fan_in)
+                ).astype(dtype)
+                parts.append(
+                    qm.quantize_array_donated(
+                        arr, axis=axis, scale_dtype=dtype
+                    )
+                )
+            return {
+                "q": jnp.stack([p["q"] for p in parts]),
+                "scale": jnp.stack([p["scale"] for p in parts]),
+            }
         arr = (
             jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
         ).astype(dtype)

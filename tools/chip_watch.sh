@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Waits for the TPU tunnel to come back (it wedges for stretches — see
+# PERF_NOTES rounds 4-5), then runs the round-5 measurement ladder once,
+# highest-value steps first in case the window is short. Results land
+# under PERF_RESULTS/ next to the hardware_session.sh logs.
+set -u
+cd "$(dirname "$0")/.."
+OUT=PERF_RESULTS
+mkdir -p "$OUT"
+
+probe() {
+    timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null
+}
+
+echo "chip-watch: probing every 120s ($(date +%H:%M:%S))"
+until probe; do
+    sleep 120
+done
+echo "chip-watch: chip is back ($(date +%H:%M:%S)); running ladder"
+
+run() {  # run <timeout-s> <name> <cmd...>
+    local t="$1" name="$2"; shift 2
+    echo "=== $name ($(date +%H:%M:%S))"
+    timeout "$t" "$@" > "$OUT/$name.log" 2>&1
+    echo "    rc=$? -> $OUT/$name.log"
+    grep -v WARNING "$OUT/$name.log" | tail -3 | sed 's/^/    /'
+}
+
+# 1. The decode-kernel A/B (fixed pool sizing) at the ladder's two slot
+#    counts — decides the production default.
+run 900 ab_s224 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 224 128
+run 600 ab_s192 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 192 128
+# 2. bf16 headline (A/B + slot ladder built in; autotune cache now warm).
+run 1800 bench_bf16_2 python bench.py
+# 3. Slot-count question: 192 vs 224 at the same kernel.
+run 1200 bench_s192 env LLMQ_BENCH_SEQS=192 python bench.py
+# 4. int8 9B north star (chunked init fix) — XLA int8 path.
+run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=tower-plus-9b python bench.py
+# 5. int8 9B with the Pallas dequant matmul (the fusion check said XLA
+#    does NOT fuse the convert; this is the guaranteed path).
+run 1800 bench_int8_9b_pallas env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=tower-plus-9b LLMQ_INT8_MATMUL=pallas python bench.py
+# 6. Param auto-layout A/B against step 2.
+run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
+
+echo "=== ladder done ($(date +%H:%M:%S))"
+grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null

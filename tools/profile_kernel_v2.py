@@ -4,7 +4,11 @@ The round-3 finding: a small test pool fits in VMEM and makes any kernel
 look infinitely fast — benchmark only with the full stacked [L,P,...]
 pool (2.3 GiB per K and V at the 3B bench config).
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 import jax.numpy as jnp
