@@ -14,6 +14,9 @@
 # Each step has its own timeout so one hang doesn't eat the session.
 set -u
 cd "$(dirname "$0")/.."
+# tools/*.py insert the repo root themselves, but belt-and-braces for
+# anything invoked as a bare module path (python -m ...).
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 OUT=PERF_RESULTS
 mkdir -p "$OUT"
 run() {  # run <timeout-s> <name> <cmd...>
