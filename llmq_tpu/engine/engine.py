@@ -242,6 +242,23 @@ class EngineCore:
                 "context-parallelize over the sp axis (each chunk computes "
                 "replicated); use bucketed prefill for ring attention"
             )
+        tp_size = int(self.mesh.shape.get(TP_AXIS, 1))
+        if (
+            os.environ.get("LLMQ_INT8_MATMUL", "").lower() == "pallas"
+            and tp_size > 1
+        ):
+            # tp==1 scope (ops/pallas_matmul.py): demote to the XLA int8
+            # path before this engine traces. Process-wide by design —
+            # workers and bench build exactly one engine per process.
+            logger.warning(
+                "LLMQ_INT8_MATMUL=pallas is single-chip-only (tp=%d mesh); "
+                "using the XLA int8 matmul path for the rest of this "
+                "process",
+                tp_size,
+            )
+            from llmq_tpu.models import quant as _qm
+
+            _qm.disable_pallas_matmul(f"tp={tp_size} mesh")
         self._buckets = _prefill_buckets(
             self.cfg, sp=int(self.mesh.shape.get(SP_AXIS, 1))
         )

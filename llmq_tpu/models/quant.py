@@ -84,13 +84,31 @@ def quantize_array_donated(w, *, axis: int, scale_dtype=jnp.float32) -> Params:
     return quantize_array(w, axis=axis, scale_dtype=scale_dtype)
 
 
+# Set by disable_pallas_matmul(); checked at trace time alongside the
+# env var.
+_PALLAS_DISABLED_REASON: str | None = None
+
+
+def disable_pallas_matmul(reason: str) -> None:
+    """Turn off the Pallas int8 matmul for the REST OF THIS PROCESS
+    (trace-time check — affects every engine traced afterwards, which
+    in the worker/bench deployment model is exactly one). The engine
+    calls this on tp>1 meshes: GSPMD cannot partition the opaque
+    ``pallas_call`` over sharded weights, so tracing with it enabled
+    would replicate every weight on every chip."""
+    global _PALLAS_DISABLED_REASON
+    _PALLAS_DISABLED_REASON = reason
+
+
 def _pallas_int8_enabled() -> bool:
     """``LLMQ_INT8_MATMUL=pallas``: route int8 matmuls through the
     dequantize-in-VMEM Pallas kernel (``ops/pallas_matmul.py``) instead
     of relying on XLA fusing the convert into the dot. tp==1 scope — see
-    the kernel module docstring."""
+    the kernel module docstring and :func:`disable_pallas_matmul`."""
     import os
 
+    if _PALLAS_DISABLED_REASON is not None:
+        return False
     return os.environ.get("LLMQ_INT8_MATMUL", "").lower() == "pallas"
 
 

@@ -196,6 +196,45 @@ class TestQuantModel:
                 break
         assert finished["r1"].completion_tokens == 6
 
+    def test_pallas_matmul_demoted_on_tp_mesh(self, monkeypatch):
+        """LLMQ_INT8_MATMUL=pallas is tp==1 scope (GSPMD cannot split an
+        opaque pallas_call); an engine built on a tp>1 mesh must demote
+        to the XLA path instead of tracing with it."""
+        from llmq_tpu.parallel import make_mesh
+
+        monkeypatch.setenv("LLMQ_INT8_MATMUL", "pallas")
+        monkeypatch.setattr(qm, "_PALLAS_DISABLED_REASON", None)
+        params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+        qparams = qm.quantize_params(params)
+        core = EngineCore(
+            CFG,
+            qparams,
+            ByteTokenizer(),
+            mesh=make_mesh(tensor_parallel=2),
+            engine_config=EngineConfig(
+                max_num_seqs=2,
+                max_model_len=64,
+                page_size=8,
+                num_pages=32,
+                kv_dtype=jnp.float32,
+                min_prefill_bucket=16,
+            ),
+        )
+        assert not qm._pallas_int8_enabled()
+        core.add_request(
+            "r1",
+            prompt="demoted",
+            params=SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        )
+        finished = {}
+        for _ in range(50):
+            for out in core.step():
+                finished[out.rid] = out
+            if not core.has_work:
+                break
+        assert set(finished) == {"r1"}
+        assert finished["r1"].completion_tokens == 4
+
 
 class TestQuantLoad:
     @pytest.fixture(scope="class")
