@@ -555,6 +555,25 @@ class TestPrefixCaching:
         core.scheduler.check_invariants()
 
 
+def test_prefill_bucket_quarter_steps():
+    """Above 128 the bucket ladder carries quarter steps between octaves
+    (a 200-token prompt pads to 224, not 256 — prefill is compute-bound
+    and padding is real FLOPs); below 128 it stays pure powers of two;
+    every bucket is a multiple of the sp degree."""
+    from llmq_tpu.engine.engine import _prefill_buckets
+
+    cfg = EngineConfig(
+        max_num_seqs=4, max_model_len=512, page_size=128,
+        min_prefill_bucket=32,
+    )
+    buckets = _prefill_buckets(cfg)
+    assert buckets == [32, 64, 128, 160, 192, 224, 256, 320, 384, 448, 512]
+    assert next(b for b in buckets if b >= 200) == 224
+    sp_buckets = _prefill_buckets(cfg, sp=4)
+    assert all(b % 4 == 0 for b in sp_buckets)
+    assert sp_buckets[-1] == 512
+
+
 def test_param_auto_layout_matches_default(monkeypatch):
     """LLMQ_PARAM_AUTO_LAYOUT=1 (XLA-chosen parameter layouts) must not
     change outputs — layout is memory order, not math."""
