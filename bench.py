@@ -27,7 +27,8 @@ back to CPU, and any late failure still emits the JSON line with an
 ``error`` field.
 
 Env knobs: LLMQ_BENCH_PRESET, LLMQ_BENCH_REQUESTS, LLMQ_BENCH_PROMPT,
-LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS, LLMQ_BENCH_INIT_RETRIES (default 2),
+LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS, LLMQ_BENCH_KV_DTYPE (fp8 = e5m2 KV
+cache), LLMQ_BENCH_INIT_RETRIES (default 2),
 LLMQ_BENCH_INIT_TIMEOUT (seconds per backend probe, default 120),
 LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 2700 —
 sized for the slot ladder running the headline at both candidates).
@@ -288,6 +289,7 @@ def _kernel_ab_probe_main() -> None:
         limit, devices[0].platform
     )
     config = get_preset(preset)
+    kv_env = (os.environ.get("LLMQ_BENCH_KV_DTYPE") or "").lower()
     choice, _measured = run_ab(
         num_heads=config.num_heads,
         num_kv_heads=config.num_kv_heads,
@@ -295,6 +297,10 @@ def _kernel_ab_probe_main() -> None:
         num_layers=config.num_layers,
         max_seqs=int(os.environ.get("LLMQ_BENCH_SEQS", 192)),
         page_size=128,
+        # The A/B must rank kernels at the production pool dtype (fp8
+        # pools move half the bytes of bf16).
+        kv_dtype="float8_e5m2" if kv_env in ("fp8", "fp8_e5m2",
+                                             "float8_e5m2") else "bfloat16",
     )
     print(choice)
 
@@ -416,7 +422,9 @@ def main() -> None:
                 engine_config=EngineConfig(
                     max_num_seqs=max_seqs,
                     max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
-                    kv_dtype=dtype,
+                    # LLMQ_BENCH_KV_DTYPE=fp8 -> float8_e5m2 page pool
+                    # (half the KV bytes; see EngineConfig.kv_dtype).
+                    kv_dtype=os.environ.get("LLMQ_BENCH_KV_DTYPE") or dtype,
                     num_pages=256 if on_cpu else None,
                     # 128-token pages: the decode kernel DMAs one page
                     # per grid step, and 16 KB transfers are
@@ -481,6 +489,11 @@ def main() -> None:
         "mfu": round(mfu, 4),
         "dtype": "int8" if int8 else str(jnp.dtype(dtype)),
         "max_seqs": max_seqs,
+        **(
+            {"kv_dtype": os.environ["LLMQ_BENCH_KV_DTYPE"]}
+            if os.environ.get("LLMQ_BENCH_KV_DTYPE")
+            else {}
+        ),
         "decode_kernel": ab_choice or os.environ.get("LLMQ_DECODE_KERNEL") or "v1",
     }
     if backend_note:

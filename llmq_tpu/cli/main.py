@@ -239,6 +239,12 @@ def worker() -> None:
               type=click.Choice(["bfloat16", "float32", "int8"]),
               help="int8 = weight-only quantization (bf16 compute); "
                    "halves HBM footprint and weight bandwidth")
+@click.option("--kv-dtype", default=None,
+              type=click.Choice(["auto", "bf16", "fp8", "fp8_e5m2"]),
+              help="KV cache storage dtype: fp8 (float8_e5m2) halves KV "
+                   "bytes — double the page pool, half the decode "
+                   "attention bandwidth (vLLM kv-cache-dtype parity). "
+                   "Default: the compute dtype (or LLMQ_KV_DTYPE)")
 @click.option("--prefill-chunk", type=int, default=None,
               help="Chunked prefill: positions per chunk (any prompt "
                    "length through one executable; decode interleaves "
@@ -248,7 +254,7 @@ def worker() -> None:
                    "(requires --prefill-chunk)")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
-               dtype, prefill_chunk, prefix_caching):
+               dtype, kv_dtype, prefill_chunk, prefix_caching):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -260,6 +266,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         concurrency=concurrency,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
+        kv_dtype=kv_dtype,
         dtype=dtype,
         prefill_chunk_size=prefill_chunk,
         enable_prefix_caching=prefix_caching,

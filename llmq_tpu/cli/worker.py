@@ -23,6 +23,7 @@ def run_tpu_worker(
     max_num_seqs: Optional[int] = None,
     max_model_len: Optional[int] = None,
     dtype: str = "bfloat16",
+    kv_dtype: Optional[str] = None,
     prefill_chunk_size: Optional[int] = None,
     enable_prefix_caching: bool = False,
 ) -> None:
@@ -44,6 +45,7 @@ def run_tpu_worker(
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
         dtype=dtype,
+        kv_dtype=kv_dtype,
         prefill_chunk_size=prefill_chunk_size,
         enable_prefix_caching=enable_prefix_caching,
     )

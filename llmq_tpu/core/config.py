@@ -124,6 +124,12 @@ class Config(BaseModel):
         description="Chunked prefill: positions per chunk (None = bucketed).",
     )
 
+    kv_dtype: Optional[str] = Field(
+        default_factory=lambda: _env("LLMQ_KV_DTYPE", "VLLM_KV_CACHE_DTYPE"),
+        description="KV cache storage dtype (bf16 default; fp8 = "
+        "float8_e5m2, half the KV bytes — vLLM kv-cache-dtype parity).",
+    )
+
     enable_prefix_caching: bool = Field(
         default_factory=lambda: (_env("LLMQ_PREFIX_CACHING") or "").lower()
         in ("1", "true", "yes"),

@@ -106,6 +106,12 @@ class EngineConfig:
     page_size: int = 32
     num_pages: Optional[int] = None  # None → size from device HBM
     hbm_utilization: float = 0.9
+    # KV cache storage dtype. "fp8" (float8_e5m2, scale-free — the same
+    # trade vLLM's kv-cache-dtype=fp8 makes) halves KV bytes: double the
+    # page pool in the same HBM and half the decode-attention bandwidth.
+    # Compute stays f32 inside the kernels (pages are converted on-chip);
+    # accepts a jnp dtype or the strings "bf16"/"bfloat16"/"fp8"/
+    # "float8_e5m2"/"f32"/"float32".
     kv_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
@@ -133,6 +139,24 @@ class EngineConfig:
     # stop set exceeds it, so min_tokens suppression always covers the
     # full set — no silent truncation.
     stop_id_capacity: int = 8
+
+    def __post_init__(self):
+        if isinstance(self.kv_dtype, str):
+            names = {
+                "bf16": jnp.bfloat16,
+                "bfloat16": jnp.bfloat16,
+                "fp8": jnp.float8_e5m2,
+                "fp8_e5m2": jnp.float8_e5m2,
+                "float8_e5m2": jnp.float8_e5m2,
+                "f32": jnp.float32,
+                "float32": jnp.float32,
+            }
+            try:
+                self.kv_dtype = names[self.kv_dtype.lower()]
+            except KeyError:
+                raise ValueError(
+                    f"kv_dtype={self.kv_dtype!r} (want one of {sorted(names)})"
+                ) from None
 
 
 def _prefill_buckets(cfg: EngineConfig, sp: int = 1) -> List[int]:

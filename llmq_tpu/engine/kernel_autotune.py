@@ -36,6 +36,7 @@ def run_ab(
     num_layers: int,
     max_seqs: int,
     page_size: int,
+    kv_dtype: str = "bfloat16",
 ) -> tuple:
     """In-process kernel A/B (the child-process body).
 
@@ -66,7 +67,11 @@ def run_ab(
         S = max_seqs
         PAGE = page_size
         PPS = 4
-        per_page = PAGE * NKV * D * 2  # bf16
+        # The v1-vs-v2/v3 trade is KV-bandwidth-bound, so the probe pool
+        # must use the PRODUCTION pool dtype: an fp8 cache moves half the
+        # bytes of bf16 and can rank the kernels differently.
+        kvd = jnp.dtype(kv_dtype)
+        per_page = PAGE * NKV * D * kvd.itemsize
         ctx = min(PPS * PAGE - 2, int(PAGE * 2.6))
         # Pool sizing. Two constraints pull apart: the pool must NOT fit
         # in VMEM (~128 MB) or every kernel looks infinitely fast, and
@@ -110,11 +115,14 @@ def run_ab(
                 file=sys.stderr,
             )
             return "v1", False
-        q = jax.random.normal(jax.random.key(0), (S, H, D), jnp.bfloat16)
-        kp = jax.random.normal(jax.random.key(1), (L, P, PAGE, NKV, D), jnp.bfloat16)
-        vp = jax.random.normal(jax.random.key(2), (L, P, PAGE, NKV, D), jnp.bfloat16)
-        kn = jax.random.normal(jax.random.key(3), (S, NKV, D), jnp.bfloat16)
-        vn = jax.random.normal(jax.random.key(4), (S, NKV, D), jnp.bfloat16)
+        def rnd(seed, shape, dtype=jnp.bfloat16):
+            return jax.random.normal(jax.random.key(seed), shape, jnp.float32).astype(dtype)
+
+        q = rnd(0, (S, H, D))
+        kp = rnd(1, (L, P, PAGE, NKV, D), kvd)
+        vp = rnd(2, (L, P, PAGE, NKV, D), kvd)
+        kn = rnd(3, (S, NKV, D))
+        vn = rnd(4, (S, NKV, D))
         cl = jnp.full((S,), ctx, jnp.int32)
         positions = (cl - 1)[:, None]
         w = jnp.asarray([1 << 30], jnp.int32)
@@ -196,6 +204,7 @@ def autotune_decode_kernel(
     num_layers: int,
     max_seqs: int = 192,
     page_size: int = 128,
+    kv_dtype: str = "bfloat16",
     timeout_s: Optional[float] = None,
     logger=None,
 ) -> Optional[str]:
@@ -226,6 +235,7 @@ def autotune_decode_kernel(
         str(num_layers),
         str(max_seqs),
         str(page_size),
+        str(kv_dtype),
     ]
     try:
         proc = subprocess.run(
@@ -269,21 +279,24 @@ def cache_path_from_env():
     return Path(env or "~/.cache/llmq_tpu/autotune.json").expanduser()
 
 
-def _cache_key(shapes: tuple, identity: str) -> str:
+def _cache_key(shapes: tuple, identity: str, kv_dtype: str) -> str:
     h, kv, d, layers, seqs, page = shapes
     return (
-        f"decode:h{h}:kv{kv}:d{d}:l{layers}:s{seqs}:p{page}:{identity}"
+        f"decode:h{h}:kv{kv}:d{d}:l{layers}:s{seqs}:p{page}"
+        f":{kv_dtype}:{identity}"
     )
 
 
-def resolve_choice(shapes: tuple, identity: str, measure) -> str:
+def resolve_choice(
+    shapes: tuple, identity: str, measure, kv_dtype: str = "bfloat16"
+) -> str:
     """Cache-or-measure for the probing child. ``measure()`` must return
     ``(choice, measured)`` — only MEASURED results are ever stored (the
     A/B's internal failure fallbacks must not pin a stale v1)."""
     import json
 
     path = cache_path_from_env()
-    key = _cache_key(shapes, identity)
+    key = _cache_key(shapes, identity, kv_dtype)
     if path is not None and path.exists():
         try:
             entry = json.loads(path.read_text()).get(key)
@@ -322,6 +335,7 @@ def _main() -> None:
     import jax
 
     shapes = tuple(int(a) for a in sys.argv[1:7])
+    kv_dtype = sys.argv[7] if len(sys.argv) > 7 else "bfloat16"
     h, kv, d, layers, seqs, page = shapes
     dev = jax.devices()[0]
     identity = f"{dev.device_kind or dev.platform}/jax{jax.__version__}"
@@ -334,9 +348,10 @@ def _main() -> None:
             num_layers=layers,
             max_seqs=seqs,
             page_size=page,
+            kv_dtype=kv_dtype,
         )
 
-    print(resolve_choice(shapes, identity, measure))
+    print(resolve_choice(shapes, identity, measure, kv_dtype))
 
 
 if __name__ == "__main__":

@@ -102,11 +102,13 @@ def paged_decode_attention(
     n_kv = k_pages.shape[2]
     n_rep = n_heads // n_kv
 
-    # [S, pages_per_seq, page_size, n_kv, d] → [S, max_ctx, n_kv, d]
+    # [S, pages_per_seq, page_size, n_kv, d] → [S, max_ctx, n_kv, d].
+    # The cast covers reduced-precision pools (fp8 KV cache): compute
+    # happens in the query dtype, pages only STORE narrow.
     k = k_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
     v = v_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    k = repeat_kv(k, n_rep).astype(q.dtype)
+    v = repeat_kv(v, n_rep).astype(q.dtype)
 
     scores = jnp.einsum("shd,skhd->shk", q, k) * scale
     scores = _softcap(scores, softcap)
@@ -154,8 +156,8 @@ def paged_prefill_attention(
 
     k = k_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
     v = v_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    k = repeat_kv(k, n_rep).astype(q.dtype)  # fp8 pools store narrow
+    v = repeat_kv(v, n_rep).astype(q.dtype)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     scores = _softcap(scores, softcap)
@@ -197,6 +199,9 @@ def write_prompt_kv_pages(
     assert T % page_size == 0, "bucket must be page-aligned for page writes"
     n_lp = T // page_size
     phys = block_tables[:, :n_lp].reshape(B * n_lp)
+    # Cast to the pool dtype (fp8 KV caches quantize on write).
+    k_new = k_new.astype(k_pages.dtype)
+    v_new = v_new.astype(v_pages.dtype)
     k_blocks = k_new.reshape(B * n_lp, page_size, n_kv, d)
     v_blocks = v_new.reshape(B * n_lp, page_size, n_kv, d)
     k_pages = k_pages.at[layer, phys].set(k_blocks, mode="drop")
@@ -233,8 +238,9 @@ def write_kv_pages(
     batch_idx = jnp.repeat(jnp.arange(B), T)
     physical_page = block_tables[batch_idx, logical_page]
     physical_page = jnp.where(valid, physical_page, 0)  # scratch page
-    k_flat = k_new.reshape(B * T, n_kv, d)
-    v_flat = v_new.reshape(B * T, n_kv, d)
+    # Cast to the pool dtype (fp8 KV caches quantize on write).
+    k_flat = k_new.reshape(B * T, n_kv, d).astype(k_pages.dtype)
+    v_flat = v_new.reshape(B * T, n_kv, d).astype(v_pages.dtype)
     if k_pages.ndim == 5:
         assert layer is not None, "stacked pages need a layer index"
         k_pages = k_pages.at[layer, physical_page, offset].set(

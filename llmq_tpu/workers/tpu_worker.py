@@ -40,6 +40,7 @@ class TPUWorker(BaseWorker):
         max_num_seqs: Optional[int] = None,
         max_model_len: Optional[int] = None,
         dtype: str = "bfloat16",
+        kv_dtype: Optional[str] = None,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefill_chunk_size: Optional[int] = None,
@@ -53,6 +54,7 @@ class TPUWorker(BaseWorker):
         self._max_num_seqs = max_num_seqs
         self._max_model_len = max_model_len
         self._dtype = dtype
+        self._kv_dtype = kv_dtype
         self._page_size = page_size
         self._num_pages = num_pages
         self._prefill_chunk_size = prefill_chunk_size
@@ -123,6 +125,7 @@ class TPUWorker(BaseWorker):
         cfg = self._model_config_host()
         if cfg is None:
             return
+        kv = self._kv_dtype or self.config.kv_dtype
         choice = autotune_decode_kernel(
             num_heads=cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
@@ -130,6 +133,10 @@ class TPUWorker(BaseWorker):
             num_layers=cfg.num_layers,
             max_seqs=self._max_num_seqs or self.config.max_num_seqs or 192,
             page_size=self._page_size or 128,
+            # fp8 pools move half the bytes; the A/B must rank the
+            # kernels on the production pool dtype.
+            kv_dtype="float8_e5m2" if kv in ("fp8", "fp8_e5m2",
+                                             "float8_e5m2") else "bfloat16",
             logger=self.logger,
         )
         if choice is not None:
@@ -219,9 +226,13 @@ class TPUWorker(BaseWorker):
             overrides["prefill_chunk_size"] = chunk
         if self._enable_prefix_caching or self.config.enable_prefix_caching:
             overrides["enable_prefix_caching"] = True
+        # KV cache dtype: per-worker flag > LLMQ_KV_DTYPE env > the
+        # compute dtype. "fp8" stores pages as float8_e5m2 (half the KV
+        # bytes; kernels convert on-chip) — vLLM kv-cache-dtype parity.
+        kv = self._kv_dtype or self.config.kv_dtype
         engine_config = EngineConfig(
             hbm_utilization=self.config.hbm_utilization,
-            kv_dtype=dtype,
+            kv_dtype=dtype if kv in (None, "", "auto") else kv,
             **overrides,
         )
         core = EngineCore(
