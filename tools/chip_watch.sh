@@ -40,13 +40,19 @@ run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=qwen2.5-3b py
 # 5. int8 3B with the Pallas dequant matmul (the fusion check said XLA
 #    does NOT fuse the convert; this is the guaranteed path).
 run 1800 bench_int8_3b_pallas env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=qwen2.5-3b LLMQ_INT8_MATMUL=pallas python bench.py
-# 6. int8 9B north star (chunked init fix): measurable on one chip, even
+# 6. fp8 KV cache at 3B: halves decode-attention bandwidth (the other
+#    half of the decode step next to the int8 weight stream).
+run 1800 bench_fp8kv_3b env LLMQ_BENCH_KV_DTYPE=fp8 python bench.py
+run 1800 bench_int8_fp8kv_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 LLMQ_BENCH_PRESET=qwen2.5-3b python bench.py
+# 7. int8 9B north star (chunked init fix): measurable on one chip, even
 #    if KV pressure keeps it off the headline. Slots capped to what the
-#    KV pool can actually hold (~5 GB after 9.4 GB int8 weights).
+#    KV pool can hold (~5 GB after 9.4 GB int8 weights); fp8 KV doubles
+#    that, so the fp8 variant gets more slots.
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=tower-plus-9b LLMQ_BENCH_SEQS=48 python bench.py
-# 7. Param auto-layout A/B against step 2.
+run 1800 bench_int8_fp8kv_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 LLMQ_BENCH_PRESET=tower-plus-9b LLMQ_BENCH_SEQS=96 python bench.py
+# 8. Param auto-layout A/B against step 2.
 run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
-# 8. Queue-drain artifact on the real engine (VERDICT weak #4): the
+# 9. Queue-drain artifact on the real engine (VERDICT weak #4): the
 #    end-to-end broker->worker->results harness at a TPU preset.
 run 1800 queue_drain_tpu python performance_benchmark.py \
     --model preset://qwen2.5-3b --samples 192 --batch-sizes 64 \
