@@ -50,6 +50,15 @@ def _hf_tiny(family: str, tmp_path):
     elif family == "qwen2":
         cfg = transformers.Qwen2Config(**common, rope_theta=10000.0)
         model = transformers.Qwen2ForCausalLM(cfg)
+    elif family == "mistral":
+        # llama lineage with sliding-window attention on EVERY layer
+        # (gemma2 below covers the alternating-pattern variant). Window 8
+        # so even the stepwise DECODE test (9 prompt + 6 generated) runs
+        # most steps with evicted positions, not full causal attention.
+        cfg = transformers.MistralConfig(
+            **common, rope_theta=10000.0, sliding_window=8
+        )
+        model = transformers.MistralForCausalLM(cfg)
     elif family == "gemma2":
         cfg = transformers.Gemma2Config(
             **common,
@@ -113,7 +122,8 @@ def _sequential_block_table(num_seqs):
 
 
 @pytest.mark.parametrize(
-    "family", ["llama", "qwen2", "qwen3", "gemma2", "qwen2_moe", "qwen3_moe"]
+    "family",
+    ["llama", "qwen2", "qwen3", "mistral", "gemma2", "qwen2_moe", "qwen3_moe"],
 )
 def test_prefill_logits_match_hf(family, tmp_path):
     path, hf_model = _hf_tiny(family, tmp_path)
@@ -184,7 +194,7 @@ def test_prefill_logits_int8_close_to_hf(family, tmp_path):
 
 
 @pytest.mark.parametrize(
-    "family", ["llama", "qwen2", "qwen3", "gemma2", "qwen2_moe"]
+    "family", ["llama", "qwen2", "qwen3", "mistral", "gemma2", "qwen2_moe"]
 )
 def test_decode_matches_hf_stepwise(family, tmp_path):
     """Prefill a prompt, then greedy-decode 6 tokens; every step's logits
@@ -277,7 +287,7 @@ def test_batched_decode_slots_independent(tmp_path):
     np.testing.assert_allclose(both[1], only1[1], rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("family", ["llama", "gemma2"])
+@pytest.mark.parametrize("family", ["llama", "mistral", "gemma2"])
 @pytest.mark.parametrize("chunk", [4, 8, 16])
 def test_chunked_prefill_matches_full_and_hf(family, chunk, tmp_path):
     """Prefilling in fixed-size chunks against the paged cache must
