@@ -184,6 +184,8 @@ class PerformanceBenchmark:
                 cmd += ["--max-model-len", str(self.args.max_model_len)]
             if self.args.dtype:
                 cmd += ["--dtype", self.args.dtype]
+            if self.args.kv_dtype:
+                cmd += ["--kv-dtype", self.args.kv_dtype]
         log = open(f"/tmp/llmq_bench_worker_{batch_size}.log", "w")
         self.worker_proc = subprocess.Popen(
             cmd, env=env, stdout=log, stderr=log
@@ -362,6 +364,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--max-model-len", type=int, default=1024)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=["auto", "bf16", "fp8", "fp8_e5m2"],
+                   help="KV cache dtype for the tpu worker (fp8 = e5m2)")
     p.add_argument("--prefetch", type=int, default=None)
     p.add_argument("--prompt-text",
                    default="Translate to Dutch: the quick brown fox jumps "
