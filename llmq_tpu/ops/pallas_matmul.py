@@ -38,8 +38,12 @@ def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    x = x_ref[...].astype(jnp.float32)  # [bm, bk]
-    w = q_ref[...].astype(jnp.float32)  # [bk, bn] — int8 converts in VMEM
+    # Multiply in bf16, accumulate in f32: int8 values (±127) are exact
+    # in bf16's 8 mantissa bits, and an f32×f32 dot would run the MXU at
+    # a fraction of its bf16 rate — harmless for bandwidth-bound decode,
+    # but compute-bound prefill shares this kernel.
+    x = x_ref[...]  # [bm, bk] activation dtype (bf16 in production)
+    w = q_ref[...].astype(x.dtype)  # [bk, bn] — int8 converts in VMEM
     acc_ref[...] += jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )

@@ -35,6 +35,23 @@ def test_matches_reference(M, K, N):
     )
 
 
+def test_bf16_activations_match_xla_int8_path():
+    """Production activations are bf16: the kernel multiplies in bf16
+    (int8 weights are exact in bf16) with an f32 accumulator, which must
+    match the XLA int8 path's `x @ q.astype(bf16) * scale` numerics."""
+    kx, kq, ks = jax.random.split(jax.random.key(1), 3)
+    x = jax.random.normal(kx, (16, 64), jnp.bfloat16)
+    q = jax.random.randint(kq, (64, 48), -127, 127, jnp.int8)
+    scale = jax.random.uniform(ks, (48,), jnp.float32, 0.01, 0.1)
+    out = int8_matmul_pallas(
+        x, q, scale, block_m=16, block_n=48, block_k=32, interpret=True
+    )
+    xla = (x @ q.astype(jnp.bfloat16)).astype(jnp.float32) * scale
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(xla), rtol=2e-2, atol=2e-2
+    )
+
+
 def test_quant_matmul_env_dispatch(monkeypatch):
     """quant.matmul routes through the kernel under LLMQ_INT8_MATMUL=
     pallas and agrees with its own XLA path, including >2D activations
