@@ -50,6 +50,20 @@ def _apply_softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
     return cap * jnp.tanh(scores / cap)
 
 
+def _mul_dtype(q_dtype, kv_dtype):
+    """Dtype the attention dots multiply in: the wider of the query and
+    KV-pool dtypes — a narrow pool (fp8 KV cache) upcasts to the query
+    dtype, a pool WIDER than the compute dtype (kv_dtype=f32 with bf16
+    compute) keeps its precision. Explicit because jnp.promote_types
+    refuses implicit 8-bit-float promotion by design."""
+    qd, kd = jnp.dtype(q_dtype), jnp.dtype(kv_dtype)
+    if kd.itemsize == 1:
+        return qd
+    if qd.itemsize == 1:
+        return kd
+    return jnp.promote_types(qd, kd)
+
+
 # ---------------------------------------------------------------------------
 # Paged decode
 # ---------------------------------------------------------------------------
@@ -732,10 +746,17 @@ def _paged_prefill_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)  # [bq, H, d]
+        # Multiply in the PROMOTED operand dtype with f32 accumulation:
+        # chunked prefill is attention-compute-bound for long contexts
+        # and an f32 multiply runs the MXU at a fraction of its bf16
+        # rate. Promotion means a narrow pool (fp8 KV cache) upcasts to
+        # the query dtype, while a pool WIDER than the compute dtype
+        # (kv_dtype=f32 with bf16 compute) keeps its full precision.
+        target = _mul_dtype(q_ref.dtype, k_ref.dtype)
+        q = q_ref[0].astype(target)  # [bq, H, d]
         bq, H, d = q.shape
-        k = k_ref[0, 0].astype(jnp.float32)  # [page, n_kv, d]
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(target)  # [page, n_kv, d]
+        v = v_ref[0, 0].astype(target)
         for g in range(n_kv):
             rows = slice(g * group, (g + 1) * group)
             qg = q[:, rows, :].reshape(bq * group, d)
@@ -777,7 +798,8 @@ def _paged_prefill_kernel(
             )
             acc_ref[srows, :] = acc_ref[srows, :] * alpha + (
                 jax.lax.dot_general(
-                    probs, v[:, g, :], (((1,), (0,)), ((), ())),
+                    probs.astype(v.dtype), v[:, g, :],
+                    (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
             )
@@ -960,16 +982,23 @@ def _flash_prefill_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Dots multiply in the PROMOTED input dtype with f32
+        # accumulation: long prefill is attention-compute-bound
+        # (FLOPs ~ T^2) and an f32 multiply runs the MXU at a fraction
+        # of its bf16 rate. This also matches the XLA reference, whose
+        # einsums multiply bf16 inputs in bf16. Softmax statistics stay
+        # f32 throughout; promotion keeps mixed-dtype callers working.
+        target = _mul_dtype(q_ref.dtype, k_ref.dtype)
+        q = q_ref[0, 0].astype(target)  # [bq, d]
+        k = k_ref[0, 0].astype(target)  # [bk, d]
+        v = v_ref[0, 0].astype(target)
         scores = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             * scale
-        )  # [bq, bk]
+        )  # [bq, bk] f32
         scores = _apply_softcap(scores, softcap)
         qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -989,7 +1018,7 @@ def _flash_prefill_kernel(
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            probs, v, (((1,), (0,)), ((), ())),
+            probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 

@@ -161,9 +161,12 @@ class TestFp8PallasKernels:
             vp3[:, 1:].astype(jnp.float32), vp_ref[:, 1:].astype(jnp.float32)
         )
 
-    def test_chunked_prefill_kernel_fp8_pool(self):
+    @pytest.mark.parametrize("q_dtype", [jnp.float32, jnp.bfloat16])
+    def test_chunked_prefill_kernel_fp8_pool(self, q_dtype):
+        """fp8 pages upcast to the query dtype inside the kernel — both
+        the f32 (tests) and bf16 (production MXU full-rate) paths."""
         B, C, n_heads, n_kv, d, page_size, pps = 2, 8, 4, 2, 16, 8, 3
-        q = _rand(jax.random.key(12), (B, C, n_heads, d))
+        q = _rand(jax.random.key(12), (B, C, n_heads, d)).astype(q_dtype)
         kp, vp, bt, _ = _fp8_paged_setup(
             jax.random.key(13), S=B, n_kv=n_kv, d=d, page_size=page_size,
             pages_per_seq=pps, ctx_lens=[0] * B,
@@ -185,9 +188,11 @@ class TestFp8PallasKernels:
             scale=d**-0.5, interpret=True,
         )
         valid = np.asarray(q_positions) >= 0
+        tol = 2e-5 if q_dtype == jnp.float32 else 3e-2
         np.testing.assert_allclose(
-            np.asarray(out)[valid], np.asarray(ref)[valid],
-            rtol=2e-5, atol=2e-5,
+            np.asarray(out, np.float32)[valid],
+            np.asarray(ref, np.float32)[valid],
+            rtol=tol, atol=tol,
         )
 
 

@@ -178,6 +178,32 @@ def test_paged_decode_v2_dead_chunk_then_live():
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
+def test_flash_prefill_bf16_matches_reference():
+    """bf16 inputs: the kernel multiplies in bf16 (f32 softmax stats +
+    accumulator) — the MXU full-rate path — and must track the XLA
+    reference, whose einsums also multiply bf16 in bf16."""
+    B, T, n_heads, n_kv, d = 2, 32, 4, 2, 16
+    lengths = jnp.asarray([T, T // 2], jnp.int32)
+    kq, kk, kv = jax.random.split(jax.random.key(40), 3)
+    q = _rand(kq, (B, T, n_heads, d)).astype(jnp.bfloat16)
+    k = _rand(kk, (B, T, n_kv, d)).astype(jnp.bfloat16)
+    v = _rand(kv, (B, T, n_kv, d)).astype(jnp.bfloat16)
+    ref = ref_ops.full_prefill_attention(
+        q, k, v, scale=d**-0.5, lengths=lengths
+    )
+    out = pk.flash_prefill_attention_pallas(
+        q, k, v, lengths, jnp.asarray([_WINDOW_DISABLED], jnp.int32),
+        scale=d**-0.5, block_q=16, block_kv=16, interpret=True,
+    )
+    for b in range(B):
+        n = int(lengths[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n], np.float32),
+            np.asarray(ref[b, :n], np.float32),
+            rtol=3e-2, atol=3e-2,
+        )
+
+
 @pytest.mark.parametrize(
     "n_heads,n_kv,window,softcap,T,block",
     [
