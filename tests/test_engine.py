@@ -452,6 +452,25 @@ class TestChunkedPrefill:
         for rid, out in golden.items():
             assert chunked[rid].token_ids == out.token_ids, rid
 
+    @pytest.mark.slow
+    def test_long_context_chunked_matches_bucketed(self):
+        """A 4k-token prompt through 256-token chunks against the paged
+        cache must reproduce the bucketed whole-prompt greedy output —
+        the long-context path (many chunks, many pages, frontier math at
+        scale) not covered by the short soaks."""
+        reqs = [
+            ("long4k", "z" * 4096, greedy(8)),
+            ("bystander", "short prompt", greedy(8)),
+        ]
+        engine = dict(
+            max_model_len=8192, num_pages=1100, max_num_seqs=2, page_size=8
+        )
+        golden = self._run(reqs, **engine)
+        chunked = self._run(reqs, prefill_chunk_size=256, **engine)
+        for rid, out in golden.items():
+            assert chunked[rid].token_ids == out.token_ids, rid
+        assert len(chunked["long4k"].token_ids) == 8
+
     def test_chunk_interleaves_with_running_decode(self):
         """A long admission while others decode must not change anyone's
         greedy output (interleaved decode steps between chunks)."""
