@@ -30,8 +30,12 @@ Env knobs: LLMQ_BENCH_PRESET, LLMQ_BENCH_REQUESTS, LLMQ_BENCH_PROMPT,
 LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS, LLMQ_BENCH_KV_DTYPE (fp8 = e5m2 KV
 cache), LLMQ_BENCH_INIT_RETRIES (default 2),
 LLMQ_BENCH_INIT_TIMEOUT (seconds per backend probe, default 120),
-LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 2700 —
-sized for the slot ladder running the headline at both candidates).
+LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 3300 —
+sized for the quantized attempt plus the slot ladder running the
+headline at both candidates),
+LLMQ_BENCH_TRY_QUANT=0 (skip the int8+fp8 subprocess attempt that
+otherwise runs first on accelerators and wins the emit when it clearly
+beats baseline), LLMQ_BENCH_QUANT_TIMEOUT (its budget, default 900 s).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 
 def _emit(payload: dict) -> None:
@@ -48,6 +53,11 @@ def _emit(payload: dict) -> None:
 
 
 def _emit_failure(tag: str, error: str) -> None:
+    if _QUANT_FALLBACK is not None:
+        # The quantized attempt already produced a real measurement —
+        # a later bf16 failure must not discard it for a 0.0 line.
+        _emit({**_QUANT_FALLBACK, "note": f"bf16 run failed: {error}"})
+        return
     _emit(
         {
             "metric": f"decode_tokens_per_sec_per_chip[{tag}]",
@@ -305,6 +315,68 @@ def _kernel_ab_probe_main() -> None:
     print(choice)
 
 
+# Set when the quantized attempt produced a valid-but-not-clearly-winning
+# number: the bf16 ladder runs too, and the better line is emitted. A
+# module global (not a main() local) on purpose: the failure emitters —
+# including the watchdog thread — must prefer this real measurement over
+# a 0.0 failure line if the later bf16 run dies.
+_QUANT_FALLBACK: Optional[dict] = None
+
+
+def _try_quantized_headline() -> Optional[dict]:
+    """Attempt the strongest measured-candidate config — int8 weights +
+    fp8 KV cache at the 3B preset — in a SUBPROCESS, and return its
+    result line if it clearly clears the baseline.
+
+    Why a child process: the quantized fast paths are CPU-validated but
+    this may be the first time they touch the deployment chip (e.g.
+    Mosaic could reject fp8 memrefs on some TPU generations) — a crash
+    or hang must cost its budget, never the proven bf16 run. Why only
+    ``vs_baseline >= 1.05``: below that the bf16 ladder might win, so
+    the parent falls through and measures it. Opt out with
+    ``LLMQ_BENCH_TRY_QUANT=0``.
+    """
+    import subprocess
+
+    budget = float(os.environ.get("LLMQ_BENCH_QUANT_TIMEOUT", 900))
+    env = dict(
+        os.environ,
+        LLMQ_BENCH_DTYPE="int8",
+        LLMQ_BENCH_KV_DTYPE="fp8",
+        LLMQ_BENCH_PRESET="qwen2.5-3b",
+        LLMQ_BENCH_QUANT_CHILD="1",
+        # The child's own watchdog fires just inside the subprocess
+        # timeout so it can still print its JSON before the kill.
+        LLMQ_BENCH_DEADLINE=str(max(60.0, budget - 20.0)),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            timeout=budget,
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+        sys.stderr.write(proc.stderr[-1500:])
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                payload = json.loads(line)
+                if "error" in payload:
+                    print(
+                        f"bench: quantized attempt failed "
+                        f"({payload['error'][:200]}); falling back to bf16",
+                        file=sys.stderr,
+                    )
+                    return None
+                return payload
+        print("bench: quantized attempt printed no JSON", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("bench: quantized attempt timed out; bf16 run", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"bench: quantized attempt error {exc!r}", file=sys.stderr)
+    return None
+
+
 def main() -> None:
     # Kernel A/B FIRST, while no backend is initialised in this process:
     # on standard TPU VMs libtpu is exclusive, so the probing child must
@@ -312,14 +384,44 @@ def main() -> None:
     # a healthy backend probe so a dead tunnel costs one probe timeout,
     # not the A/B budget too.
     ab_choice = None
+    quant_eligible = (
+        os.environ.get("LLMQ_BENCH_TRY_QUANT", "1").lower()
+        not in ("0", "false")
+        and not os.environ.get("LLMQ_BENCH_QUANT_CHILD")
+        and not os.environ.get("LLMQ_BENCH_DTYPE")
+        and not os.environ.get("LLMQ_BENCH_KV_DTYPE")
+        and not os.environ.get("LLMQ_BENCH_PRESET")
+    )
     if (
         os.environ.get("JAX_PLATFORMS", "") != "cpu"
-        and not os.environ.get("LLMQ_DECODE_KERNEL")
+        and (quant_eligible or not os.environ.get("LLMQ_DECODE_KERNEL"))
         and _probe_backend_subprocess(
             float(os.environ.get("LLMQ_BENCH_INIT_TIMEOUT", 120))
         )
     ):
-        ab_choice = pick_decode_kernel()
+        # Quantized-config attempt first (it owns the chip start to
+        # finish, including its own kernel A/B at the fp8 pool dtype).
+        # Skipped when the operator pinned any of the knobs it would
+        # override — explicit settings mean explicit intent.
+        if quant_eligible:
+            quant = _try_quantized_headline()
+            if quant is not None and quant.get("vs_baseline", 0) >= 1.05:
+                # Clear win over every bf16 number ever measured here
+                # (best: 0.937): skip the bf16 run entirely.
+                _emit(quant)
+                return
+            if quant is not None:
+                # Not a clear win — measure bf16 too and emit the better.
+                print(
+                    f"bench: quantized attempt at "
+                    f"{quant.get('vs_baseline')}x baseline; measuring bf16 "
+                    "to compare",
+                    file=sys.stderr,
+                )
+                global _QUANT_FALLBACK
+                _QUANT_FALLBACK = quant
+        if not os.environ.get("LLMQ_DECODE_KERNEL"):
+            ab_choice = pick_decode_kernel()
 
     jax, devices, backend_note = init_devices()
     if jax is None or not devices:
@@ -498,6 +600,11 @@ def main() -> None:
     }
     if backend_note:
         payload["note"] = backend_note
+    if (
+        _QUANT_FALLBACK is not None
+        and _QUANT_FALLBACK.get("vs_baseline", 0) > payload["vs_baseline"]
+    ):
+        payload = _QUANT_FALLBACK
     _emit(payload)
 
 
@@ -508,7 +615,7 @@ elif __name__ == "__main__":
     # compile / dispatch blocks in C). If the run exceeds the deadline,
     # the failure JSON still gets emitted before exiting.
     _cancel = _arm_emit_watchdog(
-        float(os.environ.get("LLMQ_BENCH_DEADLINE", 2700)),
+        float(os.environ.get("LLMQ_BENCH_DEADLINE", 3300)),
         "benchmark exceeded LLMQ_BENCH_DEADLINE (device dispatch hung?)",
     )
     try:
