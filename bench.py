@@ -377,6 +377,45 @@ def _try_quantized_headline() -> Optional[dict]:
     return None
 
 
+def _fp8_kernel_canary() -> None:
+    """On-device parity check of the compiled fp8-pool decode path
+    against the XLA reference (same fp8 bits, both dequantize on load —
+    any disagreement beyond dot-order noise means a miscompile).
+    Raises on mismatch; the caller lets it crash the quant child."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llmq_tpu.ops import dispatch
+
+    S, H, NKV, D, PAGE, PPS, L = 8, 16, 2, 128, 128, 3, 2
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = (jax.random.normal(kq, (S, H, D), jnp.float32) * 0.3).astype(
+        jnp.bfloat16
+    )
+    P = 1 + S * PPS
+    kp = (jax.random.normal(kk, (L, P, PAGE, NKV, D), jnp.float32) * 0.3)
+    vp = (jax.random.normal(kv, (L, P, PAGE, NKV, D), jnp.float32) * 0.3)
+    kp, vp = kp.astype(jnp.float8_e5m2), vp.astype(jnp.float8_e5m2)
+    bt = jnp.arange(1, 1 + S * PPS, dtype=jnp.int32).reshape(S, PPS)
+    cl = jnp.asarray([1, 40, 128, 129, 200, 255, 300, 332], jnp.int32)
+    li = jnp.asarray(1, jnp.int32)
+    outs = {}
+    for backend in ("pallas", "xla"):
+        outs[backend] = np.asarray(
+            dispatch.decode_attention(
+                q, kp, vp, bt, cl, scale=D**-0.5, backend=backend, layer=li
+            ),
+            np.float32,
+        )
+    err = np.max(np.abs(outs["pallas"] - outs["xla"]))
+    if not np.isfinite(err) or err > 0.05:
+        raise RuntimeError(
+            f"fp8 decode-kernel canary failed: |pallas - xla| = {err}"
+        )
+    print(f"bench: fp8 kernel canary ok (|diff| {err:.2e})", file=sys.stderr)
+
+
 def main() -> None:
     # Kernel A/B FIRST, while no backend is initialised in this process:
     # on standard TPU VMs libtpu is exclusive, so the probing child must
@@ -422,6 +461,9 @@ def main() -> None:
                 _QUANT_FALLBACK = quant
         if not os.environ.get("LLMQ_DECODE_KERNEL"):
             ab_choice = pick_decode_kernel()
+            # Export immediately: everything downstream — the fp8
+            # canary included — must trace with the measured winner.
+            os.environ["LLMQ_DECODE_KERNEL"] = ab_choice
 
     jax, devices, backend_note = init_devices()
     if jax is None or not devices:
@@ -439,6 +481,15 @@ def main() -> None:
     from llmq_tpu.parallel import make_mesh
 
     platform = devices[0].platform
+    if os.environ.get("LLMQ_BENCH_QUANT_CHILD") and platform == "tpu":
+        # Numerics canary: this may be the first time the fp8-pool
+        # decode kernel meets the deployment chip. A Mosaic miscompile
+        # would otherwise produce a *plausible throughput number from a
+        # broken engine* — compare the compiled kernel against the XLA
+        # reference on-device and abort (-> parent falls back to bf16)
+        # rather than benchmark garbage.
+        _fp8_kernel_canary()
+
     try:
         limit = (devices[0].memory_stats() or {}).get("bytes_limit")
     except Exception:  # noqa: BLE001
@@ -476,8 +527,6 @@ def main() -> None:
         file=sys.stderr,
     )
     page_size = 8 if on_cpu else 128
-    if not on_cpu and ab_choice:
-        os.environ["LLMQ_DECODE_KERNEL"] = ab_choice
     # quantize-at-init: the bf16 tree alone would not fit HBM at 9B.
     params = init_params(config, jax.random.key(0), dtype=dtype, quantize=int8)
     mesh = make_mesh(devices=devices)  # all local devices, tp
