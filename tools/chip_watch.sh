@@ -30,10 +30,13 @@ run() {  # run <timeout-s> <name> <cmd...>
 #    counts — decides the production default.
 run 900 ab_s224 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 224 128
 run 600 ab_s192 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 192 128
-# 2. bf16 headline (A/B + slot ladder built in; autotune cache now warm).
-run 1800 bench_bf16_2 python bench.py
+# 2. Driver-style run: quant-first attempt + canary + fallback, exactly
+#    what the end-of-round BENCH will execute.
+run 3300 bench_driver_style python bench.py
+# 2b. bf16 headline alone (A/B + slot ladder built in).
+run 1800 bench_bf16_2 env LLMQ_BENCH_TRY_QUANT=0 python bench.py
 # 3. Slot-count question: 192 vs 224 at the same kernel.
-run 1200 bench_s192 env LLMQ_BENCH_SEQS=192 python bench.py
+run 1200 bench_s192 env LLMQ_BENCH_TRY_QUANT=0 LLMQ_BENCH_SEQS=192 python bench.py
 # 4. int8 3B — the strongest headline candidate: decode is weight-bound
 #    at 3B, KV fits, and prefill (compute-bound) is unchanged.
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=qwen2.5-3b python bench.py
@@ -42,7 +45,7 @@ run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=qwen2.5-3b py
 run 1800 bench_int8_3b_pallas env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=qwen2.5-3b LLMQ_INT8_MATMUL=pallas python bench.py
 # 6. fp8 KV cache at 3B: halves decode-attention bandwidth (the other
 #    half of the decode step next to the int8 weight stream).
-run 1800 bench_fp8kv_3b env LLMQ_BENCH_KV_DTYPE=fp8 python bench.py
+run 1800 bench_fp8kv_3b env LLMQ_BENCH_KV_DTYPE=fp8 LLMQ_BENCH_PRESET=qwen2.5-3b python bench.py
 run 1800 bench_int8_fp8kv_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 LLMQ_BENCH_PRESET=qwen2.5-3b python bench.py
 # 7. int8 9B north star (chunked init fix): measurable on one chip, even
 #    if KV pressure keeps it off the headline. Slots capped to what the
@@ -51,7 +54,7 @@ run 1800 bench_int8_fp8kv_3b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 L
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_PRESET=tower-plus-9b LLMQ_BENCH_SEQS=48 python bench.py
 run 1800 bench_int8_fp8kv_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 LLMQ_BENCH_PRESET=tower-plus-9b LLMQ_BENCH_SEQS=96 python bench.py
 # 8. Param auto-layout A/B against step 2.
-run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
+run 1800 bench_autolayout env LLMQ_BENCH_TRY_QUANT=0 LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
 # 9. Queue-drain artifact on the real engine (VERDICT weak #4): the
 #    end-to-end broker->worker->results harness at a TPU preset.
 run 1800 queue_drain_tpu python performance_benchmark.py \
