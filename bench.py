@@ -388,6 +388,15 @@ def _fp8_kernel_canary() -> None:
 
     from llmq_tpu.ops import dispatch
 
+    if dispatch.resolve_backend() != "pallas":
+        # LLMQ_ATTN_BACKEND=xla: the engine won't run a Pallas kernel,
+        # so there is nothing to validate (and a Mosaic failure here
+        # would spuriously kill a run that would have been fine).
+        print("bench: fp8 canary skipped (xla backend)", file=sys.stderr)
+        return
+
+    from llmq_tpu.ops import attention as xla_ops
+
     S, H, NKV, D, PAGE, PPS, L = 8, 16, 2, 128, 128, 3, 2
     kq, kk, kv = jax.random.split(jax.random.key(7), 3)
     q = (jax.random.normal(kq, (S, H, D), jnp.float32) * 0.3).astype(
@@ -400,20 +409,54 @@ def _fp8_kernel_canary() -> None:
     bt = jnp.arange(1, 1 + S * PPS, dtype=jnp.int32).reshape(S, PPS)
     cl = jnp.asarray([1, 40, 128, 129, 200, 255, 300, 332], jnp.int32)
     li = jnp.asarray(1, jnp.int32)
-    outs = {}
-    for backend in ("pallas", "xla"):
-        outs[backend] = np.asarray(
-            dispatch.decode_attention(
-                q, kp, vp, bt, cl, scale=D**-0.5, backend=backend, layer=li
-            ),
-            np.float32,
+    kern, fused = dispatch.decode_kernel_plan(H, NKV)
+    if fused:
+        # v3 writes the step's fp8 K/V rows in-kernel — a DISTINCT code
+        # path from plain decode; validate exactly what the engine runs.
+        kn = (jax.random.normal(jax.random.key(8), (S, NKV, D),
+                                jnp.float32) * 0.3).astype(jnp.bfloat16)
+        vn = (jax.random.normal(jax.random.key(9), (S, NKV, D),
+                                jnp.float32) * 0.3).astype(jnp.bfloat16)
+        # Reference FIRST: the fused kernel aliases (donates) the pool
+        # buffers, so kp/vp are unusable after it runs.
+        positions = (cl - 1)[:, None]
+        kp_r, vp_r = xla_ops.write_kv_pages(
+            kp, vp, kn[:, None], vn[:, None], bt, positions, layer=li
         )
-    err = np.max(np.abs(outs["pallas"] - outs["xla"]))
+        ref = xla_ops.paged_decode_attention(
+            q, kp_r, vp_r, bt, cl, scale=D**-0.5, layer=li
+        )
+        jax.block_until_ready(ref)
+        out_p, kp_p, vp_p = dispatch.decode_attention_fused_write(
+            q, kp, vp, kn, vn, bt, cl, scale=D**-0.5, layer=li
+        )
+        pool_err = np.max(
+            np.abs(
+                np.asarray(kp_p[li, 1:], np.float32)
+                - np.asarray(kp_r[li, 1:], np.float32)
+            )
+        )
+        if pool_err > 0:
+            raise RuntimeError(
+                f"fp8 v3 canary: fused KV write diverged (|diff| {pool_err})"
+            )
+        err = np.max(np.abs(np.asarray(out_p, np.float32) - np.asarray(ref, np.float32)))
+    else:
+        out_p = dispatch.decode_attention(
+            q, kp, vp, bt, cl, scale=D**-0.5, backend="pallas", layer=li
+        )
+        ref = xla_ops.paged_decode_attention(
+            q, kp, vp, bt, cl, scale=D**-0.5, layer=li
+        )
+        err = np.max(np.abs(np.asarray(out_p, np.float32) - np.asarray(ref, np.float32)))
     if not np.isfinite(err) or err > 0.05:
         raise RuntimeError(
-            f"fp8 decode-kernel canary failed: |pallas - xla| = {err}"
+            f"fp8 decode-kernel canary failed ({kern}): |pallas - xla| = {err}"
         )
-    print(f"bench: fp8 kernel canary ok (|diff| {err:.2e})", file=sys.stderr)
+    print(
+        f"bench: fp8 kernel canary ok ({kern}, |diff| {err:.2e})",
+        file=sys.stderr,
+    )
 
 
 def main() -> None:
