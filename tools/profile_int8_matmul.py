@@ -98,7 +98,8 @@ bytes_bf16 = H * I * 2
 bytes_int8 = H * I * 1
 print(f"bf16 XLA:    {ms_bf16:.3f} ms/layer ({bytes_bf16/ms_bf16*1e3/2**30:.0f} GiB/s eff)")
 print(f"int8 XLA:    {ms_int8:.3f} ms/layer ({bytes_int8/ms_int8*1e3/2**30:.0f} GiB/s int8-eff)")
-print(f"int8 Pallas: {ms_pallas:.3f} ms/layer ({bytes_int8/ms_pallas*1e3/2**30:.0f} GiB/s int8-eff)")
+gibs = bytes_int8 / ms_pallas * 1e3 / 2**30
+print(f"int8 Pallas: {ms_pallas:.3f} ms/layer ({gibs:.0f} GiB/s int8-eff)")
 ratio = ms_int8 / ms_bf16
 verdict = "FUSED (int8 wins as-is)" if ratio < 0.8 else (
     "NOT fused — enable LLMQ_INT8_MATMUL=pallas"

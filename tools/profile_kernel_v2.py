@@ -80,7 +80,8 @@ print(f"v2 manual-DMA: {ms:.3f} ms/layer -> x{L}: {ms*L:.1f} ms/step  "
 
 a = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
 b = paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
-print("max|diff| v2 vs v1 on TPU:", float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))))
+diff = jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+print("max|diff| v2 vs v1 on TPU:", float(diff))
 
 # v3 fused-KV-write vs v1/v2 + their separate XLA scatter — the engine's
 # actual per-layer cost for each choice (same framing as the bench A/B:
