@@ -30,7 +30,7 @@ Env knobs: LLMQ_BENCH_PRESET, LLMQ_BENCH_REQUESTS, LLMQ_BENCH_PROMPT,
 LLMQ_BENCH_GEN, LLMQ_BENCH_SEQS, LLMQ_BENCH_KV_DTYPE (fp8 = e5m2 KV
 cache), LLMQ_BENCH_INIT_RETRIES (default 2),
 LLMQ_BENCH_INIT_TIMEOUT (seconds per backend probe, default 120),
-LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 3300 —
+LLMQ_BENCH_DEADLINE (whole-run watchdog seconds, default 3600 —
 sized for the quantized attempt plus the slot ladder running the
 headline at both candidates),
 LLMQ_BENCH_TRY_QUANT=0 (skip the int8+fp8 subprocess attempt that
@@ -431,16 +431,18 @@ def _fp8_kernel_canary() -> None:
         out_p, kp_p, vp_p = dispatch.decode_attention_fused_write(
             q, kp, vp, kn, vn, bt, cl, scale=D**-0.5, layer=li
         )
-        pool_err = np.max(
-            np.abs(
-                np.asarray(kp_p[li, 1:], np.float32)
-                - np.asarray(kp_r[li, 1:], np.float32)
+        for name, got, want in (("K", kp_p, kp_r), ("V", vp_p, vp_r)):
+            pool_err = np.max(
+                np.abs(
+                    np.asarray(got[li, 1:], np.float32)
+                    - np.asarray(want[li, 1:], np.float32)
+                )
             )
-        )
-        if pool_err > 0:
-            raise RuntimeError(
-                f"fp8 v3 canary: fused KV write diverged (|diff| {pool_err})"
-            )
+            if pool_err > 0:
+                raise RuntimeError(
+                    f"fp8 v3 canary: fused {name} write diverged "
+                    f"(|diff| {pool_err})"
+                )
         err = np.max(np.abs(np.asarray(out_p, np.float32) - np.asarray(ref, np.float32)))
     else:
         out_p = dispatch.decode_attention(
@@ -554,11 +556,17 @@ def main() -> None:
     # BOTH 224 and 192 and keep the fastest (the ladder below runs the
     # headline at every candidate that fits; r05: 224 fit but ran ~3%
     # slower than 192).
+    config = get_preset(preset)
     seqs_env = os.environ.get("LLMQ_BENCH_SEQS")
     if seqs_env:
         seqs_candidates = [int(seqs_env)]
     elif on_cpu:
         seqs_candidates = [4]
+    elif int8 and config.num_params() > 5e9:
+        # A ~9B int8 model leaves only ~5 GB for KV on a 16 GB chip
+        # (fp8 KV doubles the tokens that buys): 3B-scale slot counts
+        # would just burn builds on guaranteed OOMs.
+        seqs_candidates = [96, 64]
     elif int8:
         # int8 weights free ~3 GB next to a 3B model: 256 slots (which
         # OOMs at bf16) likely fits and amortizes the weight stream
@@ -566,8 +574,6 @@ def main() -> None:
         seqs_candidates = [256, 224, 192]
     else:
         seqs_candidates = [224, 192]
-
-    config = get_preset(preset)
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
     print(
         f"bench: preset={preset} ({config.num_params()/1e9:.2f}B) on "
@@ -724,7 +730,7 @@ elif __name__ == "__main__":
     # compile / dispatch blocks in C). If the run exceeds the deadline,
     # the failure JSON still gets emitted before exiting.
     _cancel = _arm_emit_watchdog(
-        float(os.environ.get("LLMQ_BENCH_DEADLINE", 3300)),
+        float(os.environ.get("LLMQ_BENCH_DEADLINE", 3600)),
         "benchmark exceeded LLMQ_BENCH_DEADLINE (device dispatch hung?)",
     )
     try:

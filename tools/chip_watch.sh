@@ -32,7 +32,7 @@ run 900 ab_s224 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 224 128
 run 600 ab_s192 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 192 128
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
-run 3400 bench_driver_style python bench.py
+run 3900 bench_driver_style python bench.py
 # 2b. bf16 headline alone (A/B + slot ladder built in).
 run 1800 bench_bf16_2 env LLMQ_BENCH_TRY_QUANT=0 python bench.py
 # 3. Slot-count question: 192 vs 224 at the same kernel.
