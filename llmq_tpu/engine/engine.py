@@ -1357,6 +1357,13 @@ class EngineCore:
     def stats(self) -> Dict[str, Any]:
         elapsed = max(1e-9, time.monotonic() - self._started_at)
         s = self.scheduler.stats()
+        from llmq_tpu.ops import dispatch as _dispatch
+
+        kern, _fused = _dispatch.decode_kernel_plan(
+            self.model_config.num_heads,
+            self.model_config.num_kv_heads,
+            mesh=self.mesh,
+        )
         s.update(
             prompt_tokens=self.total_prompt_tokens,
             generated_tokens=self.total_generated_tokens,
@@ -1364,6 +1371,11 @@ class EngineCore:
             prefills=self.prefills,
             tokens_per_sec=self.total_generated_tokens / elapsed,
             devices=int(np.prod(list(self.mesh.shape.values()))),
+            # What this engine actually runs — the autotuned kernel and
+            # the pool dtype — so operators can see the calibration in
+            # heartbeats instead of guessing from env vars.
+            decode_kernel=kern,
+            kv_dtype=str(jnp.dtype(self.cfg.kv_dtype)),
         )
         return s
 

@@ -213,6 +213,10 @@ class TestEngineCore:
         assert s["generated_tokens"] == 5
         assert s["prefills"] == 1
         assert s["prompt_tokens"] == 5
+        # Calibration surfaces in heartbeats: what the engine actually
+        # runs, not what env vars suggest.
+        assert s["decode_kernel"] == "xla"  # CPU backend
+        assert s["kv_dtype"] == "float32"
 
 
 class TestSharding:
