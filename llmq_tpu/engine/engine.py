@@ -40,6 +40,10 @@ TPU-native design differs from vLLM's CUDA core on purpose:
   engine runs single-chip or tensor-parallel across a slice unchanged.
 - **Sampling on device.** Per-slot temperature/top-k/top-p/seed arrays;
   the model step and the sampler fuse into one executable.
+- **Memory dtypes.** Weight-only int8 (``models/quant.py``) and an fp8
+  (float8_e5m2) KV cache (``kv_dtype="fp8"``) are first-class: pools
+  and params stay narrow in HBM, kernels convert on-chip, and the
+  decode-kernel autotune calibrates at the production pool dtype.
 
 An ``AsyncEngine`` wrapper runs the step loop on a dedicated thread and
 bridges to asyncio futures, mirroring the AsyncLLMEngine surface the
