@@ -1,0 +1,103 @@
+"""bench.py orchestration logic (no accelerator needed).
+
+The headline benchmark is the round's reporting artifact, so its
+decision logic — quantized-attempt parsing, failure-line fallbacks,
+preset picking — gets unit coverage beyond the CPU smoke runs.
+"""
+
+import json
+import subprocess
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.unit
+
+
+def _completed(stdout: str, stderr: str = "", rc: int = 0):
+    return subprocess.CompletedProcess(
+        args=["bench"], returncode=rc, stdout=stdout, stderr=stderr
+    )
+
+
+@pytest.fixture(autouse=True)
+def _reset_fallback():
+    bench._QUANT_FALLBACK = None
+    yield
+    bench._QUANT_FALLBACK = None
+
+
+class TestQuantAttemptParsing:
+    def _patch_run(self, monkeypatch, proc=None, exc=None):
+        def fake_run(*a, **kw):
+            if exc is not None:
+                raise exc
+            return proc
+
+        # bench imports subprocess inside the function, so patching the
+        # real module's run is what it sees.
+        monkeypatch.setattr(subprocess, "run", fake_run)
+
+    def test_valid_payload_returned(self, monkeypatch):
+        payload = {"metric": "m", "value": 5000.0, "vs_baseline": 1.2}
+        self._patch_run(
+            monkeypatch, _completed("noise\n" + json.dumps(payload) + "\n")
+        )
+        assert bench._try_quantized_headline() == payload
+
+    def test_error_payload_rejected(self, monkeypatch):
+        payload = {"metric": "m", "value": 0.0, "error": "boom"}
+        self._patch_run(monkeypatch, _completed(json.dumps(payload)))
+        assert bench._try_quantized_headline() is None
+
+    def test_no_json_rejected(self, monkeypatch):
+        self._patch_run(monkeypatch, _completed("no json here\n"))
+        assert bench._try_quantized_headline() is None
+
+    def test_timeout_rejected(self, monkeypatch):
+        self._patch_run(
+            monkeypatch,
+            exc=subprocess.TimeoutExpired(cmd="bench", timeout=1),
+        )
+        assert bench._try_quantized_headline() is None
+
+    def test_last_json_line_wins(self, monkeypatch):
+        early = {"metric": "m", "value": 1.0, "vs_baseline": 0.1}
+        final = {"metric": "m", "value": 2.0, "vs_baseline": 0.2}
+        out = json.dumps(early) + "\n" + json.dumps(final) + "\n"
+        self._patch_run(monkeypatch, _completed(out))
+        assert bench._try_quantized_headline() == final
+
+
+class TestFailureEmit:
+    def test_plain_failure_line(self, capsys):
+        bench._emit_failure("failed", "boom")
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["value"] == 0.0
+        assert line["error"] == "boom"
+
+    def test_failure_prefers_quant_fallback(self, capsys):
+        bench._QUANT_FALLBACK = {
+            "metric": "decode_tokens_per_sec_per_chip[qwen2.5-3b]",
+            "value": 4800.0,
+            "vs_baseline": 1.02,
+        }
+        bench._emit_failure("failed", "RESOURCE_EXHAUSTED")
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["value"] == 4800.0
+        assert "bf16 run failed" in line["note"]
+        assert "error" not in line
+
+
+class TestPickPreset:
+    def test_cpu_is_tiny(self):
+        assert bench.pick_preset(None, "cpu") == "tiny"
+
+    def test_16gb_bf16_picks_3b(self):
+        assert bench.pick_preset(16 * 2**30, "tpu") == "qwen2.5-3b"
+
+    def test_16gb_int8_picks_9b(self):
+        assert bench.pick_preset(16 * 2**30, "tpu", int8=True) == (
+            "tower-plus-9b"
+        )
