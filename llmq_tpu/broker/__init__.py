@@ -12,12 +12,27 @@ durable queues, per-consumer prefetch (QoS), ack / reject-requeue,
 - ``tcp://host:port`` — the llmq-tpu broker daemon (``llmq-tpu broker serve``)
   for multi-host deployments
 - ``amqp://...``     — RabbitMQ passthrough when aio-pika is installed
+- ``chaos+<scheme>://...`` — deterministic fault-injection decorator over any
+  of the above (connection kills / delays / duplicate deliveries), for tests
 
 All implement the ``Broker`` interface in ``base.py``; the high-level facade
-used by workers/CLI is ``BrokerManager`` in ``manager.py``.
+used by workers/CLI is ``BrokerManager`` in ``manager.py``, which wraps the
+transport in ``ResilientBroker`` (``resilient.py``) so sessions survive
+mid-run connection loss: re-dial with capped backoff, topology + consumer
+replay, generation-fenced settles, and a bounded publish outbox.
 """
 
 from llmq_tpu.broker.base import Broker, DeliveredMessage, connect_broker
+from llmq_tpu.broker.chaos import ChaosBroker
 from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.broker.resilient import ResilientBroker, SessionStats
 
-__all__ = ["Broker", "DeliveredMessage", "BrokerManager", "connect_broker"]
+__all__ = [
+    "Broker",
+    "DeliveredMessage",
+    "BrokerManager",
+    "ChaosBroker",
+    "ResilientBroker",
+    "SessionStats",
+    "connect_broker",
+]

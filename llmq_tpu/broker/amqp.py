@@ -111,6 +111,24 @@ class AmqpBroker(Broker):
     async def connect(self) -> None:
         self._conn = await aio_pika.connect_robust(self.url)
         self._channel = await self._conn.channel()
+        # Surface transport loss to the resilience layer. connect_robust
+        # re-dials channels on its own, but consumers registered through
+        # ResilientBroker still need a uniform loss signal so topology and
+        # consumer replay behave identically across backends. Guarded:
+        # minimal AMQP stand-ins (tests) may not expose callback hooks.
+        callbacks = getattr(self._conn, "close_callbacks", None)
+        if callbacks is not None:
+            try:
+                callbacks.add(lambda *_args, **_kw: self._notify_connection_lost())
+            except Exception:  # noqa: BLE001 — optional wiring only
+                pass
+
+    @property
+    def is_connected(self) -> bool:
+        if self._conn is None:
+            return False
+        closed = getattr(self._conn, "is_closed", None)
+        return True if closed is None else not bool(closed)
 
     async def close(self) -> None:
         if self._conn is not None:

@@ -132,7 +132,31 @@ MessageHandler = Callable[[DeliveredMessage], Awaitable[None]]
 
 
 class Broker(abc.ABC):
-    """Transport-level broker API (one connection)."""
+    """Transport-level broker API (one connection).
+
+    Connection-loss signalling: implementations that can detect a dropped
+    transport (tcp, amqp, chaos) call ``_notify_connection_lost`` when it
+    happens; a session layer (``ResilientBroker``) installs the
+    ``on_connection_lost`` callback to re-dial promptly instead of waiting
+    for the next operation to fail. Implementations that cannot lose a
+    connection (memory) never fire it.
+    """
+
+    #: Optional callback fired once per detected transport loss.
+    on_connection_lost: Optional[Callable[[], None]] = None
+
+    @property
+    def is_connected(self) -> bool:
+        """Best-effort transport liveness (True when unknowable)."""
+        return True
+
+    def _notify_connection_lost(self) -> None:
+        cb = self.on_connection_lost
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observer must not kill transport
+                pass
 
     @abc.abstractmethod
     async def connect(self) -> None: ...
@@ -213,6 +237,10 @@ async def connect_broker(
 def make_broker(url: str) -> Broker:
     """Instantiate (without connecting) the implementation for a broker URL."""
     scheme = url.split("://", 1)[0].lower() if "://" in url else ""
+    if scheme.startswith("chaos+"):
+        from llmq_tpu.broker.chaos import ChaosBroker
+
+        return ChaosBroker(url)
     if scheme == "memory":
         from llmq_tpu.broker.memory import MemoryBroker
 
@@ -231,5 +259,5 @@ def make_broker(url: str) -> Broker:
         return AmqpBroker(url)
     raise ValueError(
         f"Unsupported broker URL scheme: {url!r} "
-        "(expected memory://, file://, tcp://, or amqp://)"
+        "(expected memory://, file://, tcp://, amqp://, or a chaos+ prefix)"
     )

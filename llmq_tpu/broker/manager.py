@@ -17,7 +17,8 @@ import json
 import logging
 from typing import Any, Dict, List, Optional
 
-from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler, connect_broker
+from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
+from llmq_tpu.broker.resilient import ResilientBroker, SessionStats
 from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result
 from llmq_tpu.core.pipeline import PipelineConfig
@@ -51,9 +52,26 @@ class BrokerManager:
     def connected(self) -> bool:
         return self._broker is not None
 
+    @property
+    def transport_connected(self) -> bool:
+        """Is the underlying transport live right now (vs. reconnecting)?"""
+        return self._broker is not None and self._broker.is_connected
+
+    @property
+    def session_stats(self) -> Optional[SessionStats]:
+        """Reconnect/outbox/fence counters for the current session."""
+        return getattr(self._broker, "session", None)
+
     async def connect(self) -> None:
         if self._broker is None:
-            self._broker = await connect_broker(self.url)
+            broker = ResilientBroker(
+                self.url,
+                reconnect_base_delay=self.config.reconnect_base_delay_s,
+                reconnect_max_delay=self.config.reconnect_max_delay_s,
+                outbox_limit=self.config.outbox_limit,
+            )
+            await broker.connect()
+            self._broker = broker
             logger.debug("Connected to broker at %s", self.url)
 
     async def disconnect(self) -> None:

@@ -391,7 +391,13 @@ class MemoryBroker(Broker):
             self._tags.remove(consumer_tag)
 
     async def get(self, queue: str) -> Optional[DeliveredMessage]:
-        return self.core.get_one(queue)
+        # Track gets under a per-connection tag so close() requeues any
+        # message fetched but never settled — same at-least-once behavior
+        # a dropped TCP/AMQP connection gives its unacked deliveries.
+        tag = f"{self.namespace}-get-{id(self)}"
+        if tag not in self._tags:
+            self._tags.append(tag)
+        return self.core.get_one(queue, tag=tag)
 
     async def stats(self, queue: str) -> QueueStats:
         return self.core.stats(queue)

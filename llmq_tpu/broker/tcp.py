@@ -91,6 +91,9 @@ class BrokerServer:
         self._journal_file = None
         self._journal_ops = 0
         self._server: Optional[asyncio.AbstractServer] = None
+        # Live client connections; stop() closes them so a daemon restart
+        # actually severs sessions (clients then requeue/reconnect).
+        self._conn_writers: set = set()
         # (tag, message_id) -> unsettled DeliveredMessage awaiting client verdict
         self._pending_settles: Dict[tuple, DeliveredMessage] = {}
         # Journal consistency for state transitions that happen inside the core:
@@ -225,6 +228,13 @@ class BrokerServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        for writer in list(self._conn_writers):
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+        self._conn_writers.clear()
         if self._journal_file is not None:
             self._journal_file.close()
             self._journal_file = None
@@ -240,6 +250,7 @@ class BrokerServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn_tags: list[str] = []
+        self._conn_writers.add(writer)
         write_lock = asyncio.Lock()
 
         async def send(obj: Dict[str, Any]) -> None:
@@ -269,6 +280,7 @@ class BrokerServer:
                         }
                     )
         finally:
+            self._conn_writers.discard(writer)
             dead = set(conn_tags)
             for key in [k for k in self._pending_settles if k[0] in dead]:
                 self._pending_settles.pop(key, None)
@@ -438,6 +450,11 @@ class TcpBroker(Broker):
         self._undispatched: Dict[str, list] = {}
         self._write_lock: Optional[asyncio.Lock] = None
         self._req_seq = 0
+        self._lost = False
+
+    @property
+    def is_connected(self) -> bool:
+        return self._writer is not None and not self._lost
 
     async def connect(self) -> None:
         if self._writer is not None:
@@ -445,6 +462,7 @@ class TcpBroker(Broker):
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port, limit=MAX_FRAME
         )
+        self._lost = False
         self._write_lock = asyncio.Lock()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
         await self._request({"op": "ping"})
@@ -476,10 +494,12 @@ class TcpBroker(Broker):
                 logger.error("Protocol error from broker: %s", exc)
                 frame = None
             if frame is None:
+                self._lost = True
                 for fut in self._replies.values():
                     if not fut.done():
                         fut.set_exception(ConnectionError("broker connection lost"))
                 self._replies.clear()
+                self._notify_connection_lost()
                 return
             ftype = frame.get("type")
             if ftype == "reply":
@@ -535,16 +555,24 @@ class TcpBroker(Broker):
         )
 
     async def _request(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        if self._writer is None or self._write_lock is None:
+        if self._writer is None or self._write_lock is None or self._lost:
             raise ConnectionError("Broker is not connected")
         self._req_seq += 1
         req_id = f"r{self._req_seq}"
         obj = {**obj, "req_id": req_id}
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._replies[req_id] = fut
-        async with self._write_lock:
-            write_frame(self._writer, obj)
-            await self._writer.drain()
+        try:
+            async with self._write_lock:
+                write_frame(self._writer, obj)
+                await self._writer.drain()
+        except OSError as exc:
+            # Write-side detection: the recv loop may not have noticed yet.
+            self._replies.pop(req_id, None)
+            if not self._lost:
+                self._lost = True
+                self._notify_connection_lost()
+            raise ConnectionError(f"broker connection lost: {exc}") from exc
         return await fut
 
     # --- Broker interface -------------------------------------------------

@@ -14,9 +14,13 @@ from rich.table import Table
 
 from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import get_config
-from llmq_tpu.core.models import QueueStats, WorkerHealth
+from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import load_pipeline_config
-from llmq_tpu.workers.base import HEALTH_SUFFIX
+from llmq_tpu.workers.base import HEALTH_SUFFIX, HEARTBEAT_INTERVAL_S
+
+# A worker that has missed two consecutive heartbeats is presumed wedged
+# (or cut off from the broker) even if its old heartbeat is still readable.
+STALE_AFTER_S = 2 * HEARTBEAT_INTERVAL_S
 
 console = Console(stderr=False)
 
@@ -118,28 +122,54 @@ async def check_health(queue: str) -> None:
             # Non-destructive: keep heartbeats readable for the next check
             # (they expire via queue TTL anyway).
             await msg.reject(requeue=True)
+        # Split fresh from stale: a heartbeat older than 2× the heartbeat
+        # interval means the worker missed at least one beat — wedged, or
+        # cut off from the broker. Stale workers don't count as liveness.
+        now = utcnow()
+        stale_ids = {
+            wid
+            for wid, health in beats.items()
+            if (now - health.last_seen).total_seconds() > STALE_AFTER_S
+        }
+        fresh = {wid: h for wid, h in beats.items() if wid not in stale_ids}
         # Worker liveness: trust the broker's consumer census when it has
         # one (memory/tcp); fall back to heartbeats where it doesn't (file
         # broker can't see other processes' consumers).
         if stats.consumer_count is not None:
-            if stats.consumer_count == 0 and not beats:
+            if stats.consumer_count == 0 and not fresh:
                 healthy = False
                 console.print("[red]✗ No workers consuming[/red]")
-        elif not beats:
+        elif not fresh:
             healthy = False
             console.print(
-                "[red]✗ No worker heartbeats in the last 2 minutes[/red]"
+                "[red]✗ No fresh worker heartbeats in the last 2 minutes[/red]"
+            )
+        if stale_ids:
+            healthy = False
+            console.print(
+                f"[red]✗ {len(stale_ids)} worker(s) stale (no heartbeat in "
+                f"{STALE_AFTER_S:.0f}s)[/red]"
             )
         if beats:
             table = Table(title="Worker heartbeats (last 2 min)")
-            for col in ("worker", "status", "jobs", "avg ms", "last seen"):
+            for col in (
+                "worker",
+                "status",
+                "jobs",
+                "avg ms",
+                "reconnects",
+                "last seen",
+            ):
                 table.add_column(col)
-            for health in beats.values():
+            for wid, health in beats.items():
+                is_stale = wid in stale_ids
+                status = "[red]stale[/red]" if is_stale else health.status
                 table.add_row(
                     health.worker_id,
-                    health.status,
+                    status,
                     str(health.jobs_processed),
                     f"{health.avg_duration_ms:.0f}" if health.avg_duration_ms else "-",
+                    str(health.reconnects) if health.reconnects is not None else "-",
                     health.last_seen.strftime("%H:%M:%S"),
                 )
             console.print(table)

@@ -94,6 +94,23 @@ class Config(BaseModel):
         description="Messages prefetched (in flight) per worker consumer.",
     )
 
+    reconnect_base_delay_s: float = Field(
+        default_factory=lambda: _env_float("LLMQ_RECONNECT_BASE_S", default=0.5),
+        description="First re-dial backoff after a mid-run connection loss "
+        "(doubles per attempt, with jitter).",
+    )
+
+    reconnect_max_delay_s: float = Field(
+        default_factory=lambda: _env_float("LLMQ_RECONNECT_MAX_S", default=30.0),
+        description="Backoff ceiling for broker reconnect attempts.",
+    )
+
+    outbox_limit: int = Field(
+        default_factory=lambda: _env_int("LLMQ_OUTBOX_LIMIT", default=10_000),
+        description="Publishes parked during a broker outage before "
+        "publishers block (bounded so back-pressure still propagates).",
+    )
+
     # --- engine -----------------------------------------------------------
     hbm_utilization: float = Field(
         default_factory=lambda: _env_float(
@@ -146,6 +163,19 @@ class Config(BaseModel):
     max_redeliveries: int = Field(
         default_factory=lambda: _env_int("LLMQ_MAX_REDELIVERIES", default=3),
         description="Redeliveries before a job is dead-lettered to <q>.failed.",
+    )
+
+    job_timeout_s: Optional[float] = Field(
+        default_factory=lambda: _env_float("LLMQ_JOB_TIMEOUT_S"),
+        description="Per-job processing timeout: a job running past it is "
+        "cancelled and reject-requeued (dead-letters via max_redeliveries) "
+        "instead of wedging a worker slot forever. None disables.",
+    )
+
+    drain_timeout_s: float = Field(
+        default_factory=lambda: _env_float("LLMQ_DRAIN_TIMEOUT_S", default=30.0),
+        description="Seconds a shutting-down worker waits for in-flight "
+        "jobs to finish (TPU jobs with long decodes may need more).",
     )
 
     chunk_size: int = Field(

@@ -141,6 +141,11 @@ class WorkerHealth(BaseModel):
     avg_duration_ms: Optional[float] = None
     queue: Optional[str] = None
     engine_stats: Optional[Dict[str, Any]] = None
+    reconnects: Optional[int] = Field(
+        None,
+        description="Broker session reconnects survived (ResilientBroker "
+        "session stats); None for pre-resilience workers.",
+    )
 
 
 class ErrorInfo(BaseModel):

@@ -14,7 +14,16 @@ Counterpart of reference ``llmq/workers/base.py:15-275``. A worker:
 
 Additions over the reference: periodic WorkerHealth heartbeats published to
 ``<queue>.health`` (the reference declared the model but nothing produced
-it), and engine stats surfaced through them.
+it), engine stats surfaced through them, and a robustness layer:
+
+- per-job timeout (``Config.job_timeout_s``): a hung engine step becomes
+  reject-requeue (dead-letters via the redelivery cap) instead of wedging a
+  prefetch slot forever,
+- unparseable payloads dead-letter to ``<queue>.failed`` with an ``x-error``
+  header instead of vanishing,
+- broker outages don't kill the worker: the BrokerManager's resilient
+  session reconnects and re-establishes the consumer; heartbeats pause
+  while the transport is down and resume after.
 """
 
 from __future__ import annotations
@@ -27,7 +36,7 @@ import time
 from typing import Optional
 
 from llmq_tpu.broker.base import DeliveredMessage
-from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.broker.manager import FAILED_SUFFIX, BrokerManager
 from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
@@ -58,6 +67,7 @@ class BaseWorker(abc.ABC):
         self.running = False
         self.jobs_processed = 0
         self.jobs_failed = 0
+        self.jobs_timed_out = 0
         self.total_duration_ms = 0.0
         self._consumer_tag: Optional[str] = None
         self._in_flight = 0
@@ -118,8 +128,12 @@ class BaseWorker(abc.ABC):
             while self.running:
                 now = time.time()
                 if now - last_beat >= HEARTBEAT_INTERVAL_S:
-                    await self._publish_heartbeat()
-                    last_beat = now
+                    # Heartbeats pause during a broker outage (publishing
+                    # them would just park stale liveness claims in the
+                    # reconnect outbox) and resume right after reconnect.
+                    if self.broker.transport_connected:
+                        await self._publish_heartbeat()
+                        last_beat = now
                 await asyncio.sleep(1.0)
         finally:
             await self.shutdown()
@@ -137,7 +151,9 @@ class BaseWorker(abc.ABC):
                 pass
             self._consumer_tag = None
         try:
-            await asyncio.wait_for(self._drained.wait(), timeout=30.0)
+            await asyncio.wait_for(
+                self._drained.wait(), timeout=self.config.drain_timeout_s
+            )
         except asyncio.TimeoutError:
             self.logger.warning("Timed out draining %d in-flight jobs", self._in_flight)
         await self._cleanup_processor()
@@ -157,14 +173,15 @@ class BaseWorker(abc.ABC):
         start = time.monotonic()
         try:
             job = Job.model_validate_json(message.body)
-        except Exception as exc:  # malformed payload: drop, never requeue
-            self.logger.error("Unparseable job dropped: %s", exc)
+        except Exception as exc:  # malformed payload: dead-letter, never requeue
+            self.logger.error("Unparseable job dead-lettered: %s", exc)
             self.jobs_failed += 1
+            await self._dead_letter_unparseable(message, exc)
             await message.reject(requeue=False)
             self._settle_in_flight()
             return
         try:
-            output = await self._process_job(job)
+            output = await self._run_with_timeout(job)
             duration_ms = (time.monotonic() - start) * 1000
             result = self._build_result(job, output, duration_ms)
             await self._publish_result(result)
@@ -177,6 +194,19 @@ class BaseWorker(abc.ABC):
                     self.jobs_processed,
                     self.total_duration_ms / self.jobs_processed,
                 )
+        except (asyncio.TimeoutError, TimeoutError) as exc:
+            # Hung engine step / stuck backend: the job slot must come
+            # back. Requeue; the broker dead-letters past the redelivery
+            # cap, so a deterministically-hanging job can't loop forever.
+            self.logger.warning(
+                "Job %s exceeded job_timeout_s=%.1fs (delivery %d), requeueing",
+                job.id,
+                self.config.job_timeout_s or 0.0,
+                message.delivery_count,
+            )
+            self.jobs_failed += 1
+            self.jobs_timed_out += 1
+            await message.reject(requeue=True)
         except ValueError as exc:
             # Job is semantically invalid — retrying can't fix it. Ack &
             # drop (reference base.py:228-235).
@@ -194,6 +224,35 @@ class BaseWorker(abc.ABC):
             await message.reject(requeue=True)
         finally:
             self._settle_in_flight()
+
+    async def _run_with_timeout(self, job: Job) -> str:
+        timeout = self.config.job_timeout_s
+        if timeout is None or timeout <= 0:
+            return await self._process_job(job)
+        return await asyncio.wait_for(self._process_job(job), timeout=timeout)
+
+    async def _dead_letter_unparseable(
+        self, message: DeliveredMessage, exc: Exception
+    ) -> None:
+        """Corrupt payloads can't round-trip the normal redelivery path
+        (they never parse into a Job), but they must not vanish either —
+        file them in ``<queue>.failed`` so `llmq-tpu errors` can show what
+        arrived and why."""
+        headers = dict(message.headers or {})
+        headers["x-error"] = f"unparseable job payload: {exc}"
+        headers["x-worker-id"] = self.worker_id
+        headers.setdefault("x-death-queue", self.queue)
+        try:
+            await self.broker.broker.publish(
+                self.queue + FAILED_SUFFIX,
+                message.body,
+                message_id=message.message_id,
+                headers=headers,
+            )
+        except Exception:  # noqa: BLE001 — best-effort: never block the loop
+            self.logger.warning(
+                "Could not dead-letter unparseable payload", exc_info=True
+            )
 
     def _settle_in_flight(self) -> None:
         self._in_flight -= 1
@@ -235,6 +294,7 @@ class BaseWorker(abc.ABC):
 
     # --- heartbeats -------------------------------------------------------
     async def _publish_heartbeat(self) -> None:
+        stats = self.broker.session_stats
         health = WorkerHealth(
             worker_id=self.worker_id,
             status="running" if self.running else "stopping",
@@ -247,6 +307,7 @@ class BaseWorker(abc.ABC):
             ),
             queue=self.queue,
             engine_stats=self._engine_stats(),
+            reconnects=stats.reconnects if stats is not None else None,
         )
         try:
             await self.broker.broker.publish(

@@ -1,0 +1,75 @@
+"""Broker daemon restart under a live worker: kill and restart the TCP
+broker mid-stream; every submitted job must yield a result (duplicates
+from redelivery allowed, losses not) and the worker must reconnect rather
+than exit."""
+
+import asyncio
+import json
+
+from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.broker.tcp import BrokerServer
+from llmq_tpu.core.config import Config
+from llmq_tpu.core.models import Job
+from llmq_tpu.workers.dummy import DummyWorker
+
+N_JOBS = 30
+
+
+async def _start_server(port=0, persist_dir=None):
+    srv = BrokerServer("127.0.0.1", port, persist_dir=persist_dir)
+    await srv.start()
+    return srv, srv._server.sockets[0].getsockname()[1]
+
+
+async def test_worker_survives_broker_restart(tmp_path):
+    journal_dir = tmp_path / "broker-state"
+    srv, port = await _start_server(persist_dir=journal_dir)
+    cfg = Config(
+        broker_url=f"tcp://127.0.0.1:{port}/",
+        reconnect_base_delay_s=0.02,
+        reconnect_max_delay_s=0.2,
+    )
+
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("rq")
+        for i in range(N_JOBS):
+            await mgr.publish_job("rq", Job(id=f"r{i}", prompt=f"p{i}"))
+
+        # Slow enough that the restart lands while jobs are still flowing.
+        worker = DummyWorker("rq", delay=0.05, config=cfg, concurrency=2)
+        task = asyncio.ensure_future(worker.run())
+        try:
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while worker.jobs_processed < 5:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+
+            # Bounce the daemon: same port, same journal — a deploy restart.
+            await srv.stop()
+            await asyncio.sleep(0.1)
+            srv2, _ = await _start_server(port=port, persist_dir=journal_dir)
+
+            # Exactly-one-result-per-job, deduped by id (a job in flight
+            # during the bounce is redelivered, so a duplicate result for
+            # it is legitimate at-least-once behavior).
+            ids: set[str] = set()
+            deadline = asyncio.get_running_loop().time() + 60.0
+            while len(ids) < N_JOBS:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    f"only {len(ids)}/{N_JOBS} results after broker restart"
+                )
+                msg = await mgr.broker.get("rq.results")
+                if msg is None:
+                    await asyncio.sleep(0.02)
+                    continue
+                ids.add(json.loads(msg.body)["id"])
+                await msg.ack()
+            assert ids == {f"r{i}" for i in range(N_JOBS)}
+
+            assert not task.done(), "worker exited on broker restart"
+            stats = worker.broker.session_stats
+            assert stats is not None and stats.reconnects >= 1
+        finally:
+            worker.request_shutdown()
+            await asyncio.wait_for(task, timeout=30.0)
+            await srv2.stop()

@@ -66,6 +66,49 @@ def test_clear_requires_confirmation(mem_url, monkeypatch):
     assert "Purged" in result.output
 
 
+async def test_health_flags_stale_workers(mem_url, monkeypatch, capsys):
+    """`llmq-tpu health` marks workers with heartbeats older than 2× the
+    heartbeat interval as stale (red, not counted as live) and renders
+    per-worker reconnect counts from session stats."""
+    from datetime import timedelta
+
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli.monitor import check_health
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.core.models import WorkerHealth, utcnow
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("hq")
+        await mgr.broker.declare_queue("hq.health", max_redeliveries=10**9)
+        fresh = WorkerHealth(
+            worker_id="w-fresh",
+            status="running",
+            last_seen=utcnow(),
+            jobs_processed=5,
+            queue="hq",
+            reconnects=2,
+        )
+        stale = WorkerHealth(
+            worker_id="w-stale",
+            status="running",
+            last_seen=utcnow() - timedelta(seconds=300),
+            jobs_processed=1,
+            queue="hq",
+        )
+        for h in (fresh, stale):
+            await mgr.broker.publish(
+                "hq.health", h.model_dump_json().encode("utf-8")
+            )
+        await check_health("hq")
+    out = capsys.readouterr().out
+    assert "w-fresh" in out and "w-stale" in out
+    assert "stale" in out
+    assert "reconnects" in out
+    assert "1 worker(s) stale" in out
+
+
 def test_errors_empty(mem_url, monkeypatch):
     monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
     result = CliRunner().invoke(cli, ["errors", "someq"])

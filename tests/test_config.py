@@ -45,6 +45,37 @@ def test_native_beats_alias(monkeypatch):
     assert Config().queue_prefetch == 7
 
 
+def test_robustness_defaults(monkeypatch):
+    for var in (
+        "LLMQ_JOB_TIMEOUT_S",
+        "LLMQ_DRAIN_TIMEOUT_S",
+        "LLMQ_RECONNECT_BASE_S",
+        "LLMQ_RECONNECT_MAX_S",
+        "LLMQ_OUTBOX_LIMIT",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    cfg = Config()
+    assert cfg.job_timeout_s is None  # no deadline unless asked for
+    assert cfg.drain_timeout_s == 30.0
+    assert cfg.reconnect_base_delay_s == 0.5
+    assert cfg.reconnect_max_delay_s == 30.0
+    assert cfg.outbox_limit == 10_000
+
+
+def test_robustness_env_overrides(monkeypatch):
+    monkeypatch.setenv("LLMQ_JOB_TIMEOUT_S", "12.5")
+    monkeypatch.setenv("LLMQ_DRAIN_TIMEOUT_S", "90")
+    monkeypatch.setenv("LLMQ_RECONNECT_BASE_S", "0.1")
+    monkeypatch.setenv("LLMQ_RECONNECT_MAX_S", "5")
+    monkeypatch.setenv("LLMQ_OUTBOX_LIMIT", "123")
+    cfg = get_config()
+    assert cfg.job_timeout_s == 12.5
+    assert cfg.drain_timeout_s == 90.0
+    assert cfg.reconnect_base_delay_s == 0.1
+    assert cfg.reconnect_max_delay_s == 5.0
+    assert cfg.outbox_limit == 123
+
+
 def test_env_file_loader(tmp_path, monkeypatch):
     monkeypatch.delenv("SOME_TEST_KEY", raising=False)
     env = tmp_path / ".env"

@@ -1,0 +1,204 @@
+"""ResilientBroker session layer: reconnect, topology/consumer replay,
+settle fencing, bounded publish outbox.
+
+TCP tests run a real BrokerServer in-process (port 0) and bounce it to
+produce genuine connection loss; memory tests force loss directly (the
+memory transport cannot lose a connection on its own).
+"""
+
+import asyncio
+
+import pytest
+
+from llmq_tpu.broker.base import make_broker
+from llmq_tpu.broker.chaos import ChaosBroker
+from llmq_tpu.broker.memory import MemoryBroker
+from llmq_tpu.broker.resilient import ResilientBroker
+from llmq_tpu.broker.tcp import BrokerServer
+
+
+async def _start_server(port=0, persist_dir=None):
+    srv = BrokerServer("127.0.0.1", port, persist_dir=persist_dir)
+    await srv.start()
+    return srv, srv._server.sockets[0].getsockname()[1]
+
+
+async def _wait_for(cond, timeout=10.0, interval=0.01):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(interval)
+
+
+def _fast_resilient(url, **kw):
+    kw.setdefault("reconnect_base_delay", 0.02)
+    kw.setdefault("reconnect_max_delay", 0.1)
+    return ResilientBroker(url, **kw)
+
+
+class TestMakeBroker:
+    def test_chaos_scheme_dispatch(self):
+        b = make_broker("chaos+memory://ns?kill_every=5&seed=3")
+        assert isinstance(b, ChaosBroker)
+        assert isinstance(b.inner, MemoryBroker)
+        assert b.kill_every == 5 and b.seed == 3
+
+    def test_chaos_requires_inner_scheme(self):
+        with pytest.raises(ValueError):
+            ChaosBroker("chaos://nope")
+
+
+class TestMemoryPassthrough:
+    async def test_normal_operation_no_reconnects(self, mem_url):
+        b = ResilientBroker(mem_url)
+        await b.connect()
+        assert b.is_connected
+        await b.declare_queue("q")
+        await b.publish("q", b"hello", message_id="m1")
+        msg = await b.get("q")
+        assert msg is not None and msg.body == b"hello"
+        await msg.ack()
+        stats = await b.stats("q")
+        assert stats.message_count == 0
+        assert b.session.reconnects == 0
+        assert b.session.outbox_parked == 0
+        await b.close()
+        assert not b.is_connected
+
+    async def test_forced_loss_fences_stale_settle(self, mem_url):
+        """A settle for a message delivered on a previous connection
+        generation is a no-op; the broker-side requeue (at-least-once)
+        owns the message."""
+        b = _fast_resilient(mem_url)
+        await b.connect()
+        await b.declare_queue("q")
+        await b.publish("q", b"payload", message_id="m1")
+        msg = await b.get("q")
+        assert msg is not None
+
+        b._connection_lost(ConnectionError("simulated loss"))
+        await _wait_for(lambda: b.is_connected)
+        assert b.session.reconnects == 1
+
+        # Stale ack: fenced, not forwarded to the new connection.
+        await msg.ack()
+        assert b.session.fenced_settles == 1
+        # The broker requeued it when the old connection closed (with a
+        # delivery-count bump), so it comes around again.
+        again = await b.get("q")
+        assert again is not None
+        assert again.message_id == "m1"
+        assert again.delivery_count == 1
+        await again.ack()
+        assert (await b.stats("q")).message_count == 0
+        await b.close()
+
+
+class TestTcpReconnect:
+    async def test_consumer_reestablished_after_server_restart(self, tmp_path):
+        srv, port = await _start_server(persist_dir=tmp_path)
+        b = _fast_resilient(f"tcp://127.0.0.1:{port}/")
+        await b.connect()
+        await b.declare_queue("q")
+        received: list[str] = []
+
+        async def handler(msg):
+            received.append(msg.message_id)
+            await msg.ack()
+
+        await b.consume("q", handler, prefetch=10)
+        for i in range(3):
+            await b.publish("q", b"x", message_id=f"a{i}")
+        await _wait_for(lambda: len(received) == 3)
+
+        await srv.stop()
+        await _wait_for(lambda: not b.is_connected)
+        # Publishes during the outage park in the outbox.
+        for i in range(3):
+            await b.publish("q", b"x", message_id=f"b{i}")
+        assert b.session.outbox_parked == 3
+
+        srv2, _ = await _start_server(port=port, persist_dir=tmp_path)
+        await _wait_for(lambda: b.is_connected)
+        # The re-established consumer receives the flushed publishes.
+        await _wait_for(lambda: len(received) == 6)
+        assert set(received) == {f"a{i}" for i in range(3)} | {
+            f"b{i}" for i in range(3)
+        }
+        assert b.session.reconnects >= 1
+        assert b.session.outbox_flushed == 3
+        await b.close()
+        await srv2.stop()
+
+    async def test_outbox_backpressure_blocks_publishers(self):
+        srv, port = await _start_server()
+        b = _fast_resilient(f"tcp://127.0.0.1:{port}/", outbox_limit=2)
+        await b.connect()
+        await b.declare_queue("q")
+        await srv.stop()
+        await _wait_for(lambda: not b.is_connected)
+
+        await b.publish("q", b"1", message_id="p1")
+        await b.publish("q", b"2", message_id="p2")
+        # Third publish exceeds the outbox bound: it must block (this is
+        # how back-pressure survives an outage) until the flush drains.
+        blocked = asyncio.ensure_future(b.publish("q", b"3", message_id="p3"))
+        await asyncio.sleep(0.1)
+        assert not blocked.done()
+
+        srv2, _ = await _start_server(port=port)
+        await asyncio.wait_for(blocked, timeout=10.0)
+        await _wait_for(lambda: b.is_connected)
+
+        async def _depth():
+            return (await b.stats("q")).message_count
+
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while (await _depth()) != 3:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert b.session.outbox_parked >= 2
+        await b.close()
+        await srv2.stop()
+
+    async def test_initial_connect_retries_then_fails(self):
+        # Grab a port with no listener: bind and close a throwaway server.
+        srv, port = await _start_server()
+        await srv.stop()
+        b = ResilientBroker(
+            f"tcp://127.0.0.1:{port}/",
+            connect_retries=2,
+            connect_base_delay=0.01,
+        )
+        with pytest.raises(ConnectionError):
+            await b.connect()
+
+    async def test_permanent_failure_raises_to_callers(self):
+        srv, port = await _start_server()
+        b = _fast_resilient(
+            f"tcp://127.0.0.1:{port}/", max_reconnect_attempts=2
+        )
+        await b.connect()
+        await b.declare_queue("q")
+        await srv.stop()
+        await _wait_for(lambda: not b.is_connected)
+        await _wait_for(lambda: b._failed is not None, timeout=10.0)
+        with pytest.raises(ConnectionError):
+            await b.stats("q")
+        with pytest.raises(ConnectionError):
+            await b.publish("q", b"x")
+        await b.close()
+
+
+class TestManagerIntegration:
+    async def test_manager_wraps_in_resilient(self, mem_url):
+        from llmq_tpu.broker.manager import BrokerManager
+        from llmq_tpu.core.config import Config
+
+        async with BrokerManager(Config(broker_url=mem_url)) as mgr:
+            assert isinstance(mgr.broker, ResilientBroker)
+            assert mgr.transport_connected
+            assert mgr.session_stats is not None
+            assert mgr.session_stats.reconnects == 0
+            assert mgr.session_stats.as_dict()["generation"] == 0

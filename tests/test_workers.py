@@ -68,6 +68,67 @@ class TestDummyWorker:
             stats = await mgr.get_queue_stats("q")
             assert stats.message_count == 0  # bad message not requeued
 
+    async def test_unparseable_payload_dead_lettered_with_error(self, mem_url):
+        """Corrupt payloads land in <q>.failed with an x-error header
+        instead of silently vanishing."""
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.broker.publish("q", b"\x00garbage payload")
+            worker = DummyWorker("q", delay=0, config=cfg)
+            await _run_worker_until(worker, lambda: worker.jobs_failed >= 1)
+            msg = await mgr.broker.get("q.failed")
+            assert msg is not None
+            assert msg.body == b"\x00garbage payload"
+            assert "unparseable" in msg.headers.get("x-error", "")
+            assert msg.headers.get("x-worker-id") == worker.worker_id
+            assert msg.headers.get("x-death-queue") == "q"
+            await msg.ack()
+            # And the original is gone from the main queue.
+            assert (await mgr.get_queue_stats("q")).message_count == 0
+
+    async def test_job_timeout_requeues_then_dead_letters(self, mem_url):
+        """A job sleeping past job_timeout_s is requeued; past the
+        redelivery cap it dead-letters to <q>.failed."""
+
+        class SleepyWorker(DummyWorker):
+            async def _process_job(self, job):
+                await asyncio.sleep(30)
+
+        cfg = Config(broker_url=mem_url, job_timeout_s=0.1, max_redeliveries=1)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job("q", Job(id="hung", prompt="p"))
+            worker = SleepyWorker("q", delay=0, config=cfg)
+            # Initial delivery + 1 redelivery both time out, then DLQ.
+            await _run_worker_until(worker, lambda: worker.jobs_timed_out >= 2)
+            await asyncio.sleep(0.1)
+            errors = await mgr.get_failed_jobs("q")
+            assert len(errors) == 1
+            assert errors[0].job_id == "hung"
+            assert errors[0].redeliveries > 1
+            assert worker.jobs_timed_out >= 2
+            assert (await mgr.get_queue_stats("q")).message_count == 0
+
+    async def test_no_timeout_when_unset(self, mem_url):
+        """job_timeout_s=None (the default) imposes no deadline."""
+
+        class MeasuredWorker(DummyWorker):
+            async def _process_job(self, job):
+                await asyncio.sleep(0.2)
+                return "done"
+
+        cfg = Config(broker_url=mem_url)
+        assert cfg.job_timeout_s is None
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job("q", Job(id="ok", prompt="p"))
+            worker = MeasuredWorker("q", delay=0, config=cfg)
+            await _run_worker_until(worker, lambda: worker.jobs_processed >= 1)
+            assert worker.jobs_timed_out == 0
+            results = await _drain_results(mgr, "q.results", 1)
+            assert results[0].result == "done"
+
     async def test_processing_error_requeues_then_dlqs(self, mem_url):
         class FailingWorker(DummyWorker):
             async def _process_job(self, job):
