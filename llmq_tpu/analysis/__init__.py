@@ -1,0 +1,58 @@
+"""llmq-lint: project-specific static analysis for the broker/worker/engine stack.
+
+The reference design delegated the hard correctness invariants to vLLM and
+RabbitMQ; this rebuild owns them itself, so the classes of bug that kill a
+queue system in production — leaked fire-and-forget tasks, swallowed
+``CancelledError``, a broker message left unsettled on an error path, a host
+sync hiding inside a jitted hot loop — get a first-class AST pass instead of
+a code-review checklist.
+
+Run it as ``python -m llmq_tpu.analysis <paths>`` or ``llmq-tpu lint``.
+
+Rules (see each checker module for the full contract):
+
+- ``orphan-task``        fire-and-forget asyncio task, result discarded
+- ``settle-exhaustive``  a ``DeliveredMessage`` path that neither settles
+                         nor delegates the message
+- ``blocking-async``     blocking call (``time.sleep``, subprocess, socket)
+                         inside ``async def``
+- ``blocking-async-io``  sync filesystem I/O inside ``async def`` (warning)
+- ``cancelled-swallow``  broad/bare except that eats cancellation inside a
+                         ``while True`` async loop
+- ``jax-host-sync``      host sync (``np.asarray``, ``device_get``,
+                         ``block_until_ready``, scalar coercion) inside a
+                         jitted or hot-path function
+- ``jax-donate``         jitted step function with KV-cache args but no
+                         ``donate_argnums``
+
+Suppression: append ``# llmq: ignore[rule-id]`` (or a bare
+``# llmq: ignore``) to the offending line or the line above it;
+``# llmq: ignore-file[rule-id]`` in the first comment block exempts the
+whole module.
+"""
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Rule,
+    SourceFile,
+    Violation,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+)
+from llmq_tpu.analysis.checkers import ALL_CHECKERS, RULES
+from llmq_tpu.analysis.sanitizer import TaskLeakError, TaskSanitizer
+
+__all__ = [
+    "ALL_CHECKERS",
+    "AnalysisContext",
+    "RULES",
+    "Rule",
+    "SourceFile",
+    "TaskLeakError",
+    "TaskSanitizer",
+    "Violation",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+]

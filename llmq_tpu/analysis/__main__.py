@@ -1,0 +1,8 @@
+"""``python -m llmq_tpu.analysis`` → the lint CLI."""
+
+import sys
+
+from llmq_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

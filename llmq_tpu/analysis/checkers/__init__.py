@@ -1,0 +1,22 @@
+"""Checker registry: every rule the pass enforces, in one place."""
+
+from llmq_tpu.analysis.checkers.blocking import BlockingCallChecker
+from llmq_tpu.analysis.checkers.cancellation import CancelledSwallowChecker
+from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
+from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
+from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
+
+ALL_CHECKERS = (
+    OrphanTaskChecker,
+    SettleExhaustiveChecker,
+    BlockingCallChecker,
+    CancelledSwallowChecker,
+    JaxHostSyncChecker,
+)
+
+#: rule id -> Rule, across every registered checker.
+RULES = {
+    rule.id: rule for checker in ALL_CHECKERS for rule in checker.rules
+}
+
+__all__ = ["ALL_CHECKERS", "RULES"]

@@ -1,0 +1,140 @@
+"""blocking-async / blocking-async-io: blocking calls inside ``async def``.
+
+A blocking call in a coroutine stalls the whole event loop: heartbeats stop,
+broker frames queue up, and every other consumer on the loop starves. Two
+tiers:
+
+- ``blocking-async`` (error): calls that block by design and always have an
+  async equivalent — ``time.sleep`` (→ ``asyncio.sleep``), the ``subprocess``
+  family (→ ``asyncio.create_subprocess_*``), blocking socket/DNS calls,
+  ``os.system``, sync HTTP clients.
+- ``blocking-async-io`` (warning): sync filesystem I/O (builtin ``open``,
+  ``Path.read_text``-style calls). Small-file metadata I/O is sometimes an
+  accepted trade-off (the file broker does it deliberately), so this tier
+  reports without failing the run; ``--strict`` elevates it.
+
+Only the *innermost* function matters: a sync helper defined inside an
+``async def`` runs wherever it is called, so its body is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+    in_async_function,
+)
+
+BLOCKING_ASYNC = Rule(
+    "blocking-async",
+    "error",
+    "blocking call inside async def stalls the event loop",
+)
+BLOCKING_ASYNC_IO = Rule(
+    "blocking-async-io",
+    "warning",
+    "sync filesystem I/O inside async def",
+)
+
+#: Canonical dotted names that block by design (error tier).
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "subprocess.Popen",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "socket.gethostbyaddr",
+    "socket.getfqdn",
+    "requests.get",
+    "requests.post",
+    "requests.put",
+    "requests.patch",
+    "requests.delete",
+    "requests.head",
+    "requests.request",
+    "urllib.request.urlopen",
+}
+
+#: Method names that are sync file I/O wherever they appear (warning tier).
+#: Method-name matching is a heuristic — the receiver's type is unknown to
+#: an AST pass — so this list sticks to names that are unambiguous in
+#: practice (pathlib.Path and file objects).
+_SYNC_IO_METHODS = {
+    "read_text",
+    "write_text",
+    "read_bytes",
+    "write_bytes",
+}
+
+
+def _canonical(call: ast.Call, imports: ImportMap) -> Optional[str]:
+    return imports.resolve(call.func)
+
+
+class BlockingCallChecker(Checker):
+    rules = (BLOCKING_ASYNC, BLOCKING_ASYNC_IO)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not in_async_function(node):
+                continue
+            name = _canonical(node, imports)
+            if name in _BLOCKING_CALLS:
+                hint = (
+                    "use asyncio.sleep"
+                    if name.endswith("sleep")
+                    else "use the asyncio equivalent or run_in_executor"
+                )
+                yield Violation(
+                    rule=BLOCKING_ASYNC,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"blocking call {name}() in async function; {hint}",
+                )
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                yield Violation(
+                    rule=BLOCKING_ASYNC_IO,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        "sync open() in async function; read before entering "
+                        "the loop or use run_in_executor"
+                    ),
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_IO_METHODS
+            ):
+                yield Violation(
+                    rule=BLOCKING_ASYNC_IO,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"sync file I/O .{node.func.attr}() in async function"
+                    ),
+                )

@@ -1,0 +1,153 @@
+"""cancelled-swallow: except clauses that eat cancellation in async loops.
+
+Graceful shutdown works by cancelling the long-lived loops (consumer polls,
+reconnect loops, heartbeats) and awaiting them. ``asyncio.CancelledError``
+is a ``BaseException`` precisely so ``except Exception`` lets it through —
+but a handler that catches it anyway (bare ``except:``,
+``except BaseException``, or naming ``CancelledError`` in the tuple) and
+then keeps looping turns "cancel and join" into a hang: drain timeouts
+fire, workers get SIGKILLed, in-flight jobs requeue.
+
+Flagged, inside a ``while True``-style loop in an ``async def``:
+
+- a handler whose type catches cancellation (bare / BaseException /
+  CancelledError) and whose body neither re-raises, returns, nor breaks
+  out of the loop;
+- an ``except Exception`` handler whose body is *only* ``pass`` /
+  ``continue`` — it cannot swallow cancellation on 3.8+, but a fully
+  silent retry loop hides every real failure mode shutdown depends on
+  (connection loss, poisoned state) and wedges just as hard in practice.
+
+``while`` loops with a real condition are exempt: cancellation typically
+flips the condition, so the loop exits on its own.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    Rule,
+    SourceFile,
+    Violation,
+    dotted_name,
+    parent,
+)
+
+CANCELLED_SWALLOW = Rule(
+    "cancelled-swallow",
+    "error",
+    "except clause swallows cancellation (or every failure) inside a "
+    "while-True async loop; shutdown cannot terminate the loop",
+)
+
+_CANCEL_NAMES = {"CancelledError", "BaseException"}
+
+
+def _exception_names(handler: ast.ExceptHandler) -> Optional[List[str]]:
+    """Leaf class names named by the handler; None for a bare ``except:``."""
+    if handler.type is None:
+        return None
+    nodes = (
+        list(handler.type.elts)
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    names = []
+    for node in nodes:
+        name = dotted_name(node)
+        names.append(name.split(".")[-1] if name else "")
+    return names
+
+
+def _catches_cancellation(handler: ast.ExceptHandler) -> bool:
+    names = _exception_names(handler)
+    if names is None:
+        return True  # bare except
+    return any(n in _CANCEL_NAMES for n in names)
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    names = _exception_names(handler)
+    return names is not None and "Exception" in names
+
+
+def _body_exits(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, return, or break on some path?"""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Only ``pass``/``continue``/docstring — no logging, no state change."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # stray string/ellipsis
+        return False
+    return True
+
+
+def _in_infinite_async_loop(node: ast.AST) -> bool:
+    """Is ``node`` (a Try) inside a while-True loop whose innermost
+    enclosing function is async?"""
+    cur = parent(node)
+    seen_loop = False
+    while cur is not None:
+        if isinstance(cur, ast.While):
+            test = cur.test
+            if isinstance(test, ast.Constant) and bool(test.value):
+                seen_loop = True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return seen_loop and isinstance(cur, ast.AsyncFunctionDef)
+        cur = parent(cur)
+    return False
+
+
+class CancelledSwallowChecker(Checker):
+    rules = (CANCELLED_SWALLOW,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _in_infinite_async_loop(node):
+                continue
+            for handler in node.handlers:
+                if _body_exits(handler):
+                    continue
+                if _catches_cancellation(handler):
+                    what = (
+                        "bare except"
+                        if handler.type is None
+                        else "except clause catching cancellation"
+                    )
+                    yield Violation(
+                        rule=CANCELLED_SWALLOW,
+                        path=source.path,
+                        line=handler.lineno,
+                        col=handler.col_offset,
+                        message=(
+                            f"{what} inside a while-True async loop never "
+                            "re-raises; cancelling this task cannot stop the "
+                            "loop (re-raise asyncio.CancelledError)"
+                        ),
+                    )
+                elif _catches_broad(handler) and _body_is_silent(handler):
+                    yield Violation(
+                        rule=CANCELLED_SWALLOW,
+                        path=source.path,
+                        line=handler.lineno,
+                        col=handler.col_offset,
+                        message=(
+                            "silent 'except Exception: pass/continue' inside "
+                            "a while-True async loop hides every failure; "
+                            "log the exception or narrow the except"
+                        ),
+                    )

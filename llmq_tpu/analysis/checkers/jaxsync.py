@@ -1,0 +1,262 @@
+"""jax-host-sync / jax-donate: host syncs and missing donation in hot paths.
+
+The engine's throughput model assumes the dispatch path never blocks on the
+accelerator: steps are enqueued run-ahead and the host only syncs at the
+drain boundary. One stray ``np.asarray`` / ``float(x)`` on a traced value
+inside a jitted function either fails tracing outright or — worse, in a
+hot-path helper that is *called* from the dispatch loop — silently inserts
+a device round-trip per step and the engine dies by a thousand syncs.
+
+``jax-host-sync`` flags, inside a jit-decorated function or any function
+named in the configurable hot-path list (``--hot-path``, matching
+``name`` or ``Class.name``):
+
+- ``numpy.asarray`` / ``numpy.array`` / ``numpy.copy`` (module resolved
+  through import aliases, so ``np.asarray`` counts),
+- ``jax.device_get``, ``jax.block_until_ready``,
+- ``.block_until_ready()``, ``.item()``, ``.tolist()`` method calls,
+- ``float()`` / ``int()`` / ``bool()`` coercions of a function parameter
+  that is not declared static (``static_argnames``/``static_argnums``).
+
+``jax-donate`` flags jit-decorated *step* functions (name contains
+``step``) that take KV-cache-shaped parameters (``k_pages``, ``v_pages``,
+``kv_cache``...) without ``donate_argnums``/``donate_argnames``: without
+donation every step double-buffers the KV pool, which on a TPU means half
+the pages and an HBM copy per token. Read-only kernels (attention over the
+pool) must NOT donate, hence the name gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+    parent,
+)
+
+JAX_HOST_SYNC = Rule(
+    "jax-host-sync",
+    "error",
+    "host synchronization inside a jitted or hot-path function",
+)
+JAX_DONATE = Rule(
+    "jax-donate",
+    "error",
+    "jitted step function updates KV-cache args without donate_argnums",
+)
+
+_NUMPY_SYNC_FUNCS = {"asarray", "array", "copy"}
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+_COERCIONS = {"float", "int", "bool"}
+_KV_PARAM_NAMES = {
+    "k_pages",
+    "v_pages",
+    "kv_pages",
+    "kv_cache",
+    "cache_k",
+    "cache_v",
+    "kv",
+}
+
+
+def _jit_decoration(
+    fn: ast.AST, imports: ImportMap
+) -> Optional[Tuple[ast.AST, List[ast.keyword]]]:
+    """(decorator node, jit keywords) when ``fn`` is jit-decorated.
+
+    Recognizes ``@jax.jit``, ``@jit`` (imported from jax), and the
+    ``@functools.partial(jax.jit, ...)`` idiom; the keywords are the
+    partial's, where ``donate_argnums``/``static_argnames`` live.
+    """
+    for deco in fn.decorator_list:  # type: ignore[union-attr]
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        resolved = imports.resolve(target) or ""
+        if resolved in ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"):
+            kws = deco.keywords if isinstance(deco, ast.Call) else []
+            return deco, list(kws)
+        if resolved in ("functools.partial", "partial") and isinstance(
+            deco, ast.Call
+        ):
+            if deco.args:
+                inner = imports.resolve(deco.args[0]) or ""
+                if inner in ("jax.jit", "jax.pjit"):
+                    return deco, list(deco.keywords)
+    return None
+
+
+def _static_param_names(
+    fn: ast.AST, jit_keywords: Sequence[ast.keyword]
+) -> Set[str]:
+    """Params declared static via static_argnames or static_argnums."""
+    args = fn.args  # type: ignore[union-attr]
+    positional = [a.arg for a in (*args.posonlyargs, *args.args)]
+    static: Set[str] = set()
+    for kw in jit_keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    static.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(positional):
+                        static.add(positional[node.value])
+    # Keyword-only params of a jitted function are static by construction
+    # in the decorator styles this repo uses (they ride static_argnames);
+    # being conservative about coercion noise matters more than catching a
+    # kw-only tracer.
+    static.update(a.arg for a in args.kwonlyargs)
+    return static
+
+
+def _is_hot(fn: ast.AST, ctx: AnalysisContext) -> bool:
+    name = fn.name  # type: ignore[union-attr]
+    if name in ctx.hot_paths:
+        return True
+    p = parent(fn)
+    if isinstance(p, ast.ClassDef) and f"{p.name}.{name}" in ctx.hot_paths:
+        return True
+    return False
+
+
+def _walk_own_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body including nested defs (they trace too when
+    called from the jitted body), which is the conservative choice."""
+    for stmt in fn.body:  # type: ignore[union-attr]
+        yield from ast.walk(stmt)
+
+
+class JaxHostSyncChecker(Checker):
+    rules = (JAX_HOST_SYNC, JAX_DONATE)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        numpy_aliases = {
+            local
+            for local, full in imports.aliases.items()
+            if full == "numpy" or full.startswith("numpy.")
+        }
+        numpy_aliases.add("numpy")
+        for node in ast.walk(source.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jit = _jit_decoration(node, imports)
+            hot = _is_hot(node, ctx)
+            if jit is None and not hot:
+                continue
+            static = _static_param_names(node, jit[1]) if jit else set()
+            args = node.args
+            traced_params = {
+                a.arg
+                for a in (*args.posonlyargs, *args.args)
+                if a.arg not in static and a.arg not in ("self", "cls")
+            }
+            yield from self._check_body(
+                node, source, numpy_aliases, imports, traced_params
+            )
+            if jit is not None:
+                yield from self._check_donation(node, source, jit[1])
+
+    def _check_body(
+        self,
+        fn: ast.AST,
+        source: SourceFile,
+        numpy_aliases: Set[str],
+        imports: ImportMap,
+        traced_params: Set[str],
+    ) -> Iterator[Violation]:
+        for node in _walk_own_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                recv = func.value
+                if (
+                    isinstance(recv, ast.Name)
+                    and recv.id in numpy_aliases
+                    and func.attr in _NUMPY_SYNC_FUNCS
+                ):
+                    yield self._violation(
+                        source,
+                        node,
+                        f"{recv.id}.{func.attr}() forces a device→host "
+                        "transfer; use jnp inside traced code",
+                    )
+                    continue
+                resolved = imports.resolve(func) or ""
+                if resolved in ("jax.device_get", "jax.block_until_ready"):
+                    yield self._violation(
+                        source,
+                        node,
+                        f"{resolved}() synchronizes the host with the device",
+                    )
+                    continue
+                if func.attr in _SYNC_METHODS and not isinstance(
+                    recv, ast.Constant
+                ):
+                    yield self._violation(
+                        source,
+                        node,
+                        f".{func.attr}() blocks until the device result "
+                        "materializes",
+                    )
+                    continue
+            elif isinstance(func, ast.Name) and func.id in _COERCIONS:
+                if (
+                    len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in traced_params
+                ):
+                    yield self._violation(
+                        source,
+                        node,
+                        f"{func.id}({node.args[0].id}) concretizes a traced "
+                        "value (host sync); keep it as an array",
+                    )
+
+    def _check_donation(
+        self, fn: ast.AST, source: SourceFile, jit_keywords: Sequence[ast.keyword]
+    ) -> Iterator[Violation]:
+        name = fn.name  # type: ignore[union-attr]
+        if "step" not in name.lower():
+            return
+        args = fn.args  # type: ignore[union-attr]
+        kv_params = [
+            a.arg
+            for a in (*args.posonlyargs, *args.args)
+            if a.arg in _KV_PARAM_NAMES
+        ]
+        if not kv_params:
+            return
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames") for kw in jit_keywords
+        ):
+            return
+        yield Violation(
+            rule=JAX_DONATE,
+            path=source.path,
+            line=fn.lineno,  # type: ignore[union-attr]
+            col=fn.col_offset,  # type: ignore[union-attr]
+            message=(
+                f"jitted step '{name}' takes KV-cache args "
+                f"({', '.join(kv_params)}) without donate_argnums; every "
+                "step double-buffers the pool"
+            ),
+        )
+
+    @staticmethod
+    def _violation(source: SourceFile, node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule=JAX_HOST_SYNC,
+            path=source.path,
+            line=node.lineno,  # type: ignore[attr-defined]
+            col=node.col_offset,  # type: ignore[attr-defined]
+            message=message,
+        )

@@ -1,0 +1,217 @@
+"""settle-exhaustive: every DeliveredMessage path must ack/reject or delegate.
+
+At-least-once delivery only works if a consumed message is settled exactly
+once: a handler that returns (or falls off the end) without ``ack()``/
+``reject()`` strands the message in the unacked map until the connection
+dies — a slow leak of prefetch slots that eventually wedges the consumer.
+
+Scope: functions with a parameter annotated ``DeliveredMessage`` (string
+annotations count). Such a function is clean when either
+
+- the message **escapes** — it is passed to another call, stored in a
+  container/attribute, returned, aliased, or settled inside a nested
+  function (deferred settle): responsibility is delegated and
+  whole-program tracking is out of scope for an AST pass; or
+- every execution path through the body settles (``msg.ack()`` /
+  ``msg.reject()``) or raises — raising is a legitimate "reject upstream"
+  signal, the dispatch layers catch handler exceptions and reject.
+
+The path analysis is a conservative outcome walk over the statement tree
+(if/try/loop/with aware); it deliberately treats a settle call anywhere in
+a simple statement as settling that path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, Iterator, List, Optional, Sequence
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    Rule,
+    SourceFile,
+    Violation,
+    parent,
+)
+
+SETTLE_EXHAUSTIVE = Rule(
+    "settle-exhaustive",
+    "error",
+    "a code path neither settles (ack/reject) nor delegates the broker message",
+)
+
+_SETTLE_ATTRS = {"ack", "reject", "_do_settle"}
+
+# Path outcomes for the conservative walk.
+_OK = "ok"  # settled, raised, or otherwise acceptably terminated
+_FALL = "fall"  # fell through still unsettled
+_BAD = "bad"  # returned / exited unsettled
+_LOOP = "loop"  # break/continue: resolved by the nearest enclosing loop
+
+
+def _annotation_is_message(ann: Optional[ast.expr]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1] == "DeliveredMessage"
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        parts: List[str] = []
+        cur: ast.AST = ann
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+        return bool(parts) and parts[0] == "DeliveredMessage"
+    if isinstance(ann, ast.Subscript):  # Optional[DeliveredMessage] etc.
+        return any(
+            _annotation_is_message(sub)
+            for sub in ast.walk(ann)
+            if isinstance(sub, (ast.Name, ast.Attribute)) and sub is not ann
+        )
+    return False
+
+
+def _message_params(fn: ast.AST) -> List[str]:
+    args = fn.args  # type: ignore[union-attr]
+    all_args = [*args.posonlyargs, *args.args, *args.kwonlyargs]
+    return [a.arg for a in all_args if _annotation_is_message(a.annotation)]
+
+
+def _is_settle_call(node: ast.AST, name: str) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _SETTLE_ATTRS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == name
+    )
+
+
+def _contains_settle(node: ast.AST, name: str) -> bool:
+    return any(_is_settle_call(sub, name) for sub in ast.walk(node))
+
+
+def _escapes(fn: ast.AST, name: str) -> bool:
+    """True when the bare message name is used as anything other than an
+    attribute receiver in the function itself — or settled inside a nested
+    function (a deferred settle via closure)."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            if _contains_settle(node, name):
+                return True
+        if not (isinstance(node, ast.Name) and node.id == name):
+            continue
+        p = parent(node)
+        if isinstance(p, ast.Attribute) and p.value is node:
+            continue  # msg.ack() / msg.body — reading through the handle
+        if isinstance(p, (ast.arg, ast.arguments)):
+            continue
+        if isinstance(node.ctx, ast.Store):
+            continue  # rebinding the name, not leaking the message
+        return True
+    return False
+
+
+def _outcomes(stmts: Sequence[ast.stmt], name: str) -> FrozenSet[str]:
+    """All possible path outcomes for a block entered *unsettled*."""
+    live = True  # some path reaches the current statement unsettled
+    acc: set = set()
+    for stmt in stmts:
+        if not live:
+            break
+        out = _stmt_outcomes(stmt, name)
+        acc |= out - {_FALL}
+        live = _FALL in out
+    if live:
+        acc.add(_FALL)
+    return frozenset(acc)
+
+
+def _stmt_outcomes(stmt: ast.stmt, name: str) -> FrozenSet[str]:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return frozenset({_FALL})  # defining, not executing
+    if isinstance(stmt, ast.Return):
+        if stmt.value is not None and _contains_settle(stmt.value, name):
+            return frozenset({_OK})
+        return frozenset({_BAD})
+    if isinstance(stmt, ast.Raise):
+        return frozenset({_OK})
+    if isinstance(stmt, (ast.Break, ast.Continue)):
+        return frozenset({_LOOP})
+    if isinstance(stmt, ast.If):
+        then = _outcomes(stmt.body, name)
+        other = _outcomes(stmt.orelse, name) if stmt.orelse else frozenset({_FALL})
+        return then | other
+    if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+        body = _outcomes(stmt.body, name)
+        # break/continue stay inside the loop; a loop may also not run (or
+        # exit on its condition), so a fall-through path always exists —
+        # except `while True` with no break, which can only exit via its
+        # body's terminal outcomes.
+        terminal = body - {_FALL, _LOOP}
+        infinite = (
+            isinstance(stmt, ast.While)
+            and isinstance(stmt.test, ast.Constant)
+            and bool(stmt.test.value)
+            and _LOOP not in body
+        )
+        if infinite:
+            return terminal or frozenset({_OK})  # loops forever: never unsettled-exits
+        return terminal | frozenset({_FALL})
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _outcomes(stmt.body, name)
+    if isinstance(stmt, ast.Try):
+        if stmt.finalbody and _outcomes(stmt.finalbody, name) == frozenset({_OK}):
+            return frozenset({_OK})  # finally settles/raises on every path
+        out = _outcomes(stmt.body, name)
+        if stmt.orelse:
+            if _FALL in out:
+                out = (out - {_FALL}) | _outcomes(stmt.orelse, name)
+        for handler in stmt.handlers:
+            # An exception can fire before the body settled, so handler
+            # paths are always entered unsettled.
+            out = out | _outcomes(handler.body, name)
+        return out
+    if isinstance(stmt, ast.Match):
+        out: FrozenSet[str] = frozenset()
+        wildcard = False
+        for case in stmt.cases:
+            out = out | _outcomes(case.body, name)
+            if isinstance(case.pattern, ast.MatchAs) and case.pattern.pattern is None:
+                wildcard = True
+        return out if wildcard else out | frozenset({_FALL})
+    # Simple statement: settles iff a settle call appears anywhere in it.
+    if _contains_settle(stmt, name):
+        return frozenset({_OK})
+    return frozenset({_FALL})
+
+
+class SettleExhaustiveChecker(Checker):
+    rules = (SETTLE_EXHAUSTIVE,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        for node in ast.walk(source.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for name in _message_params(node):
+                if _escapes(node, name):
+                    continue
+                outcomes = _outcomes(node.body, name)
+                if outcomes <= frozenset({_OK}):
+                    continue
+                how = (
+                    "returns" if _BAD in outcomes else "falls off the end"
+                )
+                yield Violation(
+                    rule=SETTLE_EXHAUSTIVE,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"'{node.name}' {how} without settling message "
+                        f"'{name}' on every path (ack/reject, raise, or "
+                        "delegate it)"
+                    ),
+                )

@@ -1,0 +1,112 @@
+"""orphan-task: fire-and-forget asyncio tasks whose result is discarded.
+
+``asyncio.ensure_future(...)`` / ``create_task(...)`` as a bare expression
+statement drops the only strong reference to the task: the event loop keeps
+a weak one, so the task can be garbage-collected mid-flight, and any
+exception it raises is silently discarded (surfacing only as a
+"Task exception was never retrieved" log line at GC time, if ever).
+
+The fix is to hold the task somewhere (a registry set with a done-callback
+that logs and discards — see ``llmq_tpu.utils.aio.spawn``), await it,
+cancel it on teardown, or at minimum attach a done-callback.
+
+Not flagged:
+
+- the result is assigned, awaited, returned, or passed along;
+- ``.add_done_callback`` is chained directly on the call;
+- ``tg.create_task(...)`` where ``tg`` is the as-target of an enclosing
+  ``async with asyncio.TaskGroup()`` (the group owns the task).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    Rule,
+    SourceFile,
+    Violation,
+    dotted_name,
+    parent,
+)
+
+ORPHAN_TASK = Rule(
+    "orphan-task",
+    "error",
+    "asyncio task spawned and discarded: exceptions vanish and the task "
+    "may be garbage-collected mid-flight",
+)
+
+_SPAWNERS = {"ensure_future", "create_task"}
+
+
+def _spawner_name(call: ast.Call) -> str | None:
+    """'asyncio.ensure_future'-style display name when ``call`` spawns a task."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _SPAWNERS:
+        return dotted_name(func) or func.attr
+    if isinstance(func, ast.Name) and func.id in _SPAWNERS:
+        return func.id
+    return None
+
+
+def _receiver(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id
+    return None
+
+
+def _owned_by_taskgroup(call: ast.Call) -> bool:
+    """True for ``tg.create_task(...)`` under ``async with TaskGroup() as tg``."""
+    recv = _receiver(call)
+    if recv is None:
+        return False
+    cur = parent(call)
+    while cur is not None:
+        if isinstance(cur, (ast.AsyncWith, ast.With)):
+            for item in cur.items:
+                target = item.optional_vars
+                if not (isinstance(target, ast.Name) and target.id == recv):
+                    continue
+                cm = item.context_expr
+                if isinstance(cm, ast.Call):
+                    cm_name = dotted_name(cm.func) or ""
+                    if cm_name.split(".")[-1] == "TaskGroup":
+                        return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # with-blocks outside this function don't scope the name
+        cur = parent(cur)
+    return False
+
+
+class OrphanTaskChecker(Checker):
+    rules = (ORPHAN_TASK,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spawner = _spawner_name(node)
+            if spawner is None:
+                continue
+            # Only a bare expression statement discards the task. Anything
+            # else (assignment, await, argument, chained method call like
+            # .add_done_callback) keeps or consumes the reference.
+            if not isinstance(parent(node), ast.Expr):
+                continue
+            if _owned_by_taskgroup(node):
+                continue
+            yield Violation(
+                rule=ORPHAN_TASK,
+                path=source.path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"result of {spawner}(...) is discarded; store the task "
+                    "(e.g. llmq_tpu.utils.aio.spawn with a registry) or await it"
+                ),
+            )

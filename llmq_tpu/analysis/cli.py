@@ -1,0 +1,111 @@
+"""Entry point shared by ``python -m llmq_tpu.analysis`` and ``llmq-tpu lint``.
+
+Exit codes: 0 clean (warnings allowed unless ``--strict``), 1 violations,
+2 usage error. Kept on argparse so the analyzer stays importable with zero
+third-party dependencies (CI images, pre-commit hooks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional, Sequence
+
+from llmq_tpu.analysis.checkers import RULES
+from llmq_tpu.analysis.core import AnalysisContext, analyze_paths
+from llmq_tpu.analysis.reporters import render_json, render_text
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="llmq-tpu lint",
+        description="Project-specific AST lint for the broker/worker/engine stack.",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["llmq_tpu"],
+        help="files or directories to analyze (default: llmq_tpu)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="treat warnings as failures",
+    )
+    parser.add_argument(
+        "--select",
+        action="append",
+        default=None,
+        metavar="RULE",
+        help="only run these rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--ignore",
+        action="append",
+        default=None,
+        metavar="RULE",
+        help="skip these rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--hot-path",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="extra hot-path function name ('step' or 'EngineCore.step') "
+        "for jax-host-sync (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print every rule id with severity and summary, then exit",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id:20s} {rule.severity:8s} {rule.summary}")
+        return 0
+
+    known = set(RULES) | {"parse-error"}
+    for opt_name, ids in (("--select", args.select), ("--ignore", args.ignore)):
+        for rule_id in ids or []:
+            if rule_id not in known:
+                print(
+                    f"error: unknown rule id {rule_id!r} for {opt_name} "
+                    f"(see --list-rules)",
+                    file=sys.stderr,
+                )
+                return 2
+
+    ctx = AnalysisContext(hot_paths=set(args.hot_path or []))
+    violations = analyze_paths(
+        args.paths,
+        ctx=ctx,
+        select=set(args.select) if args.select else None,
+        ignore=set(args.ignore) if args.ignore else None,
+    )
+    report = (
+        render_json(violations) if args.format == "json" else render_text(violations)
+    )
+    print(report)
+    failing: List = [
+        v
+        for v in violations
+        if v.severity == "error" or (args.strict and v.severity == "warning")
+    ]
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Framework for the AST checkers: rules, violations, suppression, walking.
+
+A checker is a class with a ``rules`` tuple and a ``run(source, ctx)``
+generator; ``analyze_source`` parses one file, annotates the tree with
+parent links, collects ``# llmq: ignore[...]`` pragmas from the token
+stream, runs every checker, and filters suppressed findings. No state is
+shared between files, so the pass is trivially parallel-safe (and fast
+enough single-threaded for this repo).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Sequence, Set
+
+#: Matches the suppression pragma inside a comment token.
+_PRAGMA_RE = re.compile(
+    r"#\s*llmq:\s*(ignore-file|ignore)\s*(?:\[([A-Za-z0-9_,\-\s]*)\])?"
+)
+
+#: Sentinel rule-set meaning "every rule".
+ALL_RULES: FrozenSet[str] = frozenset({"*"})
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One invariant the pass enforces."""
+
+    id: str
+    severity: str  # "error" | "warning"
+    summary: str
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r} for rule {self.id}")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding: a rule broken at a specific location."""
+
+    rule: Rule
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def rule_id(self) -> str:
+        return self.rule.id
+
+    @property
+    def severity(self) -> str:
+        return self.rule.severity
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule.id} [{self.rule.severity}] {self.message}"
+        )
+
+
+@dataclass
+class AnalysisContext:
+    """Cross-file configuration shared by every checker."""
+
+    #: Function names (bare or ``Class.method``) treated as hot paths by the
+    #: jax-host-sync checker even without a ``@jax.jit`` decorator.
+    hot_paths: Set[str] = field(default_factory=set)
+
+
+class SourceFile:
+    """A parsed module plus its suppression pragmas."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        _link_parents(self.tree)
+        # line -> suppressed rule ids on that line ("*" = all)
+        self.suppressions: Dict[int, FrozenSet[str]] = {}
+        self.file_suppressions: FrozenSet[str] = frozenset()
+        self._collect_pragmas()
+
+    def _collect_pragmas(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m is None:
+                continue
+            kind, raw_ids = m.group(1), m.group(2)
+            ids = (
+                frozenset(
+                    part.strip() for part in raw_ids.split(",") if part.strip()
+                )
+                if raw_ids
+                else ALL_RULES
+            )
+            if kind == "ignore-file":
+                self.file_suppressions = self.file_suppressions | ids
+            else:
+                line = tok.start[0]
+                self.suppressions[line] = self.suppressions.get(line, frozenset()) | ids
+
+    def is_suppressed(self, line: int, rule_id: str) -> bool:
+        if "*" in self.file_suppressions or rule_id in self.file_suppressions:
+            return True
+        for candidate in (line, line - 1):
+            ids = self.suppressions.get(candidate)
+            if ids is not None and ("*" in ids or rule_id in ids):
+                return True
+        return False
+
+
+class Checker:
+    """Base class: subclasses set ``rules`` and implement ``run``."""
+
+    rules: Sequence[Rule] = ()
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by checkers
+# ---------------------------------------------------------------------------
+
+
+def _link_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._llmq_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_llmq_parent", None)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Local alias → canonical dotted path, from module-level imports."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    full = alias.name if alias.asname else alias.name.split(".")[0]
+                    self.aliases[local] = full
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.aliases[local] = f"{node.module}.{alias.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute, unfolding one alias."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        full_head = self.aliases.get(head, head)
+        return f"{full_head}.{rest}" if rest else full_head
+
+
+def enclosing_functions(node: ast.AST) -> List[ast.AST]:
+    """Function defs containing ``node``, innermost first."""
+    out: List[ast.AST] = []
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur)
+        cur = parent(cur)
+    return out
+
+
+def in_async_function(node: ast.AST) -> bool:
+    """True when the *innermost* enclosing function is ``async def``."""
+    funcs = enclosing_functions(node)
+    return bool(funcs) and isinstance(funcs[0], ast.AsyncFunctionDef)
+
+
+def walk_skipping_functions(body: Iterable[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class defs."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# Driving the pass
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if any(
+                    part.startswith(".") or part == "__pycache__"
+                    for part in sub.parts
+                ):
+                    continue
+                yield sub
+
+
+def analyze_source(
+    path: str,
+    text: str,
+    *,
+    checkers: Optional[Sequence[Checker]] = None,
+    ctx: Optional[AnalysisContext] = None,
+) -> List[Violation]:
+    """Run the pass over one module's source text."""
+    from llmq_tpu.analysis.checkers import ALL_CHECKERS
+
+    ctx = ctx or AnalysisContext()
+    source = SourceFile(path, text)
+    found: List[Violation] = []
+    for checker in checkers if checkers is not None else [c() for c in ALL_CHECKERS]:
+        for violation in checker.run(source, ctx):
+            if not source.is_suppressed(violation.line, violation.rule_id):
+                found.append(violation)
+    found.sort(key=lambda v: (v.path, v.line, v.col, v.rule_id))
+    return found
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    ctx: Optional[AnalysisContext] = None,
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Violation]:
+    """Run the pass over files/directories; returns sorted violations.
+
+    ``select``/``ignore`` filter by rule id after checking (a selected rule
+    still honors inline suppressions). Unparseable files are reported as a
+    synthetic ``parse-error`` violation rather than crashing the run.
+    """
+    from llmq_tpu.analysis.checkers import ALL_CHECKERS
+
+    ctx = ctx or AnalysisContext()
+    checkers = [c() for c in ALL_CHECKERS]
+    found: List[Violation] = []
+    for file in iter_python_files(paths):
+        try:
+            text = file.read_text(encoding="utf-8")
+            found.extend(
+                analyze_source(str(file), text, checkers=checkers, ctx=ctx)
+            )
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            found.append(
+                Violation(
+                    rule=PARSE_ERROR,
+                    path=str(file),
+                    line=getattr(exc, "lineno", None) or 1,
+                    col=0,
+                    message=f"could not parse: {exc.__class__.__name__}: {exc}",
+                )
+            )
+    if select:
+        found = [v for v in found if v.rule_id in select]
+    if ignore:
+        found = [v for v in found if v.rule_id not in ignore]
+    found.sort(key=lambda v: (v.path, v.line, v.col, v.rule_id))
+    return found
+
+
+PARSE_ERROR = Rule(
+    "parse-error", "error", "file could not be parsed as Python"
+)

@@ -1,0 +1,85 @@
+"""Pytest wiring for the TaskSanitizer.
+
+Two integration points:
+
+- ``run_async_test(fn, kwargs, item)`` — drop-in body for a repo-level
+  ``pytest_pyfunc_call`` hook that already owns async-test execution (this
+  repo's conftest runs coroutines via ``asyncio.run``): it runs the test
+  inside a ``TaskSanitizer`` whose strictness comes from the
+  ``task_sanitizer`` marker / ``LLMQ_TASK_SANITIZER`` env var.
+- a standalone plugin (``pytest_plugins = ["llmq_tpu.analysis.pytest_plugin"]``)
+  for projects without their own async runner: hooks ``pytest_pyfunc_call``
+  itself and registers the marker.
+
+Modes: lenient (default) logs leaks and cancels them — byte-for-byte the
+cleanup ``asyncio.run`` already performs, so enabling the plugin cannot
+change test outcomes; strict (marker or ``LLMQ_TASK_SANITIZER=strict``)
+fails the test with ``TaskLeakError``. ``LLMQ_TASK_SANITIZER=off`` disables
+the wrapper entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+from typing import Any, Dict
+
+from llmq_tpu.analysis.sanitizer import TaskSanitizer
+
+MARKER = "task_sanitizer"
+ENV_VAR = "LLMQ_TASK_SANITIZER"
+
+
+def _mode(item: Any) -> str:
+    """'strict' | 'lenient' | 'off' for one test item."""
+    env = os.environ.get(ENV_VAR, "").strip().lower()
+    if env == "off":
+        return "off"
+    marker = item.get_closest_marker(MARKER) if item is not None else None
+    if marker is not None:
+        if marker.kwargs.get("strict", True):
+            return "strict"
+        return "lenient"
+    if env == "strict":
+        return "strict"
+    return "lenient"
+
+
+def run_async_test(fn, kwargs: Dict[str, Any], item: Any = None) -> None:
+    """Run one async test function to completion under the sanitizer."""
+    mode = _mode(item)
+    if mode == "off":
+        asyncio.run(fn(**kwargs))
+        return
+
+    label = getattr(item, "nodeid", None) or getattr(fn, "__name__", "test")
+
+    async def wrapped() -> None:
+        async with TaskSanitizer(strict=(mode == "strict"), label=label):
+            await fn(**kwargs)
+
+    asyncio.run(wrapped())
+
+
+# --- standalone plugin surface ---------------------------------------------
+
+
+def pytest_configure(config) -> None:
+    config.addinivalue_line(
+        "markers",
+        f"{MARKER}(strict=True): fail this async test if it leaks pending "
+        "asyncio tasks or discards task exceptions",
+    )
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+    }
+    run_async_test(fn, kwargs, pyfuncitem)
+    return True

@@ -1,0 +1,47 @@
+"""Render violations as human text or machine JSON."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence
+
+from llmq_tpu.analysis.core import Violation
+
+
+def render_text(violations: Sequence[Violation]) -> str:
+    lines: List[str] = [v.render() for v in violations]
+    counts = Counter(v.severity for v in violations)
+    if violations:
+        lines.append("")
+    lines.append(
+        f"{counts.get('error', 0)} error(s), {counts.get('warning', 0)} "
+        f"warning(s) across {len({v.path for v in violations})} file(s)"
+        if violations
+        else "clean: no violations"
+    )
+    return "\n".join(lines)
+
+
+def render_json(violations: Sequence[Violation]) -> str:
+    by_rule = Counter(v.rule_id for v in violations)
+    payload = {
+        "violations": [
+            {
+                "rule": v.rule_id,
+                "severity": v.severity,
+                "path": v.path,
+                "line": v.line,
+                "col": v.col,
+                "message": v.message,
+            }
+            for v in violations
+        ],
+        "counts": {
+            "total": len(violations),
+            "errors": sum(1 for v in violations if v.severity == "error"),
+            "warnings": sum(1 for v in violations if v.severity == "warning"),
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)

@@ -1,0 +1,165 @@
+"""Runtime counterpart of the orphan-task rule: the asyncio TaskSanitizer.
+
+The static pass catches *spawning* a task and dropping it; this catches the
+runtime symptom — a scope (a test, a request handler, a drain window) that
+exits while tasks it spawned are still pending, or after a spawned task
+died with an exception nobody retrieved. Both bugs are invisible at the
+point of failure: the leak shows up later as a wedged shutdown, the
+discarded exception as a GC-time log line with no traceback context.
+
+Detection has two legs, because ``asyncio.all_tasks()`` only reports tasks
+that are *not yet finished*:
+
+- a snapshot/diff of ``all_tasks()`` around the scope finds still-pending
+  leaks, and
+- a task-factory hook installed for the scope's duration records every
+  task created inside it (keeping a strong reference, so even an orphan
+  cannot be garbage-collected out of sight), which is how tasks that
+  already *finished* with an unretrieved exception are found.
+
+Usage, directly::
+
+    async with TaskSanitizer() as ts:
+        await run_the_thing()
+    # raises TaskLeakError on leaked-pending or crashed-unretrieved tasks
+
+or through the pytest plugin (``llmq_tpu.analysis.pytest_plugin``), which
+wraps async tests: lenient by default (report + cancel), strict under the
+``task_sanitizer`` marker or ``LLMQ_TASK_SANITIZER=strict``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+
+class TaskLeakError(AssertionError):
+    """A sanitized scope leaked pending tasks or discarded task exceptions."""
+
+
+def _describe(task: "asyncio.Task") -> str:
+    coro = task.get_coro()
+    origin = getattr(coro, "__qualname__", None) or repr(coro)
+    return f"{task.get_name()} ({origin})"
+
+
+def _exception_unretrieved(task: "asyncio.Task") -> bool:
+    """Did ``task`` die with an exception nobody has looked at?
+
+    CPython flips ``_log_traceback`` off the moment ``exception()``/
+    ``result()`` is called (that flag is what drives the GC-time "Task
+    exception was never retrieved" warning). Fall back to "it has an
+    exception at all" where the private flag is missing.
+    """
+    flag = getattr(task, "_log_traceback", None)
+    if flag is not None:
+        return bool(flag)
+    return task.exception() is not None
+
+
+class TaskSanitizer:
+    """Context manager that audits tasks spawned within a scope.
+
+    On exit it classifies every task created inside the scope:
+
+    - still pending → a **leak** (``leaked``); cancelled and awaited when
+      ``cancel_leaked`` (the default), so the scope's loop closes clean,
+    - done with an unretrieved exception → a **discarded failure**
+      (``failed``),
+
+    then raises ``TaskLeakError`` in ``strict`` mode. With
+    ``strict=False`` it only logs — the mode the repo-wide pytest wiring
+    uses so legacy tests keep passing while new code opts into strictness.
+    """
+
+    def __init__(
+        self,
+        *,
+        strict: bool = True,
+        cancel_leaked: bool = True,
+        check_exceptions: bool = True,
+        label: str = "scope",
+    ) -> None:
+        self.strict = strict
+        self.cancel_leaked = cancel_leaked
+        self.check_exceptions = check_exceptions
+        self.label = label
+        self.leaked: List[asyncio.Task] = []
+        self.failed: List[asyncio.Task] = []
+        self._before: Set[asyncio.Task] = set()
+        self._created: List[asyncio.Task] = []
+        self._prev_factory = None
+
+    async def __aenter__(self) -> "TaskSanitizer":
+        loop = asyncio.get_running_loop()
+        self._before = set(asyncio.all_tasks())
+        self._created = []
+        self._prev_factory = loop.get_task_factory()
+        prev = self._prev_factory
+        created = self._created
+
+        def factory(loop, coro, **kwargs):
+            if prev is not None:
+                task = prev(loop, coro, **kwargs)
+            else:
+                task = asyncio.Task(coro, loop=loop, **kwargs)
+            created.append(task)
+            return task
+
+        loop.set_task_factory(factory)
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        asyncio.get_running_loop().set_task_factory(self._prev_factory)
+        # One scheduling turn so tasks that are merely "not reaped yet"
+        # (done callbacks pending, trivial coroutines) settle first.
+        await asyncio.sleep(0)
+        current = asyncio.current_task()
+        spawned = {
+            t
+            for t in (asyncio.all_tasks() - self._before) | set(self._created)
+            if t is not current
+        }
+        self.leaked = [t for t in spawned if not t.done()]
+        self.failed = []
+        if self.check_exceptions:
+            for t in spawned:
+                if t.done() and not t.cancelled() and _exception_unretrieved(t):
+                    self.failed.append(t)
+        if self.leaked and self.cancel_leaked:
+            for t in self.leaked:
+                t.cancel()
+            await asyncio.gather(*self.leaked, return_exceptions=True)
+        if exc_type is not None:
+            return False  # the scope's own failure wins
+        problems = self._render_problems()
+        if problems:
+            if self.strict:
+                raise TaskLeakError(problems)
+            logger.warning("TaskSanitizer (%s): %s", self.label, problems)
+        return False
+
+    def _render_problems(self) -> Optional[str]:
+        parts = []
+        if self.leaked:
+            names = ", ".join(_describe(t) for t in self.leaked)
+            parts.append(
+                f"{len(self.leaked)} task(s) still pending at {self.label} "
+                f"exit: {names}"
+            )
+        for t in self.failed:
+            parts.append(
+                f"task {_describe(t)} died with unretrieved "
+                f"{type(t.exception()).__name__}: {t.exception()}"
+            )
+        return "; ".join(parts) if parts else None
+
+
+async def run_sanitized(coro, **kwargs) -> None:
+    """Await ``coro`` inside a TaskSanitizer (helper for test wrappers)."""
+    async with TaskSanitizer(**kwargs):
+        await coro

@@ -38,6 +38,7 @@ from llmq_tpu.broker.base import (
 )
 from llmq_tpu.broker.memory import DEFAULT_MAX_REDELIVERIES, FAILED_SUFFIX
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils.aio import reap, reap_all, spawn, wait_drained
 
 POLL_INTERVAL_S = 0.05
 CLAIM_LEASE_S = 600.0
@@ -58,6 +59,7 @@ class FileBroker(Broker):
         self.root = Path(path)
         self.owner = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self._consumers: Dict[str, asyncio.Task] = {}
+        self._handler_tasks: set = set()  # strong refs to in-flight handlers
         self._declared: set = set()  # skip per-publish mkdir/meta churn
         self._connected = False
 
@@ -88,6 +90,10 @@ class FileBroker(Broker):
     async def close(self) -> None:
         for tag in list(self._consumers):
             await self.cancel(tag)
+        # Give in-flight handlers a short drain window, then cancel; the
+        # janitor requeues anything left claimed, so this is at-least-once.
+        await wait_drained(self._handler_tasks, timeout=5.0)
+        await reap_all(self._handler_tasks, label="file handler task")
         self._connected = False
 
     async def declare_queue(
@@ -110,7 +116,9 @@ class FileBroker(Broker):
             meta["max_redeliveries"] = max_redeliveries
         if meta:
             tmp = self._meta_path(name).with_suffix(".tmp")
-            tmp.write_text(json.dumps(meta))
+            # Deliberate sync I/O: meta files are tens of bytes, written once
+            # per queue declaration — not worth a thread hop.
+            tmp.write_text(json.dumps(meta))  # llmq: ignore[blocking-async-io]
             tmp.replace(self._meta_path(name))
 
     # --- publish ----------------------------------------------------------
@@ -281,19 +289,19 @@ class FileBroker(Broker):
                     finally:
                         sem.release()
 
-                asyncio.ensure_future(run())
+                spawn(
+                    run(),
+                    registry=self._handler_tasks,
+                    name=f"file-handler:{queue}",
+                )
 
         self._consumers[tag] = asyncio.ensure_future(loop())
         return tag
 
     async def cancel(self, consumer_tag: str) -> None:
-        task = self._consumers.pop(consumer_tag, None)
-        if task is not None:
-            task.cancel()
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+        await reap(
+            self._consumers.pop(consumer_tag, None), label="file consume loop"
+        )
 
     async def get(self, queue: str) -> Optional[DeliveredMessage]:
         await self.declare_queue(queue)

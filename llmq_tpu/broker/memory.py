@@ -26,6 +26,7 @@ from llmq_tpu.broker.base import (
     new_message_id,
 )
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils.aio import spawn
 
 DEFAULT_MAX_REDELIVERIES = 3
 FAILED_SUFFIX = ".failed"
@@ -83,6 +84,9 @@ class BrokerCore:
     def __init__(self) -> None:
         self.queues: Dict[str, QueueCore] = {}
         self._dispatch_scheduled: set[str] = set()
+        # Strong refs to in-flight handler tasks (the event loop holds only
+        # weak ones); tasks remove themselves on completion via spawn().
+        self.handler_tasks: set[asyncio.Task] = set()
         self.on_dead_letter: Optional[Callable[[str, StoredMessage], None]] = None
         self.on_redeliver: Optional[Callable[[str, StoredMessage], None]] = None
 
@@ -164,7 +168,11 @@ class BrokerCore:
                 headers=msg.headers,
                 _settle=self._settler(queue, msg.message_id),
             )
-            asyncio.ensure_future(self._run_handler(consumer, delivered))
+            spawn(
+                self._run_handler(consumer, delivered),
+                registry=self.handler_tasks,
+                name=f"dispatch:{queue}",
+            )
 
     async def _run_handler(
         self, consumer: _Consumer, message: DeliveredMessage
@@ -309,6 +317,9 @@ class BrokerCore:
         return ids
 
 
+# Placeholder handler for get_one's transient consumer: the caller of get()
+# owns settling the returned message, so this handler never runs it.
+# llmq: ignore[settle-exhaustive]
 async def _noop_handler(message: DeliveredMessage) -> None:
     return None
 

@@ -42,6 +42,7 @@ from llmq_tpu.broker.base import (
     make_broker,
 )
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils.aio import reap
 
 logger = logging.getLogger(__name__)
 
@@ -155,13 +156,8 @@ class ResilientBroker(Broker):
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
-        if self._reconnect_task is not None:
-            self._reconnect_task.cancel()
-            try:
-                await self._reconnect_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._reconnect_task = None
+        await reap(self._reconnect_task, label="reconnect loop")
+        self._reconnect_task = None
         await self._close_inner()
         self._connected.clear()
 

@@ -30,7 +30,7 @@ import os
 import struct
 import uuid
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Set
 
 from llmq_tpu.broker.base import (
     Broker,
@@ -42,6 +42,7 @@ from llmq_tpu.broker.base import (
 )
 from llmq_tpu.broker.memory import BrokerCore
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils.aio import reap, reap_all, spawn
 
 logger = logging.getLogger(__name__)
 
@@ -451,6 +452,9 @@ class TcpBroker(Broker):
         self._write_lock: Optional[asyncio.Lock] = None
         self._req_seq = 0
         self._lost = False
+        # Strong refs to in-flight handler tasks: the loop only keeps weak
+        # ones, so a naked ensure_future could be GC'd mid-delivery.
+        self._handler_tasks: Set[asyncio.Task] = set()
 
     @property
     def is_connected(self) -> bool:
@@ -468,13 +472,11 @@ class TcpBroker(Broker):
         await self._request({"op": "ping"})
 
     async def close(self) -> None:
-        if self._recv_task is not None:
-            self._recv_task.cancel()
-            try:
-                await self._recv_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._recv_task = None
+        await reap(self._recv_task, label="tcp recv loop")
+        self._recv_task = None
+        # Unfinished deliveries are cancelled; the server requeues anything
+        # unacked once the connection drops, so this is loss-free.
+        await reap_all(self._handler_tasks, label="tcp handler task")
         if self._writer is not None:
             self._writer.close()
             try:
@@ -516,7 +518,11 @@ class TcpBroker(Broker):
                 handler = self._handlers.get(tag)
                 if handler is not None:
                     message = self._delivered_from(frame)
-                    asyncio.ensure_future(self._run_handler(handler, message))
+                    spawn(
+                        self._run_handler(handler, message),
+                        registry=self._handler_tasks,
+                        name=f"tcp-handler:{tag}",
+                    )
                 else:
                     self._undispatched.setdefault(tag, []).append(frame)
 
@@ -621,7 +627,11 @@ class TcpBroker(Broker):
         self._handlers[tag] = handler
         for frame in self._undispatched.pop(tag, []):
             message = self._delivered_from(frame)
-            asyncio.ensure_future(self._run_handler(handler, message))
+            spawn(
+                self._run_handler(handler, message),
+                registry=self._handler_tasks,
+                name=f"tcp-handler:{tag}",
+            )
         return tag
 
     async def cancel(self, consumer_tag: str) -> None:

@@ -211,6 +211,27 @@ def clear(queue, yes):
 
 
 # ---------------------------------------------------------------------------
+# static analysis
+# ---------------------------------------------------------------------------
+
+
+@cli.command(
+    context_settings={"ignore_unknown_options": True, "help_option_names": []}
+)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def lint(args):
+    """Run the llmq AST lint pass (same as `python -m llmq_tpu.analysis`).
+
+    Checks the async broker/worker/engine invariants: orphan tasks,
+    settle exhaustiveness, blocking calls in async code, swallowed
+    cancellation, and JAX host syncs. Try `llmq-tpu lint --list-rules`.
+    """
+    from llmq_tpu.analysis.cli import main as lint_main
+
+    sys.exit(lint_main(list(args)))
+
+
+# ---------------------------------------------------------------------------
 # workers
 # ---------------------------------------------------------------------------
 

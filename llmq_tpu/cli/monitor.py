@@ -7,6 +7,8 @@
 
 from __future__ import annotations
 
+import logging
+
 from typing import List, Optional
 
 from rich.console import Console
@@ -17,6 +19,8 @@ from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import load_pipeline_config
 from llmq_tpu.workers.base import HEALTH_SUFFIX, HEARTBEAT_INTERVAL_S
+
+logger = logging.getLogger(__name__)
 
 # A worker that has missed two consecutive heartbeats is presumed wedged
 # (or cut off from the broker) even if its old heartbeat is still readable.
@@ -116,8 +120,8 @@ async def check_health(queue: str) -> None:
                 prev = beats.get(health.worker_id)
                 if prev is None or health.last_seen >= prev.last_seen:
                     beats[health.worker_id] = health
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:  # noqa: BLE001 — skip malformed beats
+                logger.debug("Skipping malformed heartbeat: %s", exc)
         for msg in peeked:
             # Non-destructive: keep heartbeats readable for the next check
             # (they expire via queue TTL anyway).

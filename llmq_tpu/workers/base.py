@@ -177,7 +177,6 @@ class BaseWorker(abc.ABC):
             self.logger.error("Unparseable job dead-lettered: %s", exc)
             self.jobs_failed += 1
             await self._dead_letter_unparseable(message, exc)
-            await message.reject(requeue=False)
             self._settle_in_flight()
             return
         try:
@@ -237,7 +236,8 @@ class BaseWorker(abc.ABC):
         """Corrupt payloads can't round-trip the normal redelivery path
         (they never parse into a Job), but they must not vanish either —
         file them in ``<queue>.failed`` so `llmq-tpu errors` can show what
-        arrived and why."""
+        arrived and why. Settles the message on every path (reject without
+        requeue: the copy now lives in the DLQ)."""
         headers = dict(message.headers or {})
         headers["x-error"] = f"unparseable job payload: {exc}"
         headers["x-worker-id"] = self.worker_id
@@ -253,6 +253,8 @@ class BaseWorker(abc.ABC):
             self.logger.warning(
                 "Could not dead-letter unparseable payload", exc_info=True
             )
+        finally:
+            await message.reject(requeue=False)
 
     def _settle_in_flight(self) -> None:
         self._in_flight -= 1

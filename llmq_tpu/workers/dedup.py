@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from llmq_tpu.core.models import Job
+from llmq_tpu.utils.aio import reap
 from llmq_tpu.workers.base import BaseWorker
 
 DROPPED_MARKER = "DEDUP_DROPPED"
@@ -284,8 +285,8 @@ class DedupWorker(BaseWorker):
                 pending.future.set_result(text if kept else DROPPED_MARKER)
 
     async def _cleanup_processor(self) -> None:
-        if self._flusher is not None:
-            self._flusher.cancel()
+        await reap(self._flusher, label="dedup idle flusher")
+        self._flusher = None
         assert self._batch_lock is not None
         async with self._batch_lock:
             flush = self._pending

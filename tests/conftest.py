@@ -31,6 +31,10 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import llmq_tpu.broker.memory as memory_broker  # noqa: E402
+from llmq_tpu.analysis.pytest_plugin import (  # noqa: E402
+    pytest_configure,  # noqa: F401 — registers the task_sanitizer marker
+    run_async_test,
+)
 
 
 @pytest.hookimpl(tryfirst=True)
@@ -41,7 +45,10 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        # Lenient by default (log + cancel leaked tasks — what asyncio.run
+        # already does); `@pytest.mark.task_sanitizer` or
+        # LLMQ_TASK_SANITIZER=strict makes leaks fail the test.
+        run_async_test(fn, kwargs, pyfuncitem)
         return True
     return None
 

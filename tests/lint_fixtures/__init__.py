@@ -1,0 +1,8 @@
+"""Known-good/known-bad snippet modules for the llmq lint pass.
+
+Each ``*_cases.py`` module covers one rule. Lines where the analyzer must
+report a violation carry an ``# EXPECT[rule-id]`` marker; the tests diff
+the analyzer's output against those markers exactly (rule id + line), so
+a checker that drifts (wrong line, missed case, new false positive) fails
+loudly. These modules are data for the AST pass — imported by nothing.
+"""

@@ -1,0 +1,46 @@
+"""blocking-async / blocking-async-io: blocking calls inside async def."""
+
+import asyncio
+import subprocess
+import time
+from time import sleep
+
+
+async def bad_time_sleep():
+    time.sleep(1.0)  # EXPECT[blocking-async]
+
+
+async def bad_from_import_sleep():
+    sleep(1.0)  # EXPECT[blocking-async]
+
+
+async def bad_subprocess():
+    subprocess.run(["true"])  # EXPECT[blocking-async]
+
+
+async def bad_open(path):
+    with open(path) as fh:  # EXPECT[blocking-async-io]
+        return fh.readline()
+
+
+async def bad_pathlib_io(path):
+    return path.read_text()  # EXPECT[blocking-async-io]
+
+
+def good_sync_function():
+    time.sleep(0.1)  # sync code may block
+
+
+async def good_async_sleep():
+    await asyncio.sleep(1.0)
+
+
+async def good_nested_sync_helper():
+    def helper():
+        time.sleep(0.1)  # runs wherever it is called, not on this loop
+
+    return helper
+
+
+async def suppressed():
+    time.sleep(0.01)  # llmq: ignore[blocking-async]

@@ -1,0 +1,77 @@
+"""cancelled-swallow: except clauses that eat cancellation in async loops."""
+
+import asyncio
+
+
+async def bad_bare_except():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except:  # noqa: E722  # EXPECT[cancelled-swallow]
+            pass
+
+
+async def bad_catches_cancelled():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except (asyncio.CancelledError, Exception):  # EXPECT[cancelled-swallow]
+            pass
+
+
+async def bad_base_exception():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except BaseException:  # EXPECT[cancelled-swallow]
+            continue
+
+
+async def bad_silent_broad_retry():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except Exception:  # EXPECT[cancelled-swallow]
+            continue
+
+
+async def good_reraises():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            print("retrying after", exc)
+
+
+async def good_breaks_out():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except BaseException:
+            break
+
+
+async def good_bounded_loop(running):
+    while running:
+        try:
+            await asyncio.sleep(1.0)
+        except Exception:
+            pass  # condition loop: cancellation exits via the test
+
+
+def good_sync_loop():
+    while True:
+        try:
+            return
+        except Exception:
+            pass
+
+
+async def suppressed():
+    while True:
+        try:
+            await asyncio.sleep(1.0)
+        except BaseException:  # llmq: ignore[cancelled-swallow]
+            pass

@@ -1,0 +1,64 @@
+"""jax-host-sync / jax-donate: host syncs and missing donation in jit code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_numpy_sync(x):
+    y = np.asarray(x)  # EXPECT[jax-host-sync]
+    return jnp.sum(y)
+
+
+@jax.jit
+def bad_device_get(x):
+    jax.device_get(x)  # EXPECT[jax-host-sync]
+    return x
+
+
+@jax.jit
+def bad_block_until_ready(x):
+    x.block_until_ready()  # EXPECT[jax-host-sync]
+    return x
+
+
+@jax.jit
+def bad_coercion(x):
+    scale = float(x)  # EXPECT[jax-host-sync]
+    return scale
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def good_static_coercion(x, *, block_size):
+    return x * int(block_size)  # static arg: concrete at trace time
+
+
+def good_untraced(x):
+    return float(np.asarray(x))  # host code may sync
+
+
+def hot_helper(x):
+    return np.asarray(x)  # EXPECT-HOT[jax-host-sync] via --hot-path
+
+
+@jax.jit
+def bad_decode_step(tokens, k_pages, v_pages):  # EXPECT[jax-donate]
+    return tokens, k_pages, v_pages
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2))
+def good_donated_step(tokens, k_pages, v_pages):
+    return tokens, k_pages, v_pages
+
+
+@jax.jit
+def good_readonly_attention(q, k_pages, v_pages):
+    return q  # not a step function: read-only kernels must not donate
+
+
+@jax.jit
+def suppressed_sync(x):
+    return x.item()  # llmq: ignore[jax-host-sync]

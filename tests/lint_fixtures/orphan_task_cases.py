@@ -1,0 +1,43 @@
+"""orphan-task: fire-and-forget tasks vs. held/awaited/owned ones."""
+
+import asyncio
+from asyncio import ensure_future
+
+
+async def bad_fire_and_forget(coro):
+    asyncio.ensure_future(coro)  # EXPECT[orphan-task]
+
+
+async def bad_create_task(coro):
+    asyncio.create_task(coro)  # EXPECT[orphan-task]
+
+
+async def bad_bare_name(coro):
+    ensure_future(coro)  # EXPECT[orphan-task]
+
+
+async def good_assigned(coro, registry):
+    task = asyncio.ensure_future(coro)
+    registry.add(task)
+    await task
+
+
+async def good_awaited(coro):
+    await asyncio.ensure_future(coro)
+
+
+async def good_chained_callback(coro, on_done):
+    asyncio.create_task(coro).add_done_callback(on_done)
+
+
+async def good_passed_along(coro, tasks):
+    tasks.append(asyncio.ensure_future(coro))
+
+
+async def good_taskgroup(coro):
+    async with asyncio.TaskGroup() as tg:
+        tg.create_task(coro)
+
+
+async def suppressed(coro):
+    asyncio.ensure_future(coro)  # llmq: ignore[orphan-task]

@@ -1,0 +1,66 @@
+"""settle-exhaustive: every path must ack/reject, raise, or delegate."""
+
+from llmq_tpu.broker.base import DeliveredMessage
+
+
+async def bad_falls_off_end(message: DeliveredMessage):  # EXPECT[settle-exhaustive]
+    if message.delivery_count > 3:
+        await message.reject(requeue=False)
+
+
+async def bad_returns_unsettled(message: DeliveredMessage):  # EXPECT[settle-exhaustive]
+    if message.delivery_count > 3:
+        return
+    await message.ack()
+
+
+async def bad_exception_branch(message: DeliveredMessage):  # EXPECT[settle-exhaustive]
+    try:
+        await message.ack()
+    except ValueError:
+        message.headers.clear()
+
+
+async def good_all_branches(message: DeliveredMessage):
+    try:
+        await message.ack()
+    except ValueError:
+        await message.reject(requeue=True)
+
+
+async def good_raise_is_settlement(message: DeliveredMessage):
+    if message.delivery_count > 3:
+        await message.reject(requeue=False)
+        return
+    raise RuntimeError("dispatch layer catches and rejects")
+
+
+async def good_finally_settles(message: DeliveredMessage):
+    try:
+        len(message.body)
+    finally:
+        await message.ack()
+
+
+async def good_delegates(message: DeliveredMessage, handler):
+    await handler(message)
+
+
+async def good_stored(message: DeliveredMessage, pending):
+    pending["slot"] = message
+
+
+async def good_deferred_closure(message: DeliveredMessage):
+    async def settle_later():
+        await message.ack()
+
+    return settle_later
+
+
+def good_unannotated(message):
+    return message  # no DeliveredMessage annotation: out of scope
+
+
+# llmq: ignore[settle-exhaustive]
+async def suppressed(message: DeliveredMessage):
+    return

@@ -1,0 +1,126 @@
+"""Marker-driven tests for the AST checkers.
+
+Each fixture module in ``tests/lint_fixtures`` carries ``# EXPECT[rule-id]``
+markers on the exact lines where the analyzer must report. The tests diff
+the analyzer's (line, rule) output against those markers with set equality,
+so a checker that drifts — wrong line, missed case, new false positive —
+fails loudly.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from llmq_tpu.analysis import AnalysisContext, analyze_paths, analyze_source
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+_EXPECT_RE = re.compile(r"#\s*EXPECT\[([a-z\-]+)\]")
+
+
+def expected_markers(path: Path):
+    expected = set()
+    for lineno, line in enumerate(
+        path.read_text(encoding="utf-8").splitlines(), start=1
+    ):
+        for match in _EXPECT_RE.finditer(line):
+            expected.add((lineno, match.group(1)))
+    return expected
+
+
+CASES = [
+    ("orphan_task_cases.py", {"orphan-task"}),
+    ("settle_cases.py", {"settle-exhaustive"}),
+    ("blocking_cases.py", {"blocking-async", "blocking-async-io"}),
+    ("cancellation_cases.py", {"cancelled-swallow"}),
+    ("jax_cases.py", {"jax-host-sync", "jax-donate"}),
+]
+
+
+@pytest.mark.unit
+@pytest.mark.parametrize("fixture, rules", CASES, ids=[c[0] for c in CASES])
+def test_fixture_matches_markers_exactly(fixture, rules):
+    path = FIXTURES / fixture
+    expected = expected_markers(path)
+    assert expected, f"{fixture} has no EXPECT markers"
+    assert {rule for _, rule in expected} <= rules, "marker/rule mismatch"
+    found = {(v.line, v.rule_id) for v in analyze_paths([str(path)])}
+    assert found == expected
+
+
+@pytest.mark.unit
+def test_hot_path_list_flags_undecorated_function():
+    path = FIXTURES / "jax_cases.py"
+    text = path.read_text(encoding="utf-8")
+    hot_line = next(
+        i
+        for i, line in enumerate(text.splitlines(), start=1)
+        if "EXPECT-HOT[jax-host-sync]" in line
+    )
+    without = {(v.line, v.rule_id) for v in analyze_paths([str(path)])}
+    assert (hot_line, "jax-host-sync") not in without
+    with_hot = {
+        (v.line, v.rule_id)
+        for v in analyze_paths(
+            [str(path)], ctx=AnalysisContext(hot_paths={"hot_helper"})
+        )
+    }
+    assert (hot_line, "jax-host-sync") in with_hot
+
+
+BAD_SNIPPET = "import asyncio\n\n\nasync def f(c):\n    asyncio.ensure_future(c)\n"
+
+
+@pytest.mark.unit
+def test_suppression_same_line():
+    suppressed = BAD_SNIPPET.replace(
+        "ensure_future(c)", "ensure_future(c)  # llmq: ignore[orphan-task]"
+    )
+    assert analyze_source("x.py", BAD_SNIPPET)
+    assert analyze_source("x.py", suppressed) == []
+
+
+@pytest.mark.unit
+def test_suppression_line_above():
+    suppressed = BAD_SNIPPET.replace(
+        "    asyncio.ensure_future(c)",
+        "    # llmq: ignore[orphan-task]\n    asyncio.ensure_future(c)",
+    )
+    assert analyze_source("x.py", suppressed) == []
+
+
+@pytest.mark.unit
+def test_suppression_file_level():
+    assert (
+        analyze_source("x.py", "# llmq: ignore-file[orphan-task]\n" + BAD_SNIPPET)
+        == []
+    )
+    assert (
+        analyze_source("x.py", "# llmq: ignore-file\n" + BAD_SNIPPET) == []
+    )
+
+
+@pytest.mark.unit
+def test_suppression_wrong_rule_id_does_not_suppress():
+    mis_suppressed = BAD_SNIPPET.replace(
+        "ensure_future(c)", "ensure_future(c)  # llmq: ignore[jax-donate]"
+    )
+    found = analyze_source("x.py", mis_suppressed)
+    assert [v.rule_id for v in found] == ["orphan-task"]
+
+
+@pytest.mark.unit
+def test_severity_tiers():
+    found = analyze_paths([str(FIXTURES / "blocking_cases.py")])
+    severities = {v.rule_id: v.severity for v in found}
+    assert severities["blocking-async"] == "error"
+    assert severities["blocking-async-io"] == "warning"
+
+
+@pytest.mark.unit
+def test_unparseable_file_reports_parse_error(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def oops(:\n")
+    found = analyze_paths([str(broken)])
+    assert [v.rule_id for v in found] == ["parse-error"]
+    assert found[0].severity == "error"

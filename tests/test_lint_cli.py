@@ -1,0 +1,149 @@
+"""CLI + reporter behavior: exit codes, JSON shape, rule selection, and the
+acceptance check that seeding each violation class into a scratch file is
+caught with the right rule id and line number."""
+
+import json
+
+import pytest
+
+from llmq_tpu.analysis.cli import main as lint_main
+
+#: One module seeding every violation class the pass hunts.
+SEED = """\
+import asyncio
+import time
+
+import jax
+import numpy as np
+
+from llmq_tpu.broker.base import DeliveredMessage
+
+
+async def spawn_and_forget(coro):
+    asyncio.ensure_future(coro)
+
+
+async def leak_message(message: DeliveredMessage):
+    if message.delivery_count > 1:
+        await message.ack()
+
+
+async def stall_loop():
+    time.sleep(5)
+
+
+async def swallow_cancel():
+    while True:
+        try:
+            await asyncio.sleep(1)
+        except BaseException:
+            pass
+
+
+@jax.jit
+def sync_inside_jit(x):
+    return np.asarray(x)
+
+
+@jax.jit
+def decode_step(tokens, kv_cache):
+    return tokens, kv_cache
+"""
+
+
+def _line_of(needle: str) -> int:
+    for i, line in enumerate(SEED.splitlines(), start=1):
+        if needle in line:
+            return i
+    raise AssertionError(f"{needle!r} not in SEED")
+
+
+EXPECTED = {
+    ("orphan-task", _line_of("ensure_future(coro)")),
+    ("settle-exhaustive", _line_of("def leak_message")),
+    ("blocking-async", _line_of("time.sleep(5)")),
+    ("cancelled-swallow", _line_of("except BaseException:")),
+    ("jax-host-sync", _line_of("np.asarray(x)")),
+    ("jax-donate", _line_of("def decode_step")),
+}
+
+
+@pytest.fixture()
+def seed_file(tmp_path):
+    path = tmp_path / "seed.py"
+    path.write_text(SEED)
+    return path
+
+
+@pytest.mark.unit
+def test_seeded_violations_exit_nonzero_with_rule_and_line(seed_file, capsys):
+    rc = lint_main([str(seed_file), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    found = {(v["rule"], v["line"]) for v in payload["violations"]}
+    assert found == EXPECTED
+    assert payload["counts"]["total"] == len(EXPECTED)
+    assert payload["counts"]["errors"] == len(EXPECTED)
+    assert payload["counts"]["by_rule"]["orphan-task"] == 1
+
+
+@pytest.mark.unit
+def test_text_report_renders_path_line_rule(seed_file, capsys):
+    rc = lint_main([str(seed_file)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = _line_of("time.sleep(5)")
+    assert f"{seed_file}:{line}:4: blocking-async [error]" in out
+    assert f"{len(EXPECTED)} error(s), 0 warning(s) across 1 file(s)" in out
+
+
+@pytest.mark.unit
+def test_select_restricts_to_one_rule(seed_file, capsys):
+    rc = lint_main([str(seed_file), "--select", "orphan-task", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {v["rule"] for v in payload["violations"]} == {"orphan-task"}
+
+
+@pytest.mark.unit
+def test_ignore_can_silence_everything(seed_file, capsys):
+    argv = [str(seed_file), "--format", "json"]
+    for rule_id, _ in EXPECTED:
+        argv += ["--ignore", rule_id]
+    rc = lint_main(argv)
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["violations"] == []
+
+
+@pytest.mark.unit
+def test_unknown_rule_id_is_usage_error(seed_file, capsys):
+    assert lint_main([str(seed_file), "--select", "no-such-rule"]) == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+@pytest.mark.unit
+def test_clean_file_exits_zero(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("async def ok():\n    return 1\n")
+    assert lint_main([str(clean)]) == 0
+    assert "clean: no violations" in capsys.readouterr().out
+
+
+@pytest.mark.unit
+def test_warning_passes_unless_strict(tmp_path, capsys):
+    warn_only = tmp_path / "warn.py"
+    warn_only.write_text(
+        "async def f(path):\n    return path.read_text()\n"
+    )
+    assert lint_main([str(warn_only)]) == 0
+    capsys.readouterr()
+    assert lint_main([str(warn_only), "--strict"]) == 1
+
+
+@pytest.mark.unit
+def test_list_rules_covers_all_checkers(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id, _ in EXPECTED:
+        assert rule_id in out

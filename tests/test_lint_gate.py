@@ -1,0 +1,35 @@
+"""CI lint gate: the analyzer must run clean over the shipped package.
+
+This is the enforcement half of the static pass — any PR introducing an
+orphan task, an unsettled message path, a blocking call in a coroutine, a
+cancellation-swallowing loop, or a host sync in jitted code fails here
+with the exact file:line:rule, before review.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from llmq_tpu.analysis import analyze_paths
+
+PACKAGE_ROOT = Path(__file__).parent.parent / "llmq_tpu"
+
+
+@pytest.mark.unit
+def test_package_has_no_error_violations():
+    violations = analyze_paths([str(PACKAGE_ROOT)])
+    errors = [v for v in violations if v.severity == "error"]
+    assert not errors, "new lint violations:\n" + "\n".join(
+        v.render() for v in errors
+    )
+
+
+@pytest.mark.unit
+def test_package_warning_budget():
+    # Warnings don't fail the build, but they must not accumulate silently:
+    # bump this budget only with a pragma-level justification in the diff.
+    violations = analyze_paths([str(PACKAGE_ROOT)])
+    warnings = [v for v in violations if v.severity == "warning"]
+    assert len(warnings) <= 0, "lint warnings grew:\n" + "\n".join(
+        v.render() for v in warnings
+    )
