@@ -36,7 +36,14 @@ headline at both candidates),
 LLMQ_BENCH_TRY_QUANT=0 (skip the int8+fp8 subprocess attempt that
 otherwise runs first on accelerators and wins the emit when it clearly
 beats baseline), LLMQ_BENCH_QUANT_TIMEOUT (its budget, default 1500 s — the int8
-ladder tries up to three slot counts).
+ladder tries up to three slot counts), LLMQ_BENCH_DECODE_BLOCK (pin the
+fused decode-block size K; unset -> the ladder measures K=2/4 at the
+winning slot count after the slot ladder and emits the best).
+
+When the remaining LLMQ_BENCH_DEADLINE budget cannot fit the whole plan
+(quant attempt + kernel A/B + the multi-candidate ladder), phases are
+trimmed in speculation order — see trim_plan() — down to, at minimum,
+one bf16 headline at the proven 192-slot config.
 """
 
 from __future__ import annotations
@@ -323,6 +330,59 @@ def _kernel_ab_probe_main() -> None:
 # a 0.0 failure line if the later bf16 run dies.
 _QUANT_FALLBACK: Optional[dict] = None
 
+# Wall-clock deadline (time.monotonic()) set in __main__ when the emit
+# watchdog is armed; trim_plan() reads the remaining budget through
+# _remaining_budget() to decide which phases still fit.
+_DEADLINE_AT: Optional[float] = None
+
+# The proven operating point: bf16, 192 slots (r05 ladder winner —
+# 224 fit but measured ~3% slower). When the deadline can't fit the
+# speculative phases, the bench skips straight here.
+_PROVEN_BF16_SEQS = 192
+
+
+def _remaining_budget() -> Optional[float]:
+    """Seconds left before the emit watchdog fires (None = no deadline)."""
+    if _DEADLINE_AT is None:
+        return None
+    return _DEADLINE_AT - time.monotonic()
+
+
+def trim_plan(
+    remaining_s: Optional[float],
+    *,
+    quant_s: float,
+    ab_s: float,
+    ladder_extra_s: float,
+    proven_s: float,
+) -> dict:
+    """Budget-aware phase trimming (pure — unit-tested in
+    tests/test_bench.py). Given the seconds left on LLMQ_BENCH_DEADLINE
+    and per-phase cost estimates, decide which phases run:
+
+    - ``quant``: the int8+fp8 subprocess attempt (cost: its timeout),
+    - ``kernel_ab``: the decode-kernel A/B subprocess (its timeout),
+    - ``full_ladder``: every bf16 slot/decode-block candidate beyond the
+      proven config (``ladder_extra_s`` extra build+measure cost).
+
+    The proven bf16 headline (``proven_s``) is the floor and is never
+    dropped — a bench that measures *something* always beats a watchdog
+    0.0. Drop order is by speculation: the quant attempt first (longest
+    budget, most failure modes), then the extra ladder rungs, then the
+    kernel A/B; each phase runs only if everything still planned fits
+    the remaining budget. No deadline (None) runs everything.
+    """
+    if remaining_s is None:
+        return {"quant": True, "kernel_ab": True, "full_ladder": True}
+    budget = remaining_s - proven_s  # the floor is reserved first
+    if budget >= quant_s + ab_s + ladder_extra_s:
+        return {"quant": True, "kernel_ab": True, "full_ladder": True}
+    if budget >= ab_s + ladder_extra_s:
+        return {"quant": False, "kernel_ab": True, "full_ladder": True}
+    if budget >= ab_s:
+        return {"quant": False, "kernel_ab": True, "full_ladder": False}
+    return {"quant": False, "kernel_ab": False, "full_ladder": False}
+
 
 def _try_quantized_headline() -> Optional[dict]:
     """Attempt the strongest measured-candidate config — int8 weights +
@@ -469,17 +529,39 @@ def main() -> None:
     # a healthy backend probe so a dead tunnel costs one probe timeout,
     # not the A/B budget too.
     ab_choice = None
+    # Budget-aware trimming: on a short remaining deadline the
+    # speculative phases are dropped (quant attempt first, then extra
+    # ladder rungs, then the kernel A/B) so the run always lands a real
+    # bf16 measurement instead of a watchdog 0.0.
+    plan = trim_plan(
+        _remaining_budget(),
+        quant_s=float(os.environ.get("LLMQ_BENCH_QUANT_TIMEOUT", 1500)),
+        ab_s=float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420)),
+        # Extra rungs beyond the proven config: one more slot count and
+        # the decode-block ladder, ~4 min of builds+measures each.
+        ladder_extra_s=720.0,
+        proven_s=300.0,
+    )
+    if not all(plan.values()):
+        print(
+            f"bench: deadline budget trims the plan to {plan}",
+            file=sys.stderr,
+        )
     quant_eligible = (
-        os.environ.get("LLMQ_BENCH_TRY_QUANT", "1").lower()
+        plan["quant"]
+        and os.environ.get("LLMQ_BENCH_TRY_QUANT", "1").lower()
         not in ("0", "false")
         and not os.environ.get("LLMQ_BENCH_QUANT_CHILD")
         and not os.environ.get("LLMQ_BENCH_DTYPE")
         and not os.environ.get("LLMQ_BENCH_KV_DTYPE")
         and not os.environ.get("LLMQ_BENCH_PRESET")
     )
+    ab_eligible = plan["kernel_ab"] and not os.environ.get(
+        "LLMQ_DECODE_KERNEL"
+    )
     if (
         os.environ.get("JAX_PLATFORMS", "") != "cpu"
-        and (quant_eligible or not os.environ.get("LLMQ_DECODE_KERNEL"))
+        and (quant_eligible or ab_eligible)
         and _probe_backend_subprocess(
             float(os.environ.get("LLMQ_BENCH_INIT_TIMEOUT", 120))
         )
@@ -505,7 +587,7 @@ def main() -> None:
                 )
                 global _QUANT_FALLBACK
                 _QUANT_FALLBACK = quant
-        if not os.environ.get("LLMQ_DECODE_KERNEL"):
+        if ab_eligible:
             ab_choice = pick_decode_kernel()
             # Export immediately: everything downstream — the fp8
             # canary included — must trace with the measured winner.
@@ -572,9 +654,18 @@ def main() -> None:
         # OOMs at bf16) likely fits and amortizes the weight stream
         # further. The ladder early-stops on the throughput peak.
         seqs_candidates = [256, 224, 192]
+    elif not plan["full_ladder"]:
+        # Deadline-trimmed: no budget for extra rungs — measure only the
+        # proven bf16 operating point.
+        seqs_candidates = [_PROVEN_BF16_SEQS]
     else:
         seqs_candidates = [224, 192]
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
+    # Decode-block ladder: LLMQ_BENCH_DECODE_BLOCK pins K; otherwise the
+    # winner slot count re-measures at K=2 and K=4 after the slot ladder
+    # (budget permitting) and the best K is emitted.
+    block_env = os.environ.get("LLMQ_BENCH_DECODE_BLOCK")
+    block_pin = int(block_env) if block_env else None
     print(
         f"bench: preset={preset} ({config.num_params()/1e9:.2f}B) on "
         f"{len(devices)}x {platform}, {n_requests} reqs, "
@@ -618,36 +709,47 @@ def main() -> None:
     # the window.
     best = None  # (tok_s, max_seqs, out_tokens, elapsed)
     last_exc = None
+    # LLMQ_BENCH_KV_DTYPE: "auto" (or empty) means "pick for me" — the
+    # compute dtype, exactly like unset. Anything else names the pool
+    # dtype explicitly ("fp8" -> float8_e5m2 pages, half the KV bytes;
+    # see EngineConfig.kv_dtype).
+    kv_env = (os.environ.get("LLMQ_BENCH_KV_DTYPE") or "").lower()
+    kv_dtype = kv_env if kv_env not in ("", "auto") else dtype
+
+    def build_core(max_seqs, block):
+        return EngineCore(
+            config,
+            params,
+            ByteTokenizer(),
+            mesh=mesh,
+            engine_config=EngineConfig(
+                max_num_seqs=max_seqs,
+                max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
+                kv_dtype=kv_dtype,
+                num_pages=256 if on_cpu else None,
+                # Fused multi-step decode: K device iterations per host
+                # dispatch (engine/engine.py decode_block).
+                decode_block=block,
+                # 128-token pages: the decode kernel DMAs one page
+                # per grid step, and 16 KB transfers are
+                # latency-bound ~6x off the bandwidth floor (measured
+                # round 2); 128-token pages make them 64 KB and
+                # quarter the grid.
+                page_size=page_size,
+                # 8-prompt prefill chunks: 2048-token batches
+                # amortize the weight stream ~24% better than the
+                # default 4 (measured).
+                max_prefill_batch=int(
+                    os.environ.get(
+                        "LLMQ_BENCH_PREFILL_BATCH", 2 if on_cpu else 8
+                    )
+                ),
+            ),
+        )
+
     for max_seqs in seqs_candidates:
         try:
-            core = EngineCore(
-                config,
-                params,
-                ByteTokenizer(),
-                mesh=mesh,
-                engine_config=EngineConfig(
-                    max_num_seqs=max_seqs,
-                    max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
-                    # LLMQ_BENCH_KV_DTYPE=fp8 -> float8_e5m2 page pool
-                    # (half the KV bytes; see EngineConfig.kv_dtype).
-                    kv_dtype=os.environ.get("LLMQ_BENCH_KV_DTYPE") or dtype,
-                    num_pages=256 if on_cpu else None,
-                    # 128-token pages: the decode kernel DMAs one page
-                    # per grid step, and 16 KB transfers are
-                    # latency-bound ~6x off the bandwidth floor (measured
-                    # round 2); 128-token pages make them 64 KB and
-                    # quarter the grid.
-                    page_size=page_size,
-                    # 8-prompt prefill chunks: 2048-token batches
-                    # amortize the weight stream ~24% better than the
-                    # default 4 (measured).
-                    max_prefill_batch=int(
-                        os.environ.get(
-                            "LLMQ_BENCH_PREFILL_BATCH", 2 if on_cpu else 8
-                        )
-                    ),
-                ),
-            )
+            core = build_core(max_seqs, block_pin or 1)
             run(1, "warmup-single")
             run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
             gen_before = core.total_generated_tokens
@@ -690,6 +792,53 @@ def main() -> None:
         raise last_exc or RuntimeError("no slot candidate fit")
     tok_s, max_seqs, out_tokens, elapsed = best
 
+    # Decode-block ladder at the winning slot count: K=1 is already
+    # measured (above); try the fused 2- and 4-iteration blocks and keep
+    # the best. Skipped when K is pinned via env or the deadline trimmed
+    # the ladder — the block rungs are exactly the kind of speculative
+    # extra the trim plan exists to shed.
+    best_block = block_pin or 1
+    for block in [] if (block_pin or not plan["full_ladder"]) else [2, 4]:
+        try:
+            core = build_core(max_seqs, block)
+            run(1, "warmup-single")
+            run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+            gen_before = core.total_generated_tokens
+            b_elapsed = run(n_requests, f"bench-s{max_seqs}-k{block}")
+            b_out = core.total_generated_tokens - gen_before
+            b_tok_s = b_out / b_elapsed
+            print(
+                f"bench: {max_seqs} slots, decode block {block} -> "
+                f"{b_tok_s:.1f} tok/s",
+                file=sys.stderr,
+            )
+            if b_tok_s > tok_s:
+                tok_s, out_tokens, elapsed, best_block = (
+                    b_tok_s, b_out, b_elapsed, block
+                )
+            elif b_tok_s < 0.98 * tok_s:
+                # Larger K only adds wasted post-finish iterations on
+                # top of whatever made this K lose; stop paying builds.
+                print(
+                    f"bench: decode block {block} past the peak; "
+                    "stopping ladder",
+                    file=sys.stderr,
+                )
+                core = None
+                break
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                f"bench: decode block {block} exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
     tok_s_chip = tok_s / len(devices)
     # MoE presets: throughput scales with ACTIVE params per token (the
     # FLOPs actually spent), not the total parameter count.
@@ -706,9 +855,10 @@ def main() -> None:
         "mfu": round(mfu, 4),
         "dtype": "int8" if int8 else str(jnp.dtype(dtype)),
         "max_seqs": max_seqs,
+        "decode_block": best_block,
         **(
-            {"kv_dtype": os.environ["LLMQ_BENCH_KV_DTYPE"]}
-            if os.environ.get("LLMQ_BENCH_KV_DTYPE")
+            {"kv_dtype": kv_env}
+            if kv_env not in ("", "auto")
             else {}
         ),
         "decode_kernel": ab_choice or os.environ.get("LLMQ_DECODE_KERNEL") or "v1",
@@ -729,10 +879,13 @@ elif __name__ == "__main__":
     # Whole-run watchdog: a tunnel can also wedge *after* init (first jit
     # compile / dispatch blocks in C). If the run exceeds the deadline,
     # the failure JSON still gets emitted before exiting.
+    _deadline = float(os.environ.get("LLMQ_BENCH_DEADLINE", 3600))
     _cancel = _arm_emit_watchdog(
-        float(os.environ.get("LLMQ_BENCH_DEADLINE", 3600)),
+        _deadline,
         "benchmark exceeded LLMQ_BENCH_DEADLINE (device dispatch hung?)",
     )
+    # trim_plan() measures the remaining budget against this deadline.
+    _DEADLINE_AT = time.monotonic() + _deadline
     try:
         main()
     except Exception as exc:  # noqa: BLE001 — the JSON line must print

@@ -273,9 +273,14 @@ def worker() -> None:
 @click.option("--prefix-caching", is_flag=True,
               help="Reuse cached KV for shared prompt prefixes "
                    "(requires --prefill-chunk)")
+@click.option("--decode-block", type=int, default=None,
+              help="Fused multi-step decode: device iterations per host "
+                   "dispatch (K tokens per round trip; a finished "
+                   "sequence wastes at most K-1 device iterations). "
+                   "Default: LLMQ_DECODE_BLOCK or 1")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
-               dtype, kv_dtype, prefill_chunk, prefix_caching):
+               dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -291,6 +296,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         dtype=dtype,
         prefill_chunk_size=prefill_chunk,
         enable_prefix_caching=prefix_caching,
+        decode_block=decode_block,
     )
 
 

@@ -26,6 +26,7 @@ def run_tpu_worker(
     kv_dtype: Optional[str] = None,
     prefill_chunk_size: Optional[int] = None,
     enable_prefix_caching: bool = False,
+    decode_block: Optional[int] = None,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
@@ -48,6 +49,7 @@ def run_tpu_worker(
         kv_dtype=kv_dtype,
         prefill_chunk_size=prefill_chunk_size,
         enable_prefix_caching=enable_prefix_caching,
+        decode_block=decode_block,
     )
     _run(worker)
 

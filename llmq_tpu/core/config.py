@@ -154,6 +154,13 @@ class Config(BaseModel):
         "(requires prefill_chunk_size).",
     )
 
+    decode_block: int = Field(
+        default_factory=lambda: _env_int("LLMQ_DECODE_BLOCK", default=1),
+        description="Fused multi-step decode: device iterations per host "
+        "dispatch (one lax.scan'd XLA computation returns a K-token "
+        "block per sequence). 1 = per-token dispatch.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

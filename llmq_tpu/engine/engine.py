@@ -66,7 +66,13 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.layout import Format, Layout
+try:  # jax >= 0.5: Format pairs a per-device Layout with a sharding
+    from jax.experimental.layout import Format, Layout
+except ImportError:  # jax 0.4.x: same pair, pre-rename names
+    from jax.experimental.layout import (
+        DeviceLocalLayout as Layout,
+        Layout as Format,
+    )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.engine import sampling as sampling_mod
@@ -110,12 +116,16 @@ class EngineConfig:
     page_size: int = 32
     num_pages: Optional[int] = None  # None → size from device HBM
     hbm_utilization: float = 0.9
-    # KV cache storage dtype. "fp8" (float8_e5m2, scale-free — the same
-    # trade vLLM's kv-cache-dtype=fp8 makes) halves KV bytes: double the
-    # page pool in the same HBM and half the decode-attention bandwidth.
-    # Compute stays f32 inside the kernels (pages are converted on-chip);
-    # accepts a jnp dtype or the strings "bf16"/"bfloat16"/"fp8"/
-    # "float8_e5m2"/"f32"/"float32".
+    # KV cache storage dtype. "fp8" here means float8_e5m2, stored
+    # scale-free (no per-tensor scaling factors). Note the vLLM mapping:
+    # vLLM's bare ``kv-cache-dtype=fp8`` is an alias for fp8_e4m3 (with
+    # calibrated scales); our choice matches vLLM's *explicit*
+    # ``fp8_e5m2`` option — e5m2 keeps bf16's exponent range so it needs
+    # no scales, trading mantissa instead. Either way the win is the
+    # same: half the KV bytes, so double the page pool in the same HBM
+    # and half the decode-attention bandwidth. Compute stays f32 inside
+    # the kernels (pages are converted on-chip); accepts a jnp dtype or
+    # the strings "bf16"/"bfloat16"/"fp8"/"float8_e5m2"/"f32"/"float32".
     kv_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 32
     max_prefill_batch: int = 4  # admitted seqs prefetched per iteration
@@ -137,7 +147,19 @@ class EngineConfig:
     # longer than this (latency floor for trickle arrivals; the clock
     # starts at the first deferred step, not at enqueue).
     admit_max_wait_s: float = 0.5
-    runahead: int = 8  # decode steps dispatched ahead of result reads
+    runahead: int = 8  # decode dispatches in flight ahead of result reads
+    # Fused multi-step decode: one compiled XLA computation runs this
+    # many decode iterations (a lax.scan over the single decode step —
+    # attention, KV write, LM head, on-device sampling with the key
+    # chain advanced on device) and returns a [K, S] token block, so the
+    # host dispatches, snapshots, and fetches once per K tokens instead
+    # of once per token. 1 = today's per-token dispatch (the exact same
+    # executable as before). The trade: a sequence that finishes at
+    # iteration j of a block still rides the remaining K-1 iterations as
+    # an inactive row (its tokens are discarded on the host), so large K
+    # wastes device work on short completions while shrinking host
+    # overhead on long ones; bench.py measures 1/2/4 and keeps the best.
+    decode_block: int = 1
     # Per-slot device-side stop-token-id capacity. Grows automatically
     # (drain + resync + jit retrace at the wider shape) when a request's
     # stop set exceeds it, so min_tokens suppression always covers the
@@ -145,6 +167,11 @@ class EngineConfig:
     stop_id_capacity: int = 8
 
     def __post_init__(self):
+        self.decode_block = int(self.decode_block)
+        if self.decode_block < 1:
+            raise ValueError(
+                f"decode_block={self.decode_block} (want >= 1)"
+            )
         if isinstance(self.kv_dtype, str):
             names = {
                 "bf16": jnp.bfloat16,
@@ -269,6 +296,9 @@ class EngineCore:
         self._repl = NamedSharding(self.mesh, P())
         self._slot1 = NamedSharding(self.mesh, P(slot_axis))
         self._slot2 = NamedSharding(self.mesh, P(slot_axis, None))
+        # Fused decode blocks stack K per-step token vectors: [K, S] with
+        # the slot axis second, so each device still owns its dp shard.
+        self._block1 = NamedSharding(self.mesh, P(None, slot_axis))
 
         self._eos_ids = set(model_config.eos_token_ids) | set(
             tokenizer.eos_token_ids
@@ -337,7 +367,8 @@ class EngineCore:
         # Counters for stats/heartbeats.
         self.total_prompt_tokens = 0
         self.total_generated_tokens = 0
-        self.decode_steps = 0
+        self.decode_steps = 0  # device decode iterations (K per dispatch)
+        self.decode_dispatches = 0  # host round trips for those iterations
         self.prefills = 0
         self._started_at = time.monotonic()
         self._resync()
@@ -412,6 +443,32 @@ class EngineCore:
             )
             out = jnp.where(active, next_tokens, 0)
             return out, kp, vp, advance_state(st, out, active)
+
+        def decode_block_step(params, kp, vp, st, *, mode):
+            """``decode_block`` fused decode iterations in ONE XLA
+            computation: a ``lax.scan`` over ``decode_step`` carrying
+            (kv pools, decode state) and stacking the per-iteration
+            token vectors into a [K, S] block. Everything the host used
+            to do between steps happens on device instead: the sampling
+            key chain advances because ``advance_state`` increments the
+            carried per-slot step counters that ``sample_tokens`` folds
+            into the (fixed) base keys, and per-row stopping works
+            because ``advance_state`` deactivates finished rows, whose
+            remaining iterations then emit token 0 and write no KV
+            (positions route to -1 / ctx_incl 0). Rows that finish at
+            iteration j still ride out iterations j+1..K-1 inactive —
+            the host discards those tokens when it processes the block.
+            """
+
+            def body(carry, _):
+                kp, vp, st = carry
+                out, kp, vp, st = decode_step(params, kp, vp, st, mode=mode)
+                return (kp, vp, st), out
+
+            (kp, vp, st), outs = jax.lax.scan(
+                body, (kp, vp, st), None, length=self.cfg.decode_block
+            )
+            return outs, kp, vp, st
 
         def sample_and_scatter(logits, valid, p_lengths, p_bt, p_slots,
                                p_keys, p_steps, p_temps, p_topks, p_topps,
@@ -491,6 +548,7 @@ class EngineCore:
         self._st_shardings = st_sh
         self._prefill_arg_shardings = (repl,) * 12
         self._decode_fn = decode_step
+        self._decode_block_fn = decode_block_step
         self._prefill_fn = prefill_step
         self._chunkfill_fn = chunkfill_step
         self._make_jits(self._param_shardings)
@@ -507,11 +565,20 @@ class EngineCore:
         repl, slot1 = self._repl, self._slot1
         kv = self._kv_format
         st_sh = self._st_shardings
+        # decode_block > 1 swaps in the fused K-iteration scan: same
+        # signature and donation, token output [K, S] instead of [S]
+        # (the host normalises both to 2-D when processing). K == 1
+        # keeps literally the pre-block executable.
+        fn, out0 = (
+            (self._decode_block_fn, self._block1)
+            if self.cfg.decode_block > 1
+            else (self._decode_fn, slot1)
+        )
         self._decode_jits = {
             mode: jax.jit(
-                partial(self._decode_fn, mode=mode),
+                partial(fn, mode=mode),
                 in_shardings=(param_spec, kv, kv, st_sh),
-                out_shardings=(slot1, kv, kv, st_sh),
+                out_shardings=(out0, kv, kv, st_sh),
                 donate_argnums=(1, 2, 3),
             )
             for mode in ("greedy", "stochastic", "filtered")
@@ -543,16 +610,22 @@ class EngineCore:
         measured round 4); compiling once with AUTO input layouts and
         re-putting the params in whatever XLA chose removes those copies
         for every subsequent step. Costs one extra compile at startup."""
-        from jax.experimental.layout import Format, Layout
-
         auto_ps = jax.tree.map(
             lambda sh: Format(Layout.AUTO, sh), self._param_shardings
         )
         kv = self._kv_format
+        # Probe the executable production actually dispatches: with
+        # decode blocks the scan body's preferred layouts are what the
+        # params should be pinned to.
+        fn, out0 = (
+            (self._decode_block_fn, self._block1)
+            if self.cfg.decode_block > 1
+            else (self._decode_fn, self._slot1)
+        )
         probe = jax.jit(
-            partial(self._decode_fn, mode="greedy"),
+            partial(fn, mode="greedy"),
             in_shardings=(auto_ps, kv, kv, self._st_shardings),
-            out_shardings=(self._slot1, kv, kv, self._st_shardings),
+            out_shardings=(out0, kv, kv, self._st_shardings),
             donate_argnums=(1, 2, 3),
         )
         # Runs after _resync, so the state spec comes straight from the
@@ -772,16 +845,27 @@ class EngineCore:
         if kind == "decode":
             self._pending_decodes -= 1
         tokens = np.asarray(out)  # transfer started at dispatch; ~ready
-        for row, seq, epoch in snapshot:
-            if (
-                seq.finish_reason is not None
-                or seq.rid not in self.scheduler.running
-                or seq.epoch != epoch
-            ):
-                # Finished, preempted, or preempted-and-readmitted (epoch
-                # mismatch) while this step was in flight.
-                continue
-            self._append_and_check(seq, int(tokens[row]), finished)
+        # Normalise to a [K, rows] block: prefill outputs and K=1 decode
+        # steps are 1-D [rows]; fused decode blocks are already [K, S].
+        # Iterating k-major reproduces exactly the per-step processing
+        # order K=1 had (all rows' token k before any row's token k+1).
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        for k_tokens in tokens:
+            for row, seq, epoch in snapshot:
+                if (
+                    seq.finish_reason is not None
+                    or seq.rid not in self.scheduler.running
+                    or seq.epoch != epoch
+                ):
+                    # Finished, preempted, or preempted-and-readmitted
+                    # (epoch mismatch) while this step was in flight —
+                    # including rows that finished or self-preempted at
+                    # an earlier iteration of this very block: their
+                    # remaining in-block tokens are lagged garbage (the
+                    # device rode them out inactive) and are discarded.
+                    continue
+                self._append_and_check(seq, int(k_tokens[row]), finished)
         self._processed_idx = idx
 
     def _flush_deferred(self) -> None:
@@ -1076,7 +1160,11 @@ class EngineCore:
         # and demanding lookahead pages for them here could cascade into
         # preempting/length-finishing a row whose chunk loop is still in
         # flight (zombie-slot corruption).
-        lookahead = self._pending_decodes + 2
+        # Each in-flight decode entry covers decode_block positions, and
+        # the dispatch below adds another block; +1 slack. (K=1 recovers
+        # the historical `pending + 2`.)
+        K = self.cfg.decode_block
+        lookahead = (self._pending_decodes + 1) * K + 1
         decodable = self._decodable_seqs()
         needs_pages = any(
             -(-self._page_target(seq, lookahead) // self.cfg.page_size)
@@ -1150,7 +1238,8 @@ class EngineCore:
         out, self.k_pages, self.v_pages, self._dev_state = self._decode_jits[
             self._mode
         ](self.params, self.k_pages, self.v_pages, self._dev_state)
-        self.decode_steps += 1
+        self.decode_steps += K
+        self.decode_dispatches += 1
         self._push_pending(
             "decode",
             out,
@@ -1279,13 +1368,23 @@ class EngineCore:
         if len(seq.output_ids) >= p.max_tokens:
             return "length"
         if p.stop and past_min:
-            # Bounded tail re-decode per step (a stop string spans at most
-            # its char count in tokens, +8 slack for multi-char tokens);
-            # the full decode + truncation happens once, at the match.
+            # Incremental detokenization: the decoded head is cached per
+            # sequence (Sequence.detok_text covers output_ids[:detok_len])
+            # and only the tail past it is decoded each token — the cache
+            # trails the end by at least `window` tokens (a stop string
+            # spans at most its char count in tokens, +8 slack for
+            # multi-char tokens), so a match can never hide entirely
+            # inside the frozen head. Before the cache, every token paid
+            # a window re-decode and a match paid O(output) full decodes.
             window = max(len(s) for s in p.stop) + 8
-            tail = self.tokenizer.decode(seq.output_ids[-window:])
-            if any(s in tail for s in p.stop):
-                text = self.tokenizer.decode(seq.output_ids)
+            tail = self._detok_tail(seq, window)
+            # Only chars that can span the head/tail seam plus the fresh
+            # tail need searching; the cached head was already searched
+            # when its chars were in the tail of an earlier check.
+            seam = max(len(s) for s in p.stop) - 1
+            hay = seq.detok_text[-seam:] + tail if seam > 0 else tail
+            if any(s in hay for s in p.stop):
+                text = seq.detok_text + tail
                 hits = [i for i in (text.find(s) for s in p.stop) if i >= 0]
                 if hits:
                     idx = min(hits)  # earliest match, not list order
@@ -1294,13 +1393,33 @@ class EngineCore:
                     return "stop"
         return None
 
+    def _detok_tail(self, seq: Sequence, window: int) -> str:
+        """Text of ``output_ids[detok_len:]``, advancing the cached head
+        so it stays exactly ``window`` tokens behind the end (never
+        fewer: late tokens could complete a stop string that starts in
+        the margin, and BPE detokenization of a token range is only
+        seam-stable a safe distance from the end)."""
+        n = len(seq.output_ids)
+        if seq.detok_len > n:  # output was truncated past the cache
+            seq.detok_len, seq.detok_text = 0, ""
+        if n - seq.detok_len > window:
+            m = n - window
+            seq.detok_text += self.tokenizer.decode(
+                seq.output_ids[seq.detok_len : m]
+            )
+            seq.detok_len = m
+        return self.tokenizer.decode(seq.output_ids[seq.detok_len :])
+
     def _trim_to_match(self, seq: Sequence, stops) -> None:
         """Drop output tokens past the stop-string match so token_ids and
-        usage agree with the truncated text (bounded: only the re-decoded
-        tail window can ever be trimmed)."""
-        lo = max(0, len(seq.output_ids) - (max(len(s) for s in stops) + 8))
+        usage agree with the truncated text (bounded: only tokens past
+        the cached head can ever be trimmed, and only their tail text is
+        re-decoded)."""
+        seam = max(len(s) for s in stops) - 1
+        head_tail = seq.detok_text[-seam:] if seam > 0 else ""
+        lo = seq.detok_len
         for n in range(lo, len(seq.output_ids) + 1):
-            head = self.tokenizer.decode(seq.output_ids[:n])
+            head = head_tail + self.tokenizer.decode(seq.output_ids[lo:n])
             if any(s in head for s in stops):
                 seq.output_ids = seq.output_ids[:n]
                 return
@@ -1372,6 +1491,11 @@ class EngineCore:
             prompt_tokens=self.total_prompt_tokens,
             generated_tokens=self.total_generated_tokens,
             decode_steps=self.decode_steps,
+            # Host round trips: with fused decode blocks the host
+            # dispatches/snapshots/fetches once per decode_block device
+            # iterations, so dispatches <= ceil(decode_steps / K).
+            decode_dispatches=self.decode_dispatches,
+            decode_block=self.cfg.decode_block,
             prefills=self.prefills,
             tokens_per_sec=self.total_generated_tokens / elapsed,
             devices=int(np.prod(list(self.mesh.shape.values()))),

@@ -103,9 +103,26 @@ def join_modes(modes) -> str:
     return _MODE_ORDER[best]
 
 
-def _step_gumbel(key_data, steps, shape) -> jnp.ndarray:
+def fold_step_keys(key_data, steps):
+    """Device-side sampling key chain: per-slot step keys derived as
+    ``fold_in(base_key, step)``.
+
+    This is the invariant that makes fused multi-step decode blocks
+    (``EngineConfig.decode_block``) exact: the host builds each slot's
+    base key ONCE, at admission/resync (``make_base_key``), and every
+    subsequent step key is a pure function of (base key, step counter) —
+    both of which live in the device decode-state carry, with
+    ``advance_state`` incrementing the counter on device. K fused
+    iterations inside one ``lax.scan`` therefore draw the exact same
+    key sequence as K host round trips, with no per-step host key
+    rebuilds to replace.
+    """
     base_keys = jax.random.wrap_key_data(key_data)
-    step_keys = jax.vmap(jax.random.fold_in)(base_keys, steps)
+    return jax.vmap(jax.random.fold_in)(base_keys, steps)
+
+
+def _step_gumbel(key_data, steps, shape) -> jnp.ndarray:
+    step_keys = fold_step_keys(key_data, steps)
     return jax.vmap(
         lambda key: jax.random.gumbel(key, shape[1:], dtype=jnp.float32)
     )(step_keys)

@@ -154,6 +154,16 @@ class Sequence:
     prefilled: bool = False  # KV cache holds this sequence (engine sets it)
     finish_reason: Optional[str] = None
     finish_text: Optional[str] = None  # pre-truncated text on stop-string hit
+    # Incremental detokenization cache (engine-owned, stop-string
+    # requests only): ``detok_text`` is the decoded text of
+    # ``output_ids[:detok_len]``. The engine keeps the cached head a
+    # safe token margin behind the end, so per-token stop-string checks
+    # decode only the short tail instead of re-decoding the output.
+    # Survives preemption (output_ids are kept, so the prefix decode is
+    # still valid); the engine resets it whenever output_ids are
+    # truncated past detok_len.
+    detok_len: int = 0
+    detok_text: str = ""
 
     @property
     def num_tokens(self) -> int:
@@ -370,7 +380,12 @@ class Scheduler:
         """Grow ``seq``'s page map to cover ``num_positions`` KV slots
         (capped at the per-sequence maximum). The engine's run-ahead
         pipeline calls this *at dispatch time* with a lookahead, so pages
-        always exist on-device before the step that writes them. May
+        always exist on-device before the step that writes them — with
+        fused decode blocks the lookahead is measured in blocks of
+        ``decode_block`` positions (every in-flight dispatch may write K
+        KV rows per sequence before the host sees any of its tokens), so
+        each block's full K positions are pre-reserved here; preemption
+        and epoch semantics are unchanged. May
         preempt other sequences (unless ``allow_preempt`` is off — the
         engine forbids it while steps are in flight, because a victim's
         freed pages could still be written); ``preemptible`` optionally

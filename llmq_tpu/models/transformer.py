@@ -421,7 +421,17 @@ class Transformer:
         active: jnp.ndarray,  # [S] bool — slot holds a live sequence
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One decode step for every active slot. Returns (logits [S, V],
-        k_pages, v_pages)."""
+        k_pages, v_pages).
+
+        Scan-compatible by construction: a pure function of its array
+        arguments (the engine's fused decode blocks run it K times
+        inside one ``lax.scan`` with (k_pages, v_pages, state) as the
+        carry), and the only Python-level branching — the trace-time
+        kernel plan below — is a function of shapes and env alone, so
+        every scan iteration inlines the identical kernel choice.
+        Inactive slots write no KV in either plan: the XLA scatter
+        routes their positions to -1 (dropped) and the fused-write
+        kernel guards on ctx_incl == 0."""
         cfg = self.config
         S = tokens.shape[0]
         inv_freq = compute_rope_inv_freq(cfg)

@@ -29,6 +29,23 @@ def _softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
     return cap * jnp.tanh(scores / cap)
 
 
+def _compute_dtype(q_dtype, kv_dtype):
+    """Dtype the attention math runs in, given the query dtype and the
+    KV *storage* dtype. Narrow pools (fp8/int8: itemsize 1) only STORE
+    narrow — they upcast to the query dtype. But a pool WIDER than the
+    query (f32 pages under a bf16 query) must not be silently downcast:
+    promote instead, so the extra precision the operator paid HBM for
+    actually reaches the matmuls. Mirrors ``_mul_dtype`` in
+    ``ops/pallas_attention.py`` so the XLA reference and the Pallas
+    kernels agree numerically."""
+    qd, kd = jnp.dtype(q_dtype), jnp.dtype(kv_dtype)
+    if kd.itemsize == 1:
+        return qd
+    if qd.itemsize == 1:
+        return kd
+    return jnp.promote_types(qd, kd)
+
+
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """[..., n_kv, d] → [..., n_kv*n_rep, d] (GQA key/value head expansion)."""
     if n_rep == 1:
@@ -104,11 +121,16 @@ def paged_decode_attention(
 
     # [S, pages_per_seq, page_size, n_kv, d] → [S, max_ctx, n_kv, d].
     # The cast covers reduced-precision pools (fp8 KV cache): compute
-    # happens in the query dtype, pages only STORE narrow.
+    # happens in _compute_dtype — the query dtype for narrow pools
+    # (pages only STORE narrow), the promoted dtype for wide ones (an
+    # f32 pool under a bf16 query keeps its f32 precision).
+    out_dtype = q.dtype  # kernels return q.dtype whatever they compute in
+    mul = _compute_dtype(q.dtype, k_pages.dtype)
     k = k_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
     v = v_pages[block_tables].reshape(S, max_ctx, n_kv, head_dim)
-    k = repeat_kv(k, n_rep).astype(q.dtype)
-    v = repeat_kv(v, n_rep).astype(q.dtype)
+    k = repeat_kv(k, n_rep).astype(mul)
+    v = repeat_kv(v, n_rep).astype(mul)
+    q = q.astype(mul)
 
     scores = jnp.einsum("shd,skhd->shk", q, k) * scale
     scores = _softcap(scores, softcap)
@@ -117,8 +139,8 @@ def paged_decode_attention(
     if sliding_window is not None:
         mask &= k_pos >= context_lens[:, None] - sliding_window
     scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("shk,skhd->shd", weights, v)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(mul)
+    return jnp.einsum("shk,skhd->shd", weights, v).astype(out_dtype)
 
 
 def paged_prefill_attention(
@@ -154,10 +176,13 @@ def paged_prefill_attention(
     n_kv = k_pages.shape[2]
     n_rep = n_heads // n_kv
 
-    k = k_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
-    v = v_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)
-    k = repeat_kv(k, n_rep).astype(q.dtype)  # fp8 pools store narrow
-    v = repeat_kv(v, n_rep).astype(q.dtype)
+    out_dtype = q.dtype
+    mul = _compute_dtype(q.dtype, k_pages.dtype)  # narrow pools upcast,
+    k = k_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)  # wide
+    v = v_pages[block_tables].reshape(B, max_ctx, n_kv, head_dim)  # promote
+    k = repeat_kv(k, n_rep).astype(mul)
+    v = repeat_kv(v, n_rep).astype(mul)
+    q = q.astype(mul)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     scores = _softcap(scores, softcap)
@@ -167,8 +192,8 @@ def paged_prefill_attention(
     if sliding_window is not None:
         mask &= k_pos > q_pos - sliding_window
     scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
-    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(mul)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v).astype(out_dtype)
 
 
 def write_prompt_kv_pages(

@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+if not hasattr(jax, "shard_map"):  # jax 0.4.x: pre-promotion location
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    jax.shard_map = _shard_map
+
 from llmq_tpu.ops import attention as xla_ops
 from llmq_tpu.ops import pallas_attention as pk
 from llmq_tpu.ops import ring_attention as ring
@@ -192,7 +197,13 @@ def decode_kernel_plan(
     """(kernel_name, fused_write) the current env resolves to for these
     shapes. ``fused_write`` (the v3 kernel) means the decode kernel writes
     the step's new K/V row itself — the model must then SKIP its XLA
-    scatter and call :func:`decode_attention_fused_write` instead."""
+    scatter and call :func:`decode_attention_fused_write` instead.
+
+    Deliberately a pure function of (shapes, mesh, env): it is consulted
+    at trace time from inside jitted step functions — including from
+    every iteration of the fused decode-block ``lax.scan`` — so it must
+    resolve identically on every call within one process or the scan
+    body would diverge between iterations."""
     backend = resolve_backend() if backend == "auto" else backend
     # Empty string = unset (the `VAR= cmd` shell idiom must mean default).
     kern = (os.environ.get("LLMQ_DECODE_KERNEL") or "v1").lower()

@@ -30,6 +30,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pre-rename name on jax 0.4.x
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 
 def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
     ik = pl.program_id(2)

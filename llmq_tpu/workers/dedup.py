@@ -30,6 +30,7 @@ prefetch the batch could never fill.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import uuid
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -64,6 +65,15 @@ def text_of(job: Job) -> str:
     return ""
 
 
+def _ngram_bucket(gram: str, dim: int) -> int:
+    """Stable n-gram → bucket hash. Python's builtin ``hash()`` on str is
+    salted per process (PYTHONHASHSEED), so two workers sharing a queue
+    would embed the same text into DIFFERENT vectors and disagree on
+    which jobs are duplicates. blake2b is keyless and process-stable."""
+    digest = hashlib.blake2b(gram.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(digest, "little") % dim
+
+
 def embed(texts: List[str], dim: int = _DIM, n: int = _NGRAM) -> np.ndarray:
     """Hashed char-n-gram TF embedding, L2-normalised. Pure numpy."""
     out = np.zeros((len(texts), dim), dtype=np.float32)
@@ -72,7 +82,7 @@ def embed(texts: List[str], dim: int = _DIM, n: int = _NGRAM) -> np.ndarray:
         if len(t) < n:
             t = t + " " * (n - len(t))
         for j in range(len(t) - n + 1):
-            out[i, hash(t[j : j + n]) % dim] += 1.0
+            out[i, _ngram_bucket(t[j : j + n], dim)] += 1.0
     norms = np.linalg.norm(out, axis=1, keepdims=True)
     np.divide(out, norms, out=out, where=norms > 0)
     return out

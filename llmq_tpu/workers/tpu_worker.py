@@ -45,6 +45,7 @@ class TPUWorker(BaseWorker):
         num_pages: Optional[int] = None,
         prefill_chunk_size: Optional[int] = None,
         enable_prefix_caching: bool = False,
+        decode_block: Optional[int] = None,
         **kwargs,
     ) -> None:
         self.model = model
@@ -59,6 +60,7 @@ class TPUWorker(BaseWorker):
         self._num_pages = num_pages
         self._prefill_chunk_size = prefill_chunk_size
         self._enable_prefix_caching = enable_prefix_caching
+        self._decode_block = decode_block
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
@@ -125,7 +127,6 @@ class TPUWorker(BaseWorker):
         cfg = self._model_config_host()
         if cfg is None:
             return
-        kv = self._kv_dtype or self.config.kv_dtype
         choice = autotune_decode_kernel(
             num_heads=cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
@@ -133,14 +134,34 @@ class TPUWorker(BaseWorker):
             num_layers=cfg.num_layers,
             max_seqs=self._max_num_seqs or self.config.max_num_seqs or 192,
             page_size=self._page_size or 128,
-            # fp8 pools move half the bytes; the A/B must rank the
-            # kernels on the production pool dtype.
-            kv_dtype="float8_e5m2" if kv in ("fp8", "fp8_e5m2",
-                                             "float8_e5m2") else "bfloat16",
+            # The A/B must rank the kernels on the production pool
+            # dtype (fp8 pools move half the bytes, f32 pools double
+            # them), resolved with _build_engine's exact precedence:
+            # explicit kv_dtype flag/env, else the compute dtype.
+            kv_dtype=self._resolve_pool_dtype(),
             logger=self.logger,
         )
         if choice is not None:
             os.environ["LLMQ_DECODE_KERNEL"] = choice
+
+    def _resolve_pool_dtype(self) -> str:
+        """The KV pool dtype _build_engine will actually use, as a
+        canonical dtype name — per-worker flag > LLMQ_KV_DTYPE env >
+        the compute dtype (int8 weight quantization computes in bf16,
+        so its pool is bf16 too)."""
+        kv = self._kv_dtype or self.config.kv_dtype
+        names = {
+            "fp8": "float8_e5m2",
+            "fp8_e5m2": "float8_e5m2",
+            "float8_e5m2": "float8_e5m2",
+            "bf16": "bfloat16",
+            "bfloat16": "bfloat16",
+            "f32": "float32",
+            "float32": "float32",
+        }
+        if kv not in (None, "", "auto"):
+            return names.get(str(kv).lower(), "bfloat16")
+        return "float32" if self._dtype == "float32" else "bfloat16"
 
     def _build_engine(self):
         import jax.numpy as jnp
@@ -226,6 +247,11 @@ class TPUWorker(BaseWorker):
             overrides["prefill_chunk_size"] = chunk
         if self._enable_prefix_caching or self.config.enable_prefix_caching:
             overrides["enable_prefix_caching"] = True
+        # Fused decode blocks: per-worker flag > LLMQ_DECODE_BLOCK env >
+        # default 1 (per-token dispatch).
+        block = self._decode_block or self.config.decode_block
+        if block and block > 1:
+            overrides["decode_block"] = block
         # KV cache dtype: per-worker flag > LLMQ_KV_DTYPE env > the
         # compute dtype. "fp8" stores pages as float8_e5m2 (half the KV
         # bytes; kernels convert on-chip) — vLLM kv-cache-dtype parity.

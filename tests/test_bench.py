@@ -101,3 +101,48 @@ class TestPickPreset:
         assert bench.pick_preset(16 * 2**30, "tpu", int8=True) == (
             "tower-plus-9b"
         )
+
+
+class TestTrimPlan:
+    """bench.trim_plan: budget-aware phase trimming against the seconds
+    left on LLMQ_BENCH_DEADLINE. The proven bf16 headline is reserved
+    first and never dropped; speculative phases drop quant-first."""
+
+    KW = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
+              proven_s=300.0)
+
+    def test_no_deadline_runs_everything(self):
+        assert bench.trim_plan(None, **self.KW) == {
+            "quant": True, "kernel_ab": True, "full_ladder": True}
+
+    def test_roomy_budget_runs_everything(self):
+        assert bench.trim_plan(3600.0, **self.KW) == {
+            "quant": True, "kernel_ab": True, "full_ladder": True}
+
+    def test_quant_dropped_first(self):
+        # 300 (proven) + 420 + 720 fits, + 1500 does not.
+        plan = bench.trim_plan(2000.0, **self.KW)
+        assert plan == {"quant": False, "kernel_ab": True,
+                        "full_ladder": True}
+
+    def test_ladder_dropped_second(self):
+        # 300 + 420 fits, + 720 does not.
+        plan = bench.trim_plan(800.0, **self.KW)
+        assert plan == {"quant": False, "kernel_ab": True,
+                        "full_ladder": False}
+
+    def test_everything_but_proven_dropped(self):
+        plan = bench.trim_plan(350.0, **self.KW)
+        assert plan == {"quant": False, "kernel_ab": False,
+                        "full_ladder": False}
+
+    def test_proven_floor_reserved_before_phases(self):
+        # Exactly quant+ab+ladder of budget but NO room for the proven
+        # floor on top -> the floor wins, quant goes.
+        plan = bench.trim_plan(2640.0, **self.KW)
+        assert plan["quant"] is False
+
+    def test_boundaries_inclusive(self):
+        assert bench.trim_plan(2940.0, **self.KW)["quant"] is True
+        assert bench.trim_plan(1440.0, **self.KW)["full_ladder"] is True
+        assert bench.trim_plan(720.0, **self.KW)["kernel_ab"] is True

@@ -627,3 +627,54 @@ def test_param_auto_layout_with_int8(monkeypatch):
     outs = run_sync(qcore(), [("r", "hello int8 layout", greedy(5))])
     assert outs["r"].token_ids == golden["r"].token_ids
 
+
+
+class TestDecodeBlock:
+    """Fused multi-step decode (EngineConfig.decode_block > 1): K device
+    iterations per host dispatch must be invisible in the outputs."""
+
+    def test_block4_matches_k1_all_sampling_modes(self):
+        reqs = [
+            ("g", "hello world", greedy(7)),
+            ("s", "hello world",
+             SamplingParams(temperature=0.8, seed=7, max_tokens=6,
+                            ignore_eos=True)),
+            ("f", "another one",
+             SamplingParams(temperature=0.5, top_k=8, top_p=0.9, seed=3,
+                            max_tokens=5, ignore_eos=True)),
+        ]
+        ref = run_sync(make_core(), reqs)
+        core = make_core(engine=dict(decode_block=4))
+        outs = run_sync(core, reqs)
+        for rid, _, _ in reqs:
+            assert outs[rid].token_ids == ref[rid].token_ids, rid
+        st = core.stats()
+        assert st["decode_block"] == 4
+        assert st["decode_dispatches"] <= -(-st["decode_steps"] // 4)
+
+    def test_k1_dispatch_accounting_unchanged(self):
+        """At the default K=1 every decode step is its own dispatch (and
+        the engine compiles the exact pre-block executable)."""
+        core = make_core()
+        run_sync(core, [("r", "hi", greedy(5))])
+        st = core.stats()
+        assert st["decode_block"] == 1
+        assert st["decode_dispatches"] == st["decode_steps"] > 0
+
+    def test_mid_block_stop_discards_lagged_tokens(self):
+        """A row that hits its stop token at block iteration j rides out
+        the remaining iterations inactive; the host must discard those
+        lagged tokens and report the same finish as K=1."""
+        ref = run_sync(make_core(), [("r", "stop test", greedy(8))])["r"]
+        stop_id = ref.token_ids[2]
+        params = greedy(8, stop_token_ids=(stop_id,))
+        a = run_sync(make_core(), [("r", "stop test", params)])["r"]
+        b = run_sync(
+            make_core(engine=dict(decode_block=4)), [("r", "stop test", params)]
+        )["r"]
+        assert a.token_ids == b.token_ids
+        assert a.finish_reason == b.finish_reason == "stop"
+
+    def test_decode_block_validation(self):
+        with pytest.raises(ValueError):
+            EngineConfig(decode_block=0)

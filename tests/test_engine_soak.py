@@ -136,6 +136,38 @@ def test_soak_tight_pool_chunked_cached(seed):
         assert outs[rid].completion_tokens <= p.max_tokens
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_decode_block4_matches_k1_golden(seed):
+    """Fused multi-step decode (decode_block=4) composes losslessly with
+    the full feature stack: a tight-pool chunked + prefix-cached engine
+    running FOUR decode iterations per host dispatch must match a roomy
+    K=1 engine bit-for-bit on EVERY row — greedy AND seeded — because
+    sampling keys are fold_in(base, step) with the step counters
+    advanced inside the fused computation, and rows that finish
+    mid-block have their lagged in-block tokens discarded on the host.
+    Also pins the dispatch accounting: host round trips must not exceed
+    ceil(decode_steps / 4)."""
+    import math
+
+    rng = np.random.default_rng(seed)
+    reqs = _requests(rng, 28)
+    tight = _core(
+        20, prefill_chunk_size=8, enable_prefix_caching=True, decode_block=4
+    )
+    outs = _drive(tight, reqs, np.random.default_rng(seed + 100))
+    tight.scheduler.check_invariants()
+    st = tight.stats()
+    assert st["decode_block"] == 4
+    assert st["decode_dispatches"] <= math.ceil(st["decode_steps"] / 4)
+    assert 0 < st["decode_dispatches"] < st["decode_steps"]
+    roomy = _core(120)
+    golden = _drive(roomy, reqs, np.random.default_rng(seed + 100))
+    for rid, _, p in reqs:
+        assert outs[rid].token_ids == golden[rid].token_ids, rid
+        assert outs[rid].finish_reason == golden[rid].finish_reason, rid
+        assert outs[rid].completion_tokens <= p.max_tokens
+
+
 def test_soak_int8_tight_pool_matches_int8_golden():
     """Int8 weight-only quantization composes losslessly with the whole
     feature stack: a tight-pool chunked+cached+preempting int8 engine

@@ -1,0 +1,245 @@
+"""CPU pre-flight for the hardware-session runbooks.
+
+``tools/hardware_session.sh`` and ``tools/chip_watch.sh`` exist to be
+fired the moment the TPU tunnel answers; a typo'd path, flag, or env
+var in them burns scarce chip minutes before anyone notices (the round-5
+session lost its window exactly this way). This module parses BOTH
+scripts, extracts every ``run <timeout> <name> <cmd...>`` ladder step
+plus the probe commands, and executes each one on CPU with tiny shape
+overrides — proving the whole ladder is runnable end to end before
+hardware is rented.
+
+Fast tier (always on): the parser finds the expected steps, every
+referenced script/module exists, and the cheap commands (probes, the
+kernel-autotune A/B, one bench) actually run. The heavyweight commands
+(every bench variant, the profilers, the queue-drain harness) are
+``slow``-marked and run in CI's full pass.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shlex
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Overrides applied ON TOP of each step's own env: force CPU, shrink
+# every shape knob, and cap runtimes. A step's model/slot choices
+# (9B preset, 224 slots, ...) are deliberately clobbered — off-TPU the
+# only question is "does the command run", not "what does it measure".
+TINY_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "LLMQ_BENCH_PRESET": "tiny",
+    "LLMQ_BENCH_REQUESTS": "3",
+    "LLMQ_BENCH_PROMPT": "8",
+    "LLMQ_BENCH_GEN": "6",
+    "LLMQ_BENCH_SEQS": "2",
+    "LLMQ_BENCH_TRY_QUANT": "0",
+    "LLMQ_BENCH_DEADLINE": "240",
+    "PROF_S": "4",
+    "PROF_H": "8",
+    "PROF_I": "16",
+    "PROF_L": "2",
+}
+
+# argv rewrites for performance_benchmark.py-style flagged commands:
+# value following the flag is replaced.
+TINY_FLAGS = {
+    "--samples": "3",
+    "--batch-sizes": "2",
+    "--max-tokens": "8",
+    "--max-model-len": "64",
+}
+
+
+def _joined_lines(text: str):
+    """Script lines with backslash continuations folded in."""
+    out, acc = [], ""
+    for line in text.splitlines():
+        if line.rstrip().endswith("\\"):
+            acc += line.rstrip()[:-1] + " "
+            continue
+        out.append(acc + line)
+        acc = ""
+    if acc:
+        out.append(acc)
+    return out
+
+
+def parse_ladder(script: Path):
+    """Extract (name, env, argv) for every python command the runbook
+    executes: ``run <timeout> <name> [env K=V...] python ...`` steps and
+    the inline ``python -c`` probes."""
+    steps = []
+    probe_n = 0
+    for line in _joined_lines(script.read_text()):
+        line = line.strip()
+        m = re.match(r"run\s+\d+\s+(\S+)\s+(.*)$", line)
+        if m:
+            name, rest = m.group(1), m.group(2)
+        elif re.match(r"(timeout\s+\d+\s+)?python(3?)\s+-c\s", line):
+            probe_n += 1
+            name, rest = f"probe{probe_n}", line
+        else:
+            continue
+        argv = shlex.split(rest)
+        env = {}
+        if argv and argv[0] == "timeout":
+            argv = argv[2:]
+        if argv and argv[0] == "env":
+            argv = argv[1:]
+            while argv and "=" in argv[0] and not argv[0].startswith("-"):
+                key, _, val = argv[0].partition("=")
+                env[key] = val
+                argv = argv[1:]
+        if not argv or not argv[0].startswith("python"):
+            continue
+        steps.append((f"{script.stem}:{name}", env, argv))
+    return steps
+
+
+def _tiny_step(env, argv):
+    """The (env, argv) a step actually runs with in pre-flight mode."""
+    env = {**env, **TINY_ENV}
+    argv = list(argv)
+    for i, tok in enumerate(argv):
+        if tok.startswith("preset://"):
+            argv[i] = "preset://tiny"
+        if tok in TINY_FLAGS and i + 1 < len(argv):
+            argv[i + 1] = TINY_FLAGS[tok]
+        if tok == "--output" and i + 1 < len(argv):
+            argv[i + 1] = "/tmp/preflight_" + Path(argv[i + 1]).name
+    return env, argv
+
+
+def all_steps():
+    steps = []
+    for script in ("hardware_session.sh", "chip_watch.sh"):
+        steps.extend(parse_ladder(REPO / "tools" / script))
+    return steps
+
+
+def unique_tiny_steps():
+    """De-duplicate steps that collapse to the same command once tiny
+    overrides clobber their preset/slot env (e.g. the 3B and 9B int8
+    benches both become `int8 x tiny`)."""
+    seen, out = set(), []
+    for name, env, argv in all_steps():
+        env, argv = _tiny_step(env, argv)
+        key = (tuple(argv), tuple(sorted(env.items())))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((name, env, argv))
+    return out
+
+
+def _run(env, argv, timeout=400):
+    full_env = {**os.environ, "PYTHONPATH": str(REPO), "HOME": "/tmp", **env}
+    if argv[0].startswith("python"):
+        argv = [sys.executable] + argv[1:]
+    return subprocess.run(
+        argv, cwd=REPO, env=full_env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def _assert_ran(name, proc, *, allow_fail=False):
+    blob = proc.stdout + proc.stderr
+    for marker in (
+        "ModuleNotFoundError", "ImportError", "SyntaxError",
+        "NameError", "FileNotFoundError", "usage:",
+    ):
+        assert marker not in blob, f"{name}: {marker} in output:\n{blob[-2000:]}"
+    if not allow_fail:
+        assert proc.returncode == 0, f"{name}: rc={proc.returncode}\n{blob[-2000:]}"
+
+
+def _is_probe(name):
+    return ":probe" in name
+
+
+def test_ladders_parse():
+    """Both runbooks yield their full command ladders (a parser that
+    silently matches nothing would make every other test vacuous)."""
+    names = [name for name, _, _ in all_steps()]
+    assert sum(n.startswith("hardware_session") for n in names) >= 7
+    assert sum(n.startswith("chip_watch") for n in names) >= 14
+    joined = " ".join(names)
+    assert "kernel_v123" in joined and "queue_drain_tpu" in joined
+
+
+def test_referenced_files_exist():
+    """Every script path / -m module named by a ladder step exists."""
+    for name, _, argv in all_steps():
+        it = iter(argv[1:])
+        for tok in it:
+            if tok == "-c":
+                break
+            if tok == "-m":
+                mod = next(it)
+                path = REPO / (mod.replace(".", "/") + ".py")
+                assert path.exists(), f"{name}: module {mod} missing"
+                break
+            if not tok.startswith("-"):
+                assert (REPO / tok).exists(), f"{name}: script {tok} missing"
+                break
+
+
+def test_probes_and_autotune_run():
+    """The cheap ladder steps execute on CPU: the device probes (the
+    chip_watch probe's `platform == tpu` assert is EXPECTED to fail
+    off-TPU — anything else in stderr is a rotted command) and both
+    kernel-autotune A/B invocations (which short-circuit to v1 on CPU)."""
+    ran = 0
+    for name, env, argv in unique_tiny_steps():
+        if _is_probe(name) or "llmq_tpu.engine.kernel_autotune" in argv:
+            proc = _run(env, argv, timeout=240)
+            _assert_ran(name, proc, allow_fail=_is_probe(name))
+            ran += 1
+    assert ran >= 3
+
+
+def test_bench_tiny_decode_block_runs():
+    """One representative bench command runs end to end on CPU with the
+    fused decode-block path enabled (K=2), emitting the metric line."""
+    proc = _run(
+        {**TINY_ENV, "LLMQ_BENCH_DECODE_BLOCK": "2"},
+        ["python", "bench.py"],
+        timeout=400,
+    )
+    _assert_ran("bench:tiny", proc)
+    assert '"metric"' in proc.stdout
+    assert '"decode_block": 2' in proc.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,env,argv",
+    [pytest.param(*step, id=step[0]) for step in unique_tiny_steps()],
+)
+def test_every_ladder_command_runs_tiny(name, env, argv):
+    """The full pre-flight: EVERY de-duplicated runbook command executes
+    on CPU in tiny mode. Catches rotted flags, renamed scripts, and env
+    knobs the tools no longer accept — before a chip is rented."""
+    proc = _run(env, argv, timeout=500)
+    _assert_ran(name, proc, allow_fail=_is_probe(name))
+
+
+@pytest.mark.slow
+def test_bench_command_count_not_shrunk():
+    """The tiny-mode dedup still leaves a spread of bench variants
+    (int8, fp8 KV, pallas matmul, auto-layout must stay distinguishable
+    — they differ in env that tiny mode does NOT clobber)."""
+    benches = [
+        tuple(sorted(env.items()))
+        for _, env, argv in unique_tiny_steps()
+        if argv[-1].endswith("bench.py")
+    ]
+    assert len(set(benches)) >= 5

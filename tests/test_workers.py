@@ -248,6 +248,39 @@ class TestDedupMath:
         assert keep.sum() == 3
         assert not keep[3]
 
+    def test_embedding_stable_across_hash_seeds(self):
+        """Two workers with different PYTHONHASHSEED must make IDENTICAL
+        keep/drop decisions on a shared queue. Python's builtin hash()
+        on str is salted per process, so an n-gram bucketing built on it
+        silently degrades dedup to per-process agreement only; the
+        blake2b bucketing must produce bit-identical vectors and masks
+        regardless of the seed."""
+        import subprocess
+        import sys
+
+        script = (
+            "import json\n"
+            "from llmq_tpu.workers.dedup import embed, select_keep_mask\n"
+            "texts = ['the quick brown fox', 'the quick brown fox!',\n"
+            "         'unrelated zebra', 'quantum entanglement']\n"
+            "v = embed(texts)\n"
+            "keep = select_keep_mask(v, 'dedup', threshold=0.8)\n"
+            "print(json.dumps({'keep': keep.tolist(),\n"
+            "                  'vec': v.round(6).tolist()}))\n"
+        )
+        outs = []
+        for seed in ("0", "12345"):
+            proc = subprocess.run(
+                [sys.executable, "-c", script],
+                capture_output=True, text=True, timeout=120,
+                env={**__import__('os').environ, "PYTHONHASHSEED": seed,
+                     "JAX_PLATFORMS": "cpu"},
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            outs.append(json.loads(proc.stdout))
+        assert outs[0] == outs[1]
+        assert outs[0]["keep"] == [True, False, True, True]
+
 
 class TestSemanticDedup:
     """The model-embedding backend catches paraphrases the lexical

@@ -19,6 +19,7 @@ scan variant matching how the engine actually reads weights.
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -67,13 +68,70 @@ def scan_int8(x, wq, sc):
 
 
 def timeit(f, *args, n=20):
-    out = f(*args)
-    jax.block_until_ready(out)
+    """Time n iterations of f with a data dependence between them.
+
+    The old version dispatched f(*args) n times with IDENTICAL inputs
+    and dead outputs — nothing stopped XLA from eliding the matmul body
+    (the result was never consumed), which shows up as impossible
+    effective bandwidth. Here each iteration's output is folded back
+    into the next iteration's activation (scaled by the smallest
+    subnormal, so the values are numerically unchanged but the compiler
+    cannot prove it), the whole chain runs inside ONE jitted fori_loop,
+    and the activation buffer is donated. Every weight read is live.
+    """
+    tiny = jnp.finfo(x.dtype).smallest_subnormal
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def chained(x0):
+        def body(_, xc):
+            ys = f(xc, *args)
+            return xc + ys.ravel()[:1].astype(xc.dtype) * tiny
+
+        return jax.lax.fori_loop(0, n, body, x0)
+
+    jax.block_until_ready(chained(jnp.copy(x)))  # compile
+    fresh = jnp.copy(x)  # donated; make the copy outside the clock
     t0 = time.monotonic()
-    for _ in range(n):
-        out = f(*args)
-    jax.block_until_ready(out)
+    jax.block_until_ready(chained(fresh))
     return (time.monotonic() - t0) / n / L * 1e3  # ms per layer
+
+
+# Datasheet HBM bandwidth per chip, GB/s. A measured *weight-stream*
+# bandwidth above this is physically impossible — it means XLA elided
+# work despite the dependence chain, and the number must not be trusted.
+_HBM_PEAK_GBS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4": 1228.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6 lite": 1640.0,
+    "v6e": 1640.0,
+}
+
+
+def hbm_peak_gbs():
+    if jax.default_backend() != "tpu":
+        return None  # CPU smoke mode: no meaningful peak to gate on
+    kind = jax.devices()[0].device_kind.lower()
+    for key in sorted(_HBM_PEAK_GBS, key=len, reverse=True):
+        if key in kind:
+            return _HBM_PEAK_GBS[key]
+    return None
+
+
+def reject_if_elided(label, gibs):
+    peak = hbm_peak_gbs()
+    if peak is None:
+        return
+    gbs = gibs * (2**30 / 1e9)
+    if gbs > 1.2 * peak:
+        sys.exit(
+            f"{label}: measured {gbs:.0f} GB/s effective weight bandwidth"
+            f" > 1.2x this chip's HBM peak ({peak:.0f} GB/s) — the"
+            " compiler elided work; measurement rejected"
+        )
 
 
 from llmq_tpu.ops.pallas_matmul import int8_matmul_pallas  # noqa: E402
@@ -91,14 +149,19 @@ def scan_pallas(x, wq, sc):
     return ys
 
 
-ms_bf16 = timeit(scan_bf16, x, w_bf16)
-ms_int8 = timeit(scan_int8, x, w_q, scale)
-ms_pallas = timeit(scan_pallas, x, w_q, scale.astype(jnp.float32))
+ms_bf16 = timeit(scan_bf16, w_bf16)
+ms_int8 = timeit(scan_int8, w_q, scale)
+ms_pallas = timeit(scan_pallas, w_q, scale.astype(jnp.float32))
 bytes_bf16 = H * I * 2
 bytes_int8 = H * I * 1
-print(f"bf16 XLA:    {ms_bf16:.3f} ms/layer ({bytes_bf16/ms_bf16*1e3/2**30:.0f} GiB/s eff)")
-print(f"int8 XLA:    {ms_int8:.3f} ms/layer ({bytes_int8/ms_int8*1e3/2**30:.0f} GiB/s int8-eff)")
+gibs_bf16 = bytes_bf16 / ms_bf16 * 1e3 / 2**30
+gibs_int8 = bytes_int8 / ms_int8 * 1e3 / 2**30
 gibs = bytes_int8 / ms_pallas * 1e3 / 2**30
+reject_if_elided("bf16 XLA", gibs_bf16)
+reject_if_elided("int8 XLA", gibs_int8)
+reject_if_elided("int8 Pallas", gibs)
+print(f"bf16 XLA:    {ms_bf16:.3f} ms/layer ({gibs_bf16:.0f} GiB/s eff)")
+print(f"int8 XLA:    {ms_int8:.3f} ms/layer ({gibs_int8:.0f} GiB/s int8-eff)")
 print(f"int8 Pallas: {ms_pallas:.3f} ms/layer ({gibs:.0f} GiB/s int8-eff)")
 ratio = ms_int8 / ms_bf16
 verdict = "FUSED (int8 wins as-is)" if ratio < 0.8 else (

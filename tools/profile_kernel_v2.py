@@ -10,20 +10,39 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # The image's sitecustomize pins the platform list at the CONFIG
+    # level; without this, any backend query hangs on the TPU tunnel.
+    from llmq_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas
 
-# bench config shapes: qwen2.5-3b, S=192, page 128, max_model_len 512
-S = 192
-H, NKV, D = 16, 2, 128
-PAGE = 128
-PPS = 4
-L = 36
-P = 961  # pool pages per layer (auto-sized in the engine at this config)
-CTX = 330
+if jax.default_backend() == "cpu":
+    # Smoke-testable off-TPU: tiny shapes, Pallas interpret mode. The
+    # numbers are meaningless (interpret is ~1000x slow) — this exists
+    # so the CPU pre-flight can prove every command in the hardware
+    # session runbook executes end to end before chips are rented.
+    S, H, NKV, D = 8, 4, 2, 16
+    PAGE, PPS, L, P, CTX = 8, 4, 2, 33, 20
+else:
+    # bench config shapes: qwen2.5-3b, S=192, page 128, max_model_len 512
+    S = 192
+    H, NKV, D = 16, 2, 128
+    PAGE = 128
+    PPS = 4
+    L = 36
+    P = 961  # pool pages per layer (auto-sized in the engine at this config)
+    CTX = 330
+S = int(os.environ.get("PROF_S", S))
+H = int(os.environ.get("PROF_H", H))
+L = int(os.environ.get("PROF_L", L))
+INTERP = jax.default_backend() != "tpu"
 
 rng = np.random.default_rng(0)
 q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
@@ -66,7 +85,7 @@ print(f"live KV/layer: {kv_bytes/2**20:.1f} MiB (floor@819GB/s "
 
 ms = timeit_layers(
     lambda li: paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=li,
-                                             scale=scale))
+                                             scale=scale, interpret=INTERP))
 print(f"current: {ms:.3f} ms/layer -> x{L}: {ms*L:.1f} ms/step  "
       f"({tot_bytes/ms*1e3/2**30:.0f} GiB/s eff)")
 
@@ -74,12 +93,12 @@ from llmq_tpu.ops.pallas_attention import paged_decode_attention_pallas_v2
 
 ms = timeit_layers(
     lambda li: paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=li,
-                                                scale=scale))
+                                                scale=scale, interpret=INTERP))
 print(f"v2 manual-DMA: {ms:.3f} ms/layer -> x{L}: {ms*L:.1f} ms/step  "
       f"({kv_bytes/ms*1e3/2**30:.0f} GiB/s live-eff)")
 
-a = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
-b = paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale)
+a = paged_decode_attention_pallas(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale, interpret=INTERP)
+b = paged_decode_attention_pallas_v2(q, kp, vp, bt, cl, w, layer=jnp.int32(0), scale=scale, interpret=INTERP)
 diff = jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
 print("max|diff| v2 vs v1 on TPU:", float(diff))
 
@@ -100,13 +119,13 @@ positions = (cl - 1)[:, None]
 def engine_step(kp, vp, li, *, which):
     if which == "v3":
         out, kp, vp = paged_decode_attention_pallas_v3(
-            q, kp, vp, kn, vn, bt, cl, w, li, scale=scale)
+            q, kp, vp, kn, vn, bt, cl, w, li, scale=scale, interpret=INTERP)
         return out, kp, vp
     kp, vp = write_kv_pages(kp, vp, kn[:, None], vn[:, None], bt, positions,
                             layer=li)
     kern = (paged_decode_attention_pallas_v2 if which == "v2"
             else paged_decode_attention_pallas)
-    return kern(q, kp, vp, bt, cl, w, li, scale=scale), kp, vp
+    return kern(q, kp, vp, bt, cl, w, li, scale=scale, interpret=INTERP), kp, vp
 
 
 def timeit_engine(which, n=3):
@@ -135,9 +154,9 @@ print("max|diff| v3 vs v1 (incl. write):",
 cl_half = jnp.where(jnp.arange(S) % 2 == 0, CTX, 0)
 ms = timeit_layers(
     lambda li: paged_decode_attention_pallas_v2(q, kp, vp, bt, cl_half, w, layer=li,
-                                                scale=scale))
+                                                scale=scale, interpret=INTERP))
 print(f"v2 half-empty: {ms:.3f} ms/layer (dead-slot skipping)")
 ms = timeit_layers(
     lambda li: paged_decode_attention_pallas(q, kp, vp, bt, cl_half, w, layer=li,
-                                             scale=scale))
+                                             scale=scale, interpret=INTERP))
 print(f"v1 half-empty: {ms:.3f} ms/layer (fixed schedule)")
