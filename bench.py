@@ -38,7 +38,11 @@ otherwise runs first on accelerators and wins the emit when it clearly
 beats baseline), LLMQ_BENCH_QUANT_TIMEOUT (its budget, default 1500 s — the int8
 ladder tries up to three slot counts), LLMQ_BENCH_DECODE_BLOCK (pin the
 fused decode-block size K; unset -> the ladder measures K=2/4 at the
-winning slot count after the slot ladder and emits the best).
+winning slot count after the slot ladder and emits the best),
+LLMQ_BENCH_SPEC_TOKENS (pin the speculative-decoding draft length;
+unset -> the spec rung measures prompt-lookup drafting at the winning
+(slots, K) point after the decode-block ladder and keeps it only if it
+wins).
 
 When the remaining LLMQ_BENCH_DEADLINE budget cannot fit the whole plan
 (quant attempt + kernel A/B + the multi-candidate ladder), phases are
@@ -354,6 +358,7 @@ def trim_plan(
     quant_s: float,
     ab_s: float,
     ladder_extra_s: float,
+    spec_s: float,
     proven_s: float,
 ) -> dict:
     """Budget-aware phase trimming (pure — unit-tested in
@@ -363,25 +368,49 @@ def trim_plan(
     - ``quant``: the int8+fp8 subprocess attempt (cost: its timeout),
     - ``kernel_ab``: the decode-kernel A/B subprocess (its timeout),
     - ``full_ladder``: every bf16 slot/decode-block candidate beyond the
-      proven config (``ladder_extra_s`` extra build+measure cost).
+      proven config (``ladder_extra_s`` extra build+measure cost),
+    - ``spec_ladder``: the speculative-decoding rung at the winning
+      (slots, K) point (``spec_s`` build+measure cost).
 
     The proven bf16 headline (``proven_s``) is the floor and is never
     dropped — a bench that measures *something* always beats a watchdog
     0.0. Drop order is by speculation: the quant attempt first (longest
-    budget, most failure modes), then the extra ladder rungs, then the
-    kernel A/B; each phase runs only if everything still planned fits
-    the remaining budget. No deadline (None) runs everything.
+    budget, most failure modes), then the spec rung (workload-dependent
+    acceptance — the most likely rung to measure a loss), then the extra
+    ladder rungs, then the kernel A/B; each phase runs only if everything
+    still planned fits the remaining budget. No deadline (None) runs
+    everything.
     """
     if remaining_s is None:
-        return {"quant": True, "kernel_ab": True, "full_ladder": True}
+        return {
+            "quant": True, "kernel_ab": True,
+            "full_ladder": True, "spec_ladder": True,
+        }
     budget = remaining_s - proven_s  # the floor is reserved first
-    if budget >= quant_s + ab_s + ladder_extra_s:
-        return {"quant": True, "kernel_ab": True, "full_ladder": True}
+    if budget >= quant_s + ab_s + ladder_extra_s + spec_s:
+        return {
+            "quant": True, "kernel_ab": True,
+            "full_ladder": True, "spec_ladder": True,
+        }
+    if budget >= ab_s + ladder_extra_s + spec_s:
+        return {
+            "quant": False, "kernel_ab": True,
+            "full_ladder": True, "spec_ladder": True,
+        }
     if budget >= ab_s + ladder_extra_s:
-        return {"quant": False, "kernel_ab": True, "full_ladder": True}
+        return {
+            "quant": False, "kernel_ab": True,
+            "full_ladder": True, "spec_ladder": False,
+        }
     if budget >= ab_s:
-        return {"quant": False, "kernel_ab": True, "full_ladder": False}
-    return {"quant": False, "kernel_ab": False, "full_ladder": False}
+        return {
+            "quant": False, "kernel_ab": True,
+            "full_ladder": False, "spec_ladder": False,
+        }
+    return {
+        "quant": False, "kernel_ab": False,
+        "full_ladder": False, "spec_ladder": False,
+    }
 
 
 def _try_quantized_headline() -> Optional[dict]:
@@ -540,6 +569,9 @@ def main() -> None:
         # Extra rungs beyond the proven config: one more slot count and
         # the decode-block ladder, ~4 min of builds+measures each.
         ladder_extra_s=720.0,
+        # The spec rung re-measures the winning point twice (draft
+        # length 2 then 4, early-stopped): ~2 builds + runs.
+        spec_s=360.0,
         proven_s=300.0,
     )
     if not all(plan.values()):
@@ -666,6 +698,12 @@ def main() -> None:
     # (budget permitting) and the best K is emitted.
     block_env = os.environ.get("LLMQ_BENCH_DECODE_BLOCK")
     block_pin = int(block_env) if block_env else None
+    # Speculative-decoding rung: LLMQ_BENCH_SPEC_TOKENS pins the draft
+    # length (every ladder build runs with it); otherwise the rung after
+    # the decode-block ladder tries the prompt-lookup drafter and keeps
+    # it only on a measured win.
+    spec_env = os.environ.get("LLMQ_BENCH_SPEC_TOKENS")
+    spec_pin = int(spec_env) if spec_env else None
     print(
         f"bench: preset={preset} ({config.num_params()/1e9:.2f}B) on "
         f"{len(devices)}x {platform}, {n_requests} reqs, "
@@ -709,6 +747,9 @@ def main() -> None:
     # the window.
     best = None  # (tok_s, max_seqs, out_tokens, elapsed)
     last_exc = None
+    # Acceptance rate of the run that produced the headline number (0.0
+    # whenever that run had spec_tokens=0).
+    spec_rate = 0.0
     # LLMQ_BENCH_KV_DTYPE: "auto" (or empty) means "pick for me" — the
     # compute dtype, exactly like unset. Anything else names the pool
     # dtype explicitly ("fp8" -> float8_e5m2 pages, half the KV bytes;
@@ -716,7 +757,7 @@ def main() -> None:
     kv_env = (os.environ.get("LLMQ_BENCH_KV_DTYPE") or "").lower()
     kv_dtype = kv_env if kv_env not in ("", "auto") else dtype
 
-    def build_core(max_seqs, block):
+    def build_core(max_seqs, block, spec=0):
         return EngineCore(
             config,
             params,
@@ -730,6 +771,9 @@ def main() -> None:
                 # Fused multi-step decode: K device iterations per host
                 # dispatch (engine/engine.py decode_block).
                 decode_block=block,
+                # Lossless speculative decoding: prompt-lookup draft
+                # tokens verified in one dispatch (0 = off).
+                spec_tokens=spec,
                 # 128-token pages: the decode kernel DMAs one page
                 # per grid step, and 16 KB transfers are
                 # latency-bound ~6x off the bandwidth floor (measured
@@ -749,7 +793,7 @@ def main() -> None:
 
     for max_seqs in seqs_candidates:
         try:
-            core = build_core(max_seqs, block_pin or 1)
+            core = build_core(max_seqs, block_pin or 1, spec_pin or 0)
             run(1, "warmup-single")
             run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
             gen_before = core.total_generated_tokens
@@ -761,6 +805,7 @@ def main() -> None:
             )
             if best is None or out / elapsed > best[0]:
                 best = (out / elapsed, max_seqs, out, elapsed)
+                spec_rate = core.stats().get("acceptance_rate", 0.0)
             elif out / elapsed < 0.98 * best[0]:
                 # Throughput vs slot count is unimodal; once a candidate
                 # measures clearly below the best (2% noise guard), the
@@ -800,7 +845,7 @@ def main() -> None:
     best_block = block_pin or 1
     for block in [] if (block_pin or not plan["full_ladder"]) else [2, 4]:
         try:
-            core = build_core(max_seqs, block)
+            core = build_core(max_seqs, block, spec_pin or 0)
             run(1, "warmup-single")
             run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
             gen_before = core.total_generated_tokens
@@ -816,6 +861,7 @@ def main() -> None:
                 tok_s, out_tokens, elapsed, best_block = (
                     b_tok_s, b_out, b_elapsed, block
                 )
+                spec_rate = core.stats().get("acceptance_rate", 0.0)
             elif b_tok_s < 0.98 * tok_s:
                 # Larger K only adds wasted post-finish iterations on
                 # top of whatever made this K lose; stop paying builds.
@@ -832,6 +878,56 @@ def main() -> None:
             exc.__traceback__ = None
             print(
                 f"bench: decode block {block} exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
+    # Speculative-decoding rung at the winning (slots, K) point: try the
+    # prompt-lookup drafter at 2 then 4 draft tokens and keep the best.
+    # Early-stopped like the block ladder — acceptance is a property of
+    # the workload, so once a draft length clearly loses, a longer one
+    # (more wasted verify positions per rejection) won't recover.
+    # Skipped when the draft length is pinned via LLMQ_BENCH_SPEC_TOKENS
+    # (every build above already ran with it) or the deadline trimmed
+    # the rung. Synthetic random prompts have little n-gram structure,
+    # so a no-win outcome here is expected off-TPU; the rung pays off on
+    # repetitive real workloads.
+    best_spec = spec_pin or 0
+    for spec in [] if (spec_pin or not plan["spec_ladder"]) else [2, 4]:
+        try:
+            core = build_core(max_seqs, best_block, spec)
+            run(1, "warmup-single")
+            run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+            gen_before = core.total_generated_tokens
+            s_elapsed = run(n_requests, f"bench-s{max_seqs}-spec{spec}")
+            s_out = core.total_generated_tokens - gen_before
+            s_tok_s = s_out / s_elapsed
+            s_rate = core.stats().get("acceptance_rate", 0.0)
+            print(
+                f"bench: {max_seqs} slots, spec {spec} -> "
+                f"{s_tok_s:.1f} tok/s (acceptance {s_rate:.3f})",
+                file=sys.stderr,
+            )
+            if s_tok_s > tok_s:
+                tok_s, out_tokens, elapsed, best_spec, spec_rate = (
+                    s_tok_s, s_out, s_elapsed, spec, s_rate
+                )
+            elif s_tok_s < 0.98 * tok_s:
+                print(
+                    f"bench: spec {spec} past the peak; stopping ladder",
+                    file=sys.stderr,
+                )
+                core = None
+                break
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                f"bench: spec {spec} exhausted HBM; skipping",
                 file=sys.stderr,
             )
         core = None
@@ -856,6 +952,8 @@ def main() -> None:
         "dtype": "int8" if int8 else str(jnp.dtype(dtype)),
         "max_seqs": max_seqs,
         "decode_block": best_block,
+        "spec_tokens": best_spec,
+        "acceptance_rate": round(float(spec_rate), 4),
         **(
             {"kv_dtype": kv_env}
             if kv_env not in ("", "auto")

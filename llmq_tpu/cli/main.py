@@ -278,9 +278,16 @@ def worker() -> None:
                    "dispatch (K tokens per round trip; a finished "
                    "sequence wastes at most K-1 device iterations). "
                    "Default: LLMQ_DECODE_BLOCK or 1")
+@click.option("--spec-tokens", type=int, default=None,
+              help="Lossless speculative decoding: n-gram prompt-lookup "
+                   "draft tokens verified per decode step (greedy output "
+                   "is bit-identical; sampled distributions stay exact "
+                   "via rejection sampling — pays off on workloads that "
+                   "copy prompt spans). Default: LLMQ_SPEC_TOKENS or 0")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
-               dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block):
+               dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block,
+               spec_tokens):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -297,6 +304,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         prefill_chunk_size=prefill_chunk,
         enable_prefix_caching=prefix_caching,
         decode_block=decode_block,
+        spec_tokens=spec_tokens,
     )
 
 

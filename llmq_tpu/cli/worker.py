@@ -27,6 +27,7 @@ def run_tpu_worker(
     prefill_chunk_size: Optional[int] = None,
     enable_prefix_caching: bool = False,
     decode_block: Optional[int] = None,
+    spec_tokens: Optional[int] = None,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
@@ -50,6 +51,7 @@ def run_tpu_worker(
         prefill_chunk_size=prefill_chunk_size,
         enable_prefix_caching=enable_prefix_caching,
         decode_block=decode_block,
+        spec_tokens=spec_tokens,
     )
     _run(worker)
 

@@ -161,6 +161,14 @@ class Config(BaseModel):
         "block per sequence). 1 = per-token dispatch.",
     )
 
+    spec_tokens: int = Field(
+        default_factory=lambda: _env_int("LLMQ_SPEC_TOKENS", default=0),
+        description="Lossless speculative decoding: n-gram prompt-lookup "
+        "draft tokens verified per decode step (0 = off). Greedy output "
+        "is bit-identical to non-speculative decoding; sampled requests "
+        "keep the exact output distribution via rejection sampling.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

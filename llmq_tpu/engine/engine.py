@@ -160,6 +160,24 @@ class EngineConfig:
     # wastes device work on short completions while shrinking host
     # overhead on long ones; bench.py measures 1/2/4 and keeps the best.
     decode_block: int = 1
+    # Lossless speculative decoding: an on-device n-gram prompt-lookup
+    # drafter proposes up to spec_tokens candidates per running row
+    # (matching the row's recent spec_ngram-token suffix against its own
+    # prompt+output history), and one fused verify dispatch scores all
+    # spec_tokens+1 positions through the paged-attention path (q-len >
+    # 1, exactly like chunked prefill). The longest candidate prefix the
+    # model itself would have emitted is accepted — greedy requests are
+    # bit-identical to spec_tokens=0, sampled requests keep the exact
+    # output distribution via rejection sampling — so one dispatch can
+    # emit up to spec_tokens+1 tokens. Rejected candidates' KV writes
+    # are simply overwritten by the next step (pages are append-only;
+    # per-row lengths rewind on device). 0 = off: the decode executable
+    # is literally the non-speculative one. Composes with decode_block
+    # (K verify iterations per dispatch).
+    spec_tokens: int = 0
+    # Draft-match n-gram length for prompt lookup. Longer = fewer but
+    # more reliable matches.
+    spec_ngram: int = 2
     # Per-slot device-side stop-token-id capacity. Grows automatically
     # (drain + resync + jit retrace at the wider shape) when a request's
     # stop set exceeds it, so min_tokens suppression always covers the
@@ -171,6 +189,16 @@ class EngineConfig:
         if self.decode_block < 1:
             raise ValueError(
                 f"decode_block={self.decode_block} (want >= 1)"
+            )
+        self.spec_tokens = int(self.spec_tokens)
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens={self.spec_tokens} (want >= 0)"
+            )
+        self.spec_ngram = int(self.spec_ngram)
+        if self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram={self.spec_ngram} (want >= 1)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -219,8 +247,10 @@ def _prefill_buckets(cfg: EngineConfig, sp: int = 1) -> List[int]:
 
 
 # Pipeline entry: (dispatch index, kind "prefill"|"decode", device
-#                  out-token array, [(row-in-out, Sequence), ...] snapshot)
-_Pending = Tuple[int, str, jax.Array, List[Tuple[int, Sequence]]]
+#                  out-token array — or a (candidates, accept-counts)
+#                  pair under speculative decoding —,
+#                  [(row-in-out, Sequence), ...] snapshot)
+_Pending = Tuple[int, str, Any, List[Tuple[int, Sequence]]]
 
 
 class EngineCore:
@@ -299,6 +329,9 @@ class EngineCore:
         # Fused decode blocks stack K per-step token vectors: [K, S] with
         # the slot axis second, so each device still owns its dp shard.
         self._block1 = NamedSharding(self.mesh, P(None, slot_axis))
+        # Speculative verify emits [K, S, Q] candidate tokens per
+        # dispatch (Q = spec_tokens + 1); slot axis stays in the middle.
+        self._spec_out = NamedSharding(self.mesh, P(None, slot_axis, None))
 
         self._eos_ids = set(model_config.eos_token_ids) | set(
             tokenizer.eos_token_ids
@@ -352,6 +385,16 @@ class EngineCore:
         self._h_limits = np.zeros((S,), np.int32)
         self._h_mins = np.zeros((S,), np.int32)
         self._h_stopids = np.full((S, E), -1, np.int32)
+        # Speculative decoding only: per-slot prompt+output token history
+        # ([S, max_model_len], the drafter's lookup corpus). Appended as
+        # the 13th decode-state leaf so drafting happens on device — the
+        # run-ahead pipeline still ships zero bytes host→device in steady
+        # state.
+        self._h_history = (
+            np.zeros((S, self.cfg.max_model_len), np.int32)
+            if self.cfg.spec_tokens > 0
+            else None
+        )
 
         # Run-ahead pipeline state.
         self._pending: Deque[_Pending] = deque()
@@ -369,6 +412,8 @@ class EngineCore:
         self.total_generated_tokens = 0
         self.decode_steps = 0  # device decode iterations (K per dispatch)
         self.decode_dispatches = 0  # host round trips for those iterations
+        self.spec_proposed = 0  # draft tokens offered for verification
+        self.spec_accepted = 0  # draft tokens the model confirmed
         self.prefills = 0
         self._started_at = time.monotonic()
         self._resync()
@@ -379,11 +424,16 @@ class EngineCore:
     def _build_steps(self) -> None:
         model = self.model
         S = self.cfg.max_num_seqs
+        spec = self.cfg.spec_tokens > 0
 
         # Device decode-state layout (leaf order is load-bearing):
         # 0 tokens[S]  1 ctx[S]    2 bt[S,pps]  3 active[S]  4 keys[S,kd]
         # 5 steps[S]   6 temps[S]  7 topks[S]   8 topps[S]   9 limits[S]
         # 10 mins[S]   11 stop_ids[S,E]
+        # Speculative decoding appends leaf 12: history[S, max_model_len]
+        # (prompt+output tokens; history[ctx] is the current token) —
+        # the on-device drafter's lookup corpus. spec_tokens=0 builds
+        # the exact 12-leaf state and functions as before.
         def advance_state(st, out, active):
             (tokens, ctx, bt, _, keys, steps, temps, topks, topps,
              limits, mins, stop_ids) = st
@@ -470,9 +520,155 @@ class EngineCore:
             )
             return outs, kp, vp, st
 
+        M = self.cfg.max_model_len
+        n_draft = self.cfg.spec_tokens
+        n_gram = self.cfg.spec_ngram
+        max_kv_pos = self._pages_per_seq * self.cfg.page_size
+
+        def draft_lookup(history, ctx):
+            """On-device prompt-lookup drafter: find the most recent
+            earlier occurrence of the n_gram-token suffix ending at
+            history[ctx] and propose the n_draft tokens that followed
+            it. Rows with no match (or fewer than n_gram tokens so far)
+            draft -1, which never equals an emitted token — the verify
+            step then degenerates to exactly one non-speculative decode
+            for that row. Overlapping matches are fine (repetition runs
+            draft themselves), and stale tokens past ctx can never leak:
+            gathers are clipped into the row and every draft is verified
+            before it is emitted."""
+            sfx_pos = ctx[:, None] - (n_gram - 1) + jnp.arange(n_gram)
+            sfx = jnp.take_along_axis(
+                history, jnp.clip(sfx_pos, 0, M - 1), axis=1
+            )  # [S, n_gram]
+            match = jnp.ones((S, M), bool)
+            for t in range(n_gram):
+                eq = history == sfx[:, t][:, None]
+                # Shift so position p asks "does the n-gram ENDING at p
+                # match the suffix" for every element at once.
+                match &= jnp.roll(eq, (n_gram - 1) - t, axis=1)
+            p_idx = jnp.arange(M)[None, :]
+            match &= (
+                (p_idx >= n_gram - 1)
+                & (p_idx < ctx[:, None])
+                & (ctx[:, None] + 1 >= n_gram)
+            )
+            j = jnp.max(jnp.where(match, p_idx, -1), axis=1)  # [S]
+            d_pos = j[:, None] + 1 + jnp.arange(n_draft)[None, :]
+            drafts = jnp.take_along_axis(
+                history, jnp.clip(d_pos, 0, M - 1), axis=1
+            )
+            return jnp.where((j >= 0)[:, None], drafts, -1)
+
+        def verify_step(params, kp, vp, st, *, mode):
+            """One speculative decode iteration: draft, score all
+            Q = spec_tokens+1 candidate positions in one model call
+            (multi-query decode through the chunked-prefill attention
+            path), accept the longest prefix the model itself emits,
+            and advance per-row state by the accepted count. Rejected
+            positions' KV stays in place — their sequence length simply
+            doesn't advance past them, and the next verify step rewrites
+            the same append-only positions. Emits ``(emit [S, Q],
+            count [S])``: count = accepted drafts + 1 corrected/bonus
+            token (0 for inactive rows); the host appends
+            ``emit[row, :count]``."""
+            (tokens, ctx, bt, active, keys, steps, temps, topks,
+             topps, limits, mins, stop_ids, history) = st
+            Q = n_draft + 1
+            drafts = draft_lookup(history, ctx)  # [S, n_draft]
+            qtok = jnp.concatenate(
+                [tokens[:, None], jnp.maximum(drafts, 0)], axis=1
+            )  # [S, Q]
+            pos_grid = ctx[:, None] + jnp.arange(Q)[None, :]
+            # Inactive rows and positions past the per-row page map route
+            # to -1 (scratch page, no attention): an unmapped position
+            # would otherwise clamp into the row's LAST mapped page and
+            # corrupt it. The grid keeps the leading-contiguous-run form
+            # the chunked-prefill kernel contract requires.
+            qpos = jnp.where(
+                active[:, None] & (pos_grid < max_kv_pos), pos_grid, -1
+            )
+            logits, kp, vp = model.verify(params, qtok, qpos, kp, vp, bt)
+            V = logits.shape[-1]
+            steps_grid = steps[:, None] + jnp.arange(Q)[None, :]
+            flat = suppress_stops(
+                logits.reshape(S * Q, V),
+                jnp.repeat(stop_ids, Q, axis=0),
+                steps_grid.reshape(-1),
+                jnp.repeat(mins, Q),
+            )
+            emit = sampling_mod.spec_verify_tokens(
+                flat.reshape(S, Q, V), drafts, keys, steps,
+                temps, topks, topps, mode=mode,
+            )  # [S, Q]
+            # Position i is reached iff every earlier draft was accepted
+            # (emit == draft); position 0 (the normal decode token) is
+            # always reached on active rows.
+            reached = jnp.concatenate(
+                [
+                    jnp.ones((S, 1), bool),
+                    jnp.cumprod(
+                        (emit[:, :-1] == drafts).astype(jnp.int32), axis=1
+                    ).astype(bool),
+                ],
+                axis=1,
+            )
+            # Stopping mirrors advance_state per position: a stop/limit
+            # hit at position i emits i's token and cuts everything after.
+            new_steps_grid = steps_grid + 1
+            hit_stop = (
+                (emit[:, :, None] == stop_ids[:, None, :]).any(axis=2)
+                & (new_steps_grid > mins[:, None])
+            )
+            stop_here = hit_stop | (new_steps_grid >= limits[:, None])
+            stopped_before = (
+                jnp.cumsum(stop_here.astype(jnp.int32), axis=1)
+                - stop_here.astype(jnp.int32)
+            ) > 0
+            emitted = active[:, None] & reached & ~stopped_before  # [S, Q]
+            count = emitted.sum(axis=1).astype(ctx.dtype)  # [S]
+            new_tok = jnp.take_along_axis(
+                emit, jnp.maximum(count - 1, 0)[:, None], axis=1
+            )[:, 0]
+            still = active & ~(emitted & stop_here).any(axis=1)
+            rows = jnp.broadcast_to(jnp.arange(S)[:, None], (S, Q))
+            hist_pos = jnp.where(emitted, pos_grid + 1, M)  # OOB → drop
+            st = (
+                jnp.where(count > 0, new_tok, tokens),
+                ctx + count,
+                bt,
+                still,
+                keys,
+                steps + count,
+                temps,
+                topks,
+                topps,
+                limits,
+                mins,
+                stop_ids,
+                history.at[rows, hist_pos].set(emit, mode="drop"),
+            )
+            return (jnp.where(emitted, emit, 0), count), kp, vp, st
+
+        def verify_block_step(params, kp, vp, st, *, mode):
+            """decode_block fused verify iterations in one XLA
+            computation, mirroring decode_block_step. Always a lax.scan
+            (even K=1) so the output block is uniformly ([K, S, Q]
+            tokens, [K, S] accept counts)."""
+
+            def body(carry, _):
+                kp, vp, st = carry
+                ys, kp, vp, st = verify_step(params, kp, vp, st, mode=mode)
+                return (kp, vp, st), ys
+
+            (kp, vp, st), outs = jax.lax.scan(
+                body, (kp, vp, st), None, length=self.cfg.decode_block
+            )
+            return outs, kp, vp, st
+
         def sample_and_scatter(logits, valid, p_lengths, p_bt, p_slots,
                                p_keys, p_steps, p_temps, p_topks, p_topps,
-                               p_limits, p_mins, p_stopids, st, *, mode):
+                               p_limits, p_mins, p_stopids, st, *, mode,
+                               p_history=None):
             """Shared tail of the prefill variants: sample each valid
             row's first token and scatter the row into the decode state
             (invalid rows route out of range and are dropped)."""
@@ -493,7 +689,7 @@ class EngineCore:
             )
             idx = jnp.where(valid, p_slots, S)
             (tokens, ctx, bt, active, keys, steps, temps, topks, topps,
-             limits, mins, stop_ids) = st
+             limits, mins, stop_ids, *hist) = st
             st = (
                 tokens.at[idx].set(out, mode="drop"),
                 ctx.at[idx].set(p_lengths, mode="drop"),
@@ -508,29 +704,41 @@ class EngineCore:
                 mins.at[idx].set(p_mins, mode="drop"),
                 stop_ids.at[idx].set(p_stopids, mode="drop"),
             )
+            if spec:
+                # Keep the drafter's invariant history[ctx] == current
+                # token: the row's prompt+output plus its fresh first
+                # sample at position p_lengths (== the new ctx).
+                B = p_history.shape[0]
+                hrow = p_history.at[jnp.arange(B), p_lengths].set(
+                    out, mode="drop"
+                )
+                st += (hist[0].at[idx].set(hrow, mode="drop"),)
             return out, st
 
         def prefill_step(params, kp, vp, p_tokens, p_lengths, p_bt, p_slots,
                          p_keys, p_steps, p_temps, p_topks, p_topps,
-                         p_limits, p_mins, p_stopids, st, *, mode):
+                         p_limits, p_mins, p_stopids, *rest, mode):
+            # rest = (p_history, st) under speculation, (st,) otherwise.
+            p_history, st = rest if spec else (None, rest[0])
             logits, kp, vp = model.prefill(
                 params, p_tokens, p_lengths, kp, vp, p_bt
             )
             out, st = sample_and_scatter(
                 logits, p_slots >= 0, p_lengths, p_bt, p_slots, p_keys,
                 p_steps, p_temps, p_topks, p_topps, p_limits, p_mins,
-                p_stopids, st, mode=mode,
+                p_stopids, st, mode=mode, p_history=p_history,
             )
             return out, kp, vp, st
 
         def chunkfill_step(params, kp, vp, c_tokens, c_positions, c_bt,
                            c_final, c_last, c_lengths, c_slots, c_keys,
                            c_steps, c_temps, c_topks, c_topps, c_limits,
-                           c_mins, c_stopids, st, *, mode):
+                           c_mins, c_stopids, *rest, mode):
             """One chunk of prompt positions for up to B rows. Rows whose
             prompt ENDS in this chunk (c_final) sample their first token
             and scatter into the decode state exactly like prefill_step;
             other rows only extend their cached K/V."""
+            c_history, st = rest if spec else (None, rest[0])
             logits, kp, vp = model.prefill_chunk(
                 params, c_tokens, c_positions, kp, vp, c_bt, c_last
             )
@@ -538,6 +746,7 @@ class EngineCore:
                 logits, jnp.logical_and(c_slots >= 0, c_final), c_lengths,
                 c_bt, c_slots, c_keys, c_steps, c_temps, c_topks, c_topps,
                 c_limits, c_mins, c_stopids, st, mode=mode,
+                p_history=c_history,
             )
             return out, kp, vp, st
 
@@ -545,10 +754,13 @@ class EngineCore:
         kv = self._kv_format
         st_sh = (slot1, slot1, slot2, slot1, slot2, slot1, slot1, slot1,
                  slot1, slot1, slot1, slot2)
+        if spec:
+            st_sh += (slot2,)  # history[S, M]
         self._st_shardings = st_sh
-        self._prefill_arg_shardings = (repl,) * 12
+        self._prefill_arg_shardings = (repl,) * (13 if spec else 12)
         self._decode_fn = decode_step
         self._decode_block_fn = decode_block_step
+        self._verify_block_fn = verify_block_step
         self._prefill_fn = prefill_step
         self._chunkfill_fn = chunkfill_step
         self._make_jits(self._param_shardings)
@@ -568,12 +780,17 @@ class EngineCore:
         # decode_block > 1 swaps in the fused K-iteration scan: same
         # signature and donation, token output [K, S] instead of [S]
         # (the host normalises both to 2-D when processing). K == 1
-        # keeps literally the pre-block executable.
-        fn, out0 = (
-            (self._decode_block_fn, self._block1)
-            if self.cfg.decode_block > 1
-            else (self._decode_fn, slot1)
-        )
+        # keeps literally the pre-block executable. Speculation swaps in
+        # the fused verify scan, whose token output is the tuple
+        # ([K, S, Q] candidates, [K, S] accept counts); with
+        # spec_tokens == 0 none of this branch exists and the decode
+        # executable is bit-for-bit the non-speculative one.
+        if self.cfg.spec_tokens > 0:
+            fn, out0 = self._verify_block_fn, (self._spec_out, self._block1)
+        elif self.cfg.decode_block > 1:
+            fn, out0 = self._decode_block_fn, self._block1
+        else:
+            fn, out0 = self._decode_fn, slot1
         self._decode_jits = {
             mode: jax.jit(
                 partial(fn, mode=mode),
@@ -583,21 +800,25 @@ class EngineCore:
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
+        # Prefill data args grow by one (the per-row history) under
+        # speculation; the trailing decode-state arg shifts with them.
+        nP = len(self._prefill_arg_shardings)  # 13 if spec else 12
         self._prefill_jits = {
             mode: jax.jit(
                 partial(self._prefill_fn, mode=mode),
-                in_shardings=(param_spec, kv, kv) + (repl,) * 12 + (st_sh,),
+                in_shardings=(param_spec, kv, kv) + (repl,) * nP + (st_sh,),
                 out_shardings=(repl, kv, kv, st_sh),
-                donate_argnums=(1, 2, 15),
+                donate_argnums=(1, 2, 3 + nP),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
+        nC = nP + 3  # chunk args: 5 per-chunk + (10|11) group-invariant
         self._chunkfill_jits = {
             mode: jax.jit(
                 partial(self._chunkfill_fn, mode=mode),
-                in_shardings=(param_spec, kv, kv) + (repl,) * 15 + (st_sh,),
+                in_shardings=(param_spec, kv, kv) + (repl,) * nC + (st_sh,),
                 out_shardings=(repl, kv, kv, st_sh),
-                donate_argnums=(1, 2, 18),
+                donate_argnums=(1, 2, 3 + nC),
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
@@ -615,13 +836,14 @@ class EngineCore:
         )
         kv = self._kv_format
         # Probe the executable production actually dispatches: with
-        # decode blocks the scan body's preferred layouts are what the
-        # params should be pinned to.
-        fn, out0 = (
-            (self._decode_block_fn, self._block1)
-            if self.cfg.decode_block > 1
-            else (self._decode_fn, self._slot1)
-        )
+        # decode blocks (or speculative verify) the scan body's preferred
+        # layouts are what the params should be pinned to.
+        if self.cfg.spec_tokens > 0:
+            fn, out0 = self._verify_block_fn, (self._spec_out, self._block1)
+        elif self.cfg.decode_block > 1:
+            fn, out0 = self._decode_block_fn, self._block1
+        else:
+            fn, out0 = self._decode_fn, self._slot1
         probe = jax.jit(
             partial(fn, mode="greedy"),
             in_shardings=(auto_ps, kv, kv, self._st_shardings),
@@ -844,6 +1066,41 @@ class EngineCore:
         idx, kind, out, snapshot = self._pending.popleft()
         if kind == "decode":
             self._pending_decodes -= 1
+        if isinstance(out, tuple):
+            # Speculative verify block: ([K, S, Q] candidates, [K, S]
+            # accept counts). Per row and iteration, the first count
+            # tokens are real (count-1 accepted drafts + 1 corrected or
+            # bonus token); the rest were rejected on device. K-major so
+            # page pressure is handled in device order, and each token
+            # re-checks the row guards — a host-detected stop string at
+            # candidate i must discard candidates i+1.. of the SAME row.
+            emit = np.asarray(out[0])
+            counts = np.asarray(out[1])
+            for k in range(emit.shape[0]):
+                for row, seq, epoch in snapshot:
+                    n = int(counts[k, row])
+                    if n <= 0:
+                        continue
+                    if (
+                        seq.finish_reason is not None
+                        or seq.rid not in self.scheduler.running
+                        or seq.epoch != epoch
+                    ):
+                        continue
+                    self.spec_proposed += self.cfg.spec_tokens
+                    self.spec_accepted += n - 1
+                    for i in range(n):
+                        if (
+                            seq.finish_reason is not None
+                            or seq.rid not in self.scheduler.running
+                            or seq.epoch != epoch
+                        ):
+                            break
+                        self._append_and_check(
+                            seq, int(emit[k, row, i]), finished
+                        )
+            self._processed_idx = idx
+            return
         tokens = np.asarray(out)  # transfer started at dispatch; ~ready
         # Normalise to a [K, rows] block: prefill outputs and K=1 decode
         # steps are 1-D [rows]; fused decode blocks are already [K, S].
@@ -880,7 +1137,8 @@ class EngineCore:
         self, kind: str, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
     ) -> None:
         try:
-            out.copy_to_host_async()
+            for arr in out if isinstance(out, tuple) else (out,):
+                arr.copy_to_host_async()
         except Exception:  # noqa: BLE001 — not all backends support it
             pass
         self._dispatch_idx += 1
@@ -897,12 +1155,15 @@ class EngineCore:
         """Rebuild the device decode state from scheduler truth. Only valid
         after a full drain (host state must have caught up)."""
         assert not self._pending, "resync with in-flight steps"
-        for arr, fill in (
+        fills = [
             (self._h_tokens, 0), (self._h_ctx, 0), (self._h_active, False),
             (self._h_bt, 0), (self._h_temp, 0.0), (self._h_topk, 0),
             (self._h_topp, 1.0), (self._h_keys, 0), (self._h_steps, 0),
             (self._h_limits, 0), (self._h_mins, 0), (self._h_stopids, -1),
-        ):
+        ]
+        if self._h_history is not None:
+            fills.append((self._h_history, 0))
+        for arr, fill in fills:
             arr[...] = fill
         modes = []
         for i, seq in enumerate(self.scheduler.slots):
@@ -921,18 +1182,21 @@ class EngineCore:
             self._h_limits[i] = p.max_tokens
             self._h_mins[i] = p.min_tokens
             self._h_stopids[i] = self._stop_ids_for(seq)
+            if self._h_history is not None:
+                ids = seq.prompt_ids + seq.output_ids
+                self._h_history[i, : len(ids)] = ids
             modes.append(sampling_mod.required_mode(p))
         self._mode = sampling_mod.join_modes(modes) if modes else "greedy"
         # One batched transfer with the final shardings — no per-array
         # convert programs, no resharding on first dispatch.
-        self._dev_state = jax.device_put(
-            (
-                self._h_tokens, self._h_ctx, self._h_bt, self._h_active,
-                self._h_keys, self._h_steps, self._h_temp, self._h_topk,
-                self._h_topp, self._h_limits, self._h_mins, self._h_stopids,
-            ),
-            self._st_shardings,
+        state = (
+            self._h_tokens, self._h_ctx, self._h_bt, self._h_active,
+            self._h_keys, self._h_steps, self._h_temp, self._h_topk,
+            self._h_topp, self._h_limits, self._h_mins, self._h_stopids,
         )
+        if self._h_history is not None:
+            state += (self._h_history,)
+        self._dev_state = jax.device_put(state, self._st_shardings)
         self._dirty = False
 
     def _grow_stop_capacity(self, need: int) -> None:
@@ -1022,10 +1286,10 @@ class EngineCore:
             prefix0 = [seq.prefix_len for seq in rows]
             lengths0 = np.zeros((B,), np.int32)
             lengths0[: len(rows)] = lens
-            inv = jax.device_put(
-                (lengths0, *self._pack_sampling_rows(rows, B)),
-                (repl,) * 10,
-            )
+            inv_arrays = (lengths0, *self._pack_sampling_rows(rows, B))
+            if self.cfg.spec_tokens > 0:
+                inv_arrays += (self._pack_history_rows(rows, B),)
+            inv = jax.device_put(inv_arrays, (repl,) * len(inv_arrays))
             chunk_mode = sampling_mod.join_modes(
                 sampling_mod.required_mode(s.params) for s in rows
             )
@@ -1114,6 +1378,16 @@ class EngineCore:
             stopids[r] = self._stop_ids_for(seq)
         return slots, keys, steps, temps, topks, topps, limits, mins, stopids
 
+    def _pack_history_rows(self, rows: List[Sequence], B: int) -> np.ndarray:
+        """Per-row prompt+output token history for the speculative
+        drafter ([B, max_model_len], zero-padded): the prefill scatter
+        installs it as the row's device-side lookup corpus."""
+        hist = np.zeros((B, self.cfg.max_model_len), np.int32)
+        for r, seq in enumerate(rows):
+            ids = seq.prompt_ids + seq.output_ids
+            hist[r, : len(ids)] = ids
+        return hist
+
     def _prefill_chunk(self, chunk: List[Sequence], bucket: int) -> None:
         # Pad to {1, max_prefill_batch} rows so at most two executables
         # exist per bucket.
@@ -1126,10 +1400,10 @@ class EngineCore:
             tokens[row, : len(ids)] = ids
             lengths[row] = len(ids)
             bt[row, : len(seq.pages)] = seq.pages
-        args = jax.device_put(
-            (tokens, lengths, bt, *self._pack_sampling_rows(chunk, B)),
-            self._prefill_arg_shardings,
-        )
+        arg_arrays = (tokens, lengths, bt, *self._pack_sampling_rows(chunk, B))
+        if self.cfg.spec_tokens > 0:
+            arg_arrays += (self._pack_history_rows(chunk, B),)
+        args = jax.device_put(arg_arrays, self._prefill_arg_shardings)
         chunk_mode = sampling_mod.join_modes(
             sampling_mod.required_mode(s.params) for s in chunk
         )
@@ -1160,11 +1434,15 @@ class EngineCore:
         # and demanding lookahead pages for them here could cascade into
         # preempting/length-finishing a row whose chunk loop is still in
         # flight (zombie-slot corruption).
-        # Each in-flight decode entry covers decode_block positions, and
-        # the dispatch below adds another block; +1 slack. (K=1 recovers
-        # the historical `pending + 2`.)
+        # Each in-flight decode entry covers decode_block positions —
+        # times spec_tokens+1 when speculating, since every verify
+        # iteration writes KV for ALL candidate positions (accepted or
+        # not) — and the dispatch below adds another block; +1 slack.
+        # (K=1, spec off recovers the historical `pending + 2`.)
         K = self.cfg.decode_block
-        lookahead = (self._pending_decodes + 1) * K + 1
+        lookahead = (
+            (self._pending_decodes + 1) * K * (self.cfg.spec_tokens + 1) + 1
+        )
         decodable = self._decodable_seqs()
         needs_pages = any(
             -(-self._page_target(seq, lookahead) // self.cfg.page_size)
@@ -1496,6 +1774,17 @@ class EngineCore:
             # iterations, so dispatches <= ceil(decode_steps / K).
             decode_dispatches=self.decode_dispatches,
             decode_block=self.cfg.decode_block,
+            # Speculation health: accepted/proposed drafts. A dispatch
+            # emits 1 + (accepted this step) tokens, so tok/s scales
+            # with acceptance_rate at fixed step time (PERF_NOTES math).
+            spec_tokens=self.cfg.spec_tokens,
+            spec_proposed=self.spec_proposed,
+            spec_accepted=self.spec_accepted,
+            acceptance_rate=(
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed
+                else 0.0
+            ),
             prefills=self.prefills,
             tokens_per_sec=self.total_generated_tokens / elapsed,
             devices=int(np.prod(list(self.mesh.shape.values()))),
@@ -1505,6 +1794,14 @@ class EngineCore:
             decode_kernel=kern,
             kv_dtype=str(jnp.dtype(self.cfg.kv_dtype)),
         )
+        if self.cfg.spec_tokens > 0:
+            # What speculation actually dispatches: the multi-query
+            # verify resolves through its own plan, not the decode ladder.
+            s["verify_kernel"] = _dispatch.verify_kernel_plan(
+                self.model_config.num_heads,
+                self.model_config.num_kv_heads,
+                mesh=self.mesh,
+            )[0]
         return s
 
 

@@ -197,6 +197,102 @@ def sample_tokens(
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
+def spec_verify_tokens(
+    logits: jnp.ndarray,  # [S, Q, V] float32 — Q = spec_tokens + 1 positions
+    drafts: jnp.ndarray,  # [S, Q-1] int32 — proposed tokens (-1 = no draft)
+    key_data: jnp.ndarray,  # [S, ...] per-slot PRNG key data
+    steps: jnp.ndarray,  # [S] int32 — generation step at position 0
+    temperature: jnp.ndarray,  # [S]
+    top_k: jnp.ndarray,  # [S] int32, 0 = off
+    top_p: jnp.ndarray,  # [S] float32, 1.0 = off
+    *,
+    mode: str = "filtered",
+) -> jnp.ndarray:
+    """Speculative-verify sampling: the token the model emits at each of
+    Q candidate positions, assuming every earlier position accepted its
+    draft. ``emit[s, i] == drafts[s, i]`` means position i's draft is
+    accepted and position i+1 is reached; the first mismatch is the
+    corrected token and the chain stops there (the engine computes the
+    accepted prefix from exactly this equality). Position Q-1 carries no
+    draft — it is the bonus token sampled when every draft is accepted.
+
+    Losslessness:
+
+    - ``greedy`` — emit is the plain argmax per position, so an accepted
+      prefix is *bit-identical* to what Q sequential decode steps would
+      have produced (each position's logits condition only on accepted
+      tokens).
+    - sampled — standard rejection sampling against a deterministic
+      (point-mass) draft: accept draft d with probability p(d) under the
+      slot's temperature/top-k/top-p-filtered distribution; on rejection
+      sample from the residual — p with d removed and renormalized —
+      which makes the marginal of ``emit`` exactly p at every position.
+      Per-position randomness comes from the same device-side key chain
+      as normal decode (``fold_in(base_key, step + i)``, split into an
+      accept-uniform and a resample-Gumbel), so the scheme needs no host
+      RNG state; seeded streams legitimately differ from the non-spec
+      engine (lossless in distribution, not per-token).
+    """
+    S, Q, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)  # [S, Q]
+    if mode == "greedy":
+        return greedy
+
+    R = S * Q
+    flat = logits.reshape(R, V)
+    steps_q = (steps[:, None] + jnp.arange(Q)[None, :]).reshape(R)
+    safe_temp = jnp.maximum(jnp.repeat(temperature, Q), 1e-6)[:, None]
+    scaled = flat / safe_temp
+
+    if mode == "filtered":
+        # Same one-sort filter machinery as sample_tokens, but the keep
+        # mask is scattered back to token space: rejection sampling needs
+        # the filtered distribution itself (accept prob + residual), not
+        # just one draw from it.
+        topk_q = jnp.repeat(top_k, Q)
+        topp_q = jnp.repeat(top_p, Q)
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        ranks = jnp.arange(V)[None, :]
+        k = jnp.where(topk_q > 0, topk_q, V)[:, None]
+        keep = ranks < k
+        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+        cum_before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+        keep &= cum_before < topp_q[:, None]
+        rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, V))
+        keep_tok = jnp.zeros((R, V), bool).at[rows, sort_idx].set(keep)
+        masked = jnp.where(keep_tok, scaled, NEG_INF)
+    else:
+        masked = scaled
+
+    # Drafts flattened with a -1 sentinel at the bonus position: p(d)=0
+    # there, so the "reject" branch below is a plain sample from p.
+    d = jnp.concatenate(
+        [drafts, jnp.full((S, 1), -1, drafts.dtype)], axis=1
+    ).reshape(R)
+    step_keys = fold_step_keys(jnp.repeat(key_data, Q, axis=0), steps_q)
+    pairs = jax.vmap(lambda key: jax.random.split(key, 2))(step_keys)
+    u = jax.vmap(lambda key: jax.random.uniform(key, ()))(pairs[:, 0])
+    gumbel = jax.vmap(
+        lambda key: jax.random.gumbel(key, (V,), dtype=jnp.float32)
+    )(pairs[:, 1])
+
+    probs = jax.nn.softmax(masked, axis=-1)
+    p_d = jnp.take_along_axis(
+        probs, jnp.clip(d, 0, V - 1)[:, None], axis=-1
+    )[:, 0]
+    p_d = jnp.where(d >= 0, p_d, 0.0)
+    accept = u < p_d
+    # Residual for a point-mass draft: p with d zeroed, renormalized —
+    # Gumbel-argmax over the masked logits with d dropped samples it
+    # exactly (d = -1 routes out of range: nothing dropped, full p).
+    d_oob = jnp.where(d >= 0, d, V)
+    residual = masked.at[jnp.arange(R), d_oob].set(NEG_INF, mode="drop")
+    resample = jnp.argmax(residual + gumbel, axis=-1)
+    emit = jnp.where(accept, d, resample).reshape(S, Q)
+    return jnp.where((temperature <= 0.0)[:, None], greedy, emit)
+
+
 @functools.lru_cache(maxsize=8192)
 def _key_data_host(eff_seed: int) -> "np.ndarray":
     """Key data for ``eff_seed``, computed on the host CPU backend.

@@ -384,8 +384,13 @@ class Scheduler:
         fused decode blocks the lookahead is measured in blocks of
         ``decode_block`` positions (every in-flight dispatch may write K
         KV rows per sequence before the host sees any of its tokens), so
-        each block's full K positions are pre-reserved here; preemption
-        and epoch semantics are unchanged. May
+        each block's full K positions are pre-reserved here. Speculative
+        decoding multiplies that per-iteration demand by spec_tokens+1:
+        a verify step writes KV for EVERY candidate position whether or
+        not it is accepted (rejected writes are simply overwritten
+        later), so the engine's lookahead covers
+        ``(pending + 1) * (decode_block * (spec_tokens + 1)) + 1``
+        positions; preemption and epoch semantics are unchanged. May
         preempt other sequences (unless ``allow_preempt`` is off — the
         engine forbids it while steps are in flight, because a victim's
         freed pages could still be written); ``preemptible`` optionally

@@ -409,6 +409,66 @@ class Transformer:
         )[:, 0]
         return self._logits(params, last_h), k_pages, v_pages
 
+    # --- speculative verify ------------------------------------------------
+    def verify(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [S, Q] current token + draft candidates
+        positions: jnp.ndarray,  # [S, Q] absolute positions (−1 = inactive)
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [S, pages_per_seq]
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Multi-query decode step for speculative verification: scores
+        Q = spec_tokens+1 candidate positions per slot in one dispatch.
+        The body is ``prefill_chunk`` with the slot axis as the batch —
+        write the candidates' K/V, then attend each candidate against the
+        whole cache causally — but logits come back for *every* position
+        ([S, Q, V]), since acceptance needs the model's choice at each
+        one. Per-row positions must be a leading contiguous run
+        ``[ctx .. ctx+n, -1 …]`` (the chunked-prefill kernel contract);
+        rejected candidates' K/V stay in place and are overwritten by the
+        next verify step at the same positions, so no cache rollback is
+        needed.
+        """
+        cfg = self.config
+        inv_freq = compute_rope_inv_freq(cfg)
+        h = self._embed(params, tokens)  # [S, Q, H]
+        windows = self._window_for_layers()
+        one_plus = cfg.model_type.startswith("gemma")
+
+        def layer_fn(carry, xs):
+            h, kps, vps = carry
+            lp, window, li = xs
+            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
+            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            kps, vps = attn_ops.write_kv_pages(
+                kps, vps, k, v, block_tables, positions, layer=li
+            )
+            attn_out = attn_dispatch.chunked_prefill_attention(
+                q,
+                kps,
+                vps,
+                block_tables,
+                positions,
+                scale=cfg.attn_scale,
+                sliding_window=window,
+                softcap=cfg.attn_softcap,
+                mesh=self.mesh,
+                backend=self.attn_backend,
+                layer=li,
+            )
+            h = self._finish_layer(lp, h, attn_out)
+            return (h, kps, vps), None
+
+        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (h, k_pages, v_pages), _ = jax.lax.scan(
+            layer_fn,
+            (h, k_pages, v_pages),
+            (params["layers"], windows, layer_idx),
+        )
+        return self._logits(params, h), k_pages, v_pages
+
     # --- decode ------------------------------------------------------------
     def decode(
         self,

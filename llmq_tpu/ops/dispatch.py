@@ -216,6 +216,32 @@ def decode_kernel_plan(
     return kern, kern == "v3"
 
 
+def verify_kernel_plan(
+    n_heads: int, n_kv: int, mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+) -> tuple:
+    """(kernel_name, fused_write) the speculative verify step resolves to
+    for these shapes. Verify is multi-query decode — Q = spec_tokens+1
+    query positions per row against the paged cache — which is exactly
+    the chunked-prefill shape, so the plan mirrors
+    :func:`chunked_prefill_attention`'s resolution (pallas paged-prefill
+    kernel on TPU, XLA reference elsewhere) rather than the single-query
+    decode ladder. ``fused_write`` is always False: with Q > 1 a
+    candidate must attend its predecessors' fresh K/V, so the write has
+    to land (``write_kv_pages``) before the attention reads — the v3
+    single-row fused write cannot apply.
+
+    Same contract as :func:`decode_kernel_plan`: a pure function of
+    (shapes, mesh, env), consulted at trace time from every iteration of
+    the fused verify ``lax.scan``."""
+    backend = resolve_backend() if backend == "auto" else backend
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    if backend != "pallas" or not tp_ok:
+        return "xla", False
+    return "chunked_prefill", False
+
+
 def decode_attention_fused_write(
     q: jnp.ndarray,  # [S, n_heads, d]
     k_pages: jnp.ndarray,  # [L, P, page, n_kv, d] (or unstacked)

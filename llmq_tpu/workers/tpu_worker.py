@@ -46,6 +46,7 @@ class TPUWorker(BaseWorker):
         prefill_chunk_size: Optional[int] = None,
         enable_prefix_caching: bool = False,
         decode_block: Optional[int] = None,
+        spec_tokens: Optional[int] = None,
         **kwargs,
     ) -> None:
         self.model = model
@@ -61,6 +62,7 @@ class TPUWorker(BaseWorker):
         self._prefill_chunk_size = prefill_chunk_size
         self._enable_prefix_caching = enable_prefix_caching
         self._decode_block = decode_block
+        self._spec_tokens = spec_tokens
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
@@ -252,6 +254,12 @@ class TPUWorker(BaseWorker):
         block = self._decode_block or self.config.decode_block
         if block and block > 1:
             overrides["decode_block"] = block
+        # Lossless speculative decoding: per-worker flag > LLMQ_SPEC_TOKENS
+        # env > default 0 (off). stats()/heartbeats then carry
+        # spec_proposed/spec_accepted/acceptance_rate automatically.
+        spec = self._spec_tokens or self.config.spec_tokens
+        if spec and spec > 0:
+            overrides["spec_tokens"] = spec
         # KV cache dtype: per-worker flag > LLMQ_KV_DTYPE env > the
         # compute dtype. "fp8" stores pages as float8_e5m2 (half the KV
         # bytes; kernels convert on-chip) — vLLM kv-cache-dtype parity.

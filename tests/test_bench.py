@@ -106,43 +106,62 @@ class TestPickPreset:
 class TestTrimPlan:
     """bench.trim_plan: budget-aware phase trimming against the seconds
     left on LLMQ_BENCH_DEADLINE. The proven bf16 headline is reserved
-    first and never dropped; speculative phases drop quant-first."""
+    first and never dropped; speculative phases drop quant-first, then
+    the spec-decode rung, then the extra ladder rungs, then the A/B."""
 
     KW = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
-              proven_s=300.0)
+              spec_s=360.0, proven_s=300.0)
 
     def test_no_deadline_runs_everything(self):
         assert bench.trim_plan(None, **self.KW) == {
-            "quant": True, "kernel_ab": True, "full_ladder": True}
+            "quant": True, "kernel_ab": True, "full_ladder": True,
+            "spec_ladder": True}
 
     def test_roomy_budget_runs_everything(self):
         assert bench.trim_plan(3600.0, **self.KW) == {
-            "quant": True, "kernel_ab": True, "full_ladder": True}
+            "quant": True, "kernel_ab": True, "full_ladder": True,
+            "spec_ladder": True}
 
     def test_quant_dropped_first(self):
-        # 300 (proven) + 420 + 720 fits, + 1500 does not.
+        # 300 (proven) + 420 + 720 + 360 fits, + 1500 does not.
         plan = bench.trim_plan(2000.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": True}
+                        "full_ladder": True, "spec_ladder": True}
 
-    def test_ladder_dropped_second(self):
+    def test_spec_rung_dropped_second(self):
+        # 300 + 420 + 720 fits, + 360 (spec rung) does not.
+        plan = bench.trim_plan(1600.0, **self.KW)
+        assert plan == {"quant": False, "kernel_ab": True,
+                        "full_ladder": True, "spec_ladder": False}
+
+    def test_ladder_dropped_third(self):
         # 300 + 420 fits, + 720 does not.
         plan = bench.trim_plan(800.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": False}
+                        "full_ladder": False, "spec_ladder": False}
 
     def test_everything_but_proven_dropped(self):
         plan = bench.trim_plan(350.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": False,
-                        "full_ladder": False}
+                        "full_ladder": False, "spec_ladder": False}
 
     def test_proven_floor_reserved_before_phases(self):
-        # Exactly quant+ab+ladder of budget but NO room for the proven
-        # floor on top -> the floor wins, quant goes.
-        plan = bench.trim_plan(2640.0, **self.KW)
+        # Exactly quant+ab+ladder+spec of budget but NO room for the
+        # proven floor on top -> the floor wins, quant goes.
+        plan = bench.trim_plan(3000.0, **self.KW)
         assert plan["quant"] is False
 
     def test_boundaries_inclusive(self):
-        assert bench.trim_plan(2940.0, **self.KW)["quant"] is True
+        assert bench.trim_plan(3300.0, **self.KW)["quant"] is True
+        assert bench.trim_plan(1800.0, **self.KW)["spec_ladder"] is True
         assert bench.trim_plan(1440.0, **self.KW)["full_ladder"] is True
         assert bench.trim_plan(720.0, **self.KW)["kernel_ab"] is True
+
+    def test_spec_never_outlives_ladder(self):
+        # Drop order invariant: the spec rung is more speculative than
+        # the extra ladder rungs — no budget keeps spec while dropping
+        # the ladder.
+        for remaining in (350.0, 720.0, 800.0, 1440.0, 1600.0, 1800.0,
+                          2000.0, 3000.0, 3300.0, 3600.0):
+            plan = bench.trim_plan(remaining, **self.KW)
+            assert not (plan["spec_ladder"] and not plan["full_ladder"])

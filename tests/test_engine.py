@@ -678,3 +678,78 @@ class TestDecodeBlock:
     def test_decode_block_validation(self):
         with pytest.raises(ValueError):
             EngineConfig(decode_block=0)
+
+
+class TestSpeculativeDecoding:
+    """Lossless speculative decoding (EngineConfig.spec_tokens > 0):
+    prompt-lookup drafting + fused on-device verification must be
+    invisible in greedy outputs and exact-in-distribution elsewhere."""
+
+    def test_spec_matches_non_spec_greedy(self):
+        reqs = [
+            ("g", "hello world hello wor", greedy(10)),
+            ("rep", "abcabcabcabc", greedy(8)),
+            ("short", "hi", greedy(3)),
+        ]
+        ref = run_sync(make_core(), reqs)
+        core = make_core(engine=dict(spec_tokens=3))
+        outs = run_sync(core, reqs)
+        for rid, _, _ in reqs:
+            assert outs[rid].token_ids == ref[rid].token_ids, rid
+            assert outs[rid].finish_reason == ref[rid].finish_reason, rid
+        st = core.stats()
+        assert st["spec_tokens"] == 3
+        assert st["spec_proposed"] > 0
+        assert st["acceptance_rate"] == pytest.approx(
+            st["spec_accepted"] / st["spec_proposed"]
+        )
+        assert st["verify_kernel"] in ("chunked_prefill", "xla")
+
+    def test_spec_composes_with_decode_block(self):
+        reqs = [("g", "hello world hello wor", greedy(9))]
+        ref = run_sync(make_core(), reqs)
+        core = make_core(engine=dict(spec_tokens=2, decode_block=2))
+        outs = run_sync(core, reqs)
+        assert outs["g"].token_ids == ref["g"].token_ids
+        st = core.stats()
+        # Two verify iterations per dispatch regardless of acceptance.
+        assert st["decode_dispatches"] <= -(-st["decode_steps"] // 2)
+
+    def test_spec_off_keeps_twelve_leaf_state_and_array_output(self):
+        """spec_tokens=0 must preserve the literal pre-speculation decode
+        path: a 12-leaf device state (no history leaf), plain-array step
+        outputs, and per-token dispatch accounting."""
+        core = make_core()
+        assert len(core._dev_state) == 12
+        assert core._h_history is None
+        run_sync(core, [("r", "hi", greedy(4))])
+        st = core.stats()
+        assert st["spec_tokens"] == 0
+        assert st["spec_proposed"] == st["spec_accepted"] == 0
+        assert st["acceptance_rate"] == 0.0
+        assert "verify_kernel" not in st
+        assert st["decode_dispatches"] == st["decode_steps"] > 0
+
+    def test_spec_on_appends_history_leaf(self):
+        core = make_core(engine=dict(spec_tokens=2))
+        assert len(core._dev_state) == 13
+        assert core._dev_state[12].shape == (4, 64)  # [S, max_model_len]
+
+    def test_spec_stop_token_cuts_accepted_run(self):
+        """A stop token emitted mid-verify must cut the accepted run at
+        that position, exactly like the sequential engine."""
+        ref = run_sync(make_core(), [("r", "stop test", greedy(8))])["r"]
+        stop_id = ref.token_ids[2]
+        params = greedy(8, stop_token_ids=(stop_id,))
+        a = run_sync(make_core(), [("r", "stop test", params)])["r"]
+        b = run_sync(
+            make_core(engine=dict(spec_tokens=3)), [("r", "stop test", params)]
+        )["r"]
+        assert a.token_ids == b.token_ids
+        assert a.finish_reason == b.finish_reason == "stop"
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            EngineConfig(spec_tokens=-1)
+        with pytest.raises(ValueError):
+            EngineConfig(spec_ngram=0)

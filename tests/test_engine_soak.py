@@ -168,6 +168,99 @@ def test_soak_decode_block4_matches_k1_golden(seed):
         assert outs[rid].completion_tokens <= p.max_tokens
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_spec_decode_matches_non_spec_golden(seed):
+    """Lossless speculative decoding composes with the full feature
+    stack: a tight-pool chunked + prefix-cached engine running
+    prompt-lookup drafting with fused verification (spec_tokens=2) AND
+    fused decode blocks (decode_block=2) must emit bit-identical greedy
+    outputs to a roomy non-speculative engine — under preemption, with
+    per-row variable accept counts, host-side stop tokens, and budget
+    caps. Sampled rows are checked for budget only (their streams
+    legitimately differ: rejection sampling preserves the distribution,
+    not the per-token draw)."""
+    rng = np.random.default_rng(seed)
+    reqs = _requests(rng, 28)
+    tight = _core(
+        20, prefill_chunk_size=8, enable_prefix_caching=True,
+        decode_block=2, spec_tokens=2,
+    )
+    outs = _drive(tight, reqs, np.random.default_rng(seed + 100))
+    tight.scheduler.check_invariants()
+    st = tight.stats()
+    assert st["spec_tokens"] == 2
+    # Every processed verify row offered spec_tokens candidates.
+    assert st["spec_proposed"] > 0
+    assert 0 <= st["spec_accepted"] <= st["spec_proposed"]
+    assert st["acceptance_rate"] == pytest.approx(
+        st["spec_accepted"] / st["spec_proposed"]
+    )
+    # The tentpole accounting: each dispatch emits 1 token per verify
+    # iteration PLUS the accepted drafts, so dispatches stay strictly
+    # below the per-token-dispatch baseline of emitted decode tokens.
+    emitted_decode = st["decode_steps"] + st["spec_accepted"]
+    assert 0 < st["decode_dispatches"] < emitted_decode
+    roomy = _core(120)
+    golden = _drive(roomy, reqs, np.random.default_rng(seed + 100))
+    for rid, _, p in reqs:
+        assert outs[rid].completion_tokens <= p.max_tokens
+        if p.temperature == 0.0:
+            assert outs[rid].token_ids == golden[rid].token_ids, rid
+            assert outs[rid].finish_reason == golden[rid].finish_reason, rid
+
+
+def test_spec_verify_rejection_sampling_distribution():
+    """The verify sampler's marginal at each position must be EXACTLY
+    the request's sampling distribution regardless of what was drafted
+    (the lossless guarantee). Run many independent rows with identical
+    logits and a fixed adversarial draft, and compare empirical token
+    frequencies at position 0 against the softmax probabilities — the
+    accept/residual split must not bias toward or against the draft."""
+    from llmq_tpu.engine.sampling import spec_verify_tokens
+
+    S, V, n = 4000, 7, 2
+    logits_row = jnp.array([2.0, 1.0, 0.5, 0.0, -0.5, -1.0, -2.0])
+    logits = jnp.broadcast_to(logits_row, (S, n + 1, V))
+    # Draft the modal token everywhere: acceptance is frequent, so both
+    # the accept and the residual-resample branches get heavy traffic.
+    drafts = jnp.zeros((S, n), jnp.int32)
+    key_data = jax.random.key_data(jax.random.split(jax.random.key(42), S))
+    steps = jnp.zeros((S,), jnp.int32)
+    temps = jnp.ones((S,), jnp.float32)
+    emit = spec_verify_tokens(
+        logits, drafts, key_data, steps, temps,
+        jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.float32),
+        mode="stochastic",
+    )
+    probs = np.asarray(jax.nn.softmax(logits_row))
+    for pos in range(n + 1):
+        freq = np.bincount(np.asarray(emit[:, pos]), minlength=V) / S
+        # Total-variation distance; 4000 draws over 7 tokens gives
+        # ~0.01-0.02 sampling noise, so 0.05 catches any real bias.
+        tv = 0.5 * np.abs(freq - probs).sum()
+        assert tv < 0.05, (pos, tv, freq, probs)
+    # Filtered mode restricted to top_k=2: mass must land on tokens
+    # {0, 1} with the renormalized ratio, again draft-independent.
+    emit_f = spec_verify_tokens(
+        logits, drafts, key_data, steps, temps,
+        jnp.full((S,), 2, jnp.int32), jnp.ones((S,), jnp.float32),
+        mode="filtered",
+    )
+    top2 = np.exp([2.0, 1.0]) / np.exp([2.0, 1.0]).sum()
+    for pos in range(n + 1):
+        counts = np.bincount(np.asarray(emit_f[:, pos]), minlength=V)
+        assert counts[2:].sum() == 0, "top_k=2 emitted a filtered token"
+        tv = 0.5 * np.abs(counts[:2] / S - top2).sum()
+        assert tv < 0.05, (pos, tv)
+    # Greedy mode is the plain argmax — drafts cannot perturb it.
+    emit_g = spec_verify_tokens(
+        logits, drafts, key_data, steps, jnp.zeros((S,), jnp.float32),
+        jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.float32),
+        mode="greedy",
+    )
+    assert np.asarray(emit_g).min() == 0 and np.asarray(emit_g).max() == 0
+
+
 def test_soak_int8_tight_pool_matches_int8_golden():
     """Int8 weight-only quantization composes losslessly with the whole
     feature stack: a tight-pool chunked+cached+preempting int8 engine

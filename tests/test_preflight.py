@@ -219,6 +219,22 @@ def test_bench_tiny_decode_block_runs():
     assert '"decode_block": 2' in proc.stdout
 
 
+def test_bench_tiny_spec_runs():
+    """One representative bench command runs end to end on CPU with
+    lossless speculative decoding pinned on (2 draft tokens), and the
+    metric line reports both the draft length and the measured
+    acceptance rate."""
+    proc = _run(
+        {**TINY_ENV, "LLMQ_BENCH_SPEC_TOKENS": "2"},
+        ["python", "bench.py"],
+        timeout=400,
+    )
+    _assert_ran("bench:tiny-spec", proc)
+    assert '"metric"' in proc.stdout
+    assert '"spec_tokens": 2' in proc.stdout
+    assert '"acceptance_rate"' in proc.stdout
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "name,env,argv",

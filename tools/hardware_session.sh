@@ -10,6 +10,8 @@
 #   4. int8 3B bench (weight-bandwidth-bound decode should gain ~directly)
 #   5. int8 9B bench — the north-star architecture on ONE 16 GB chip
 #   6. param auto-layout A/B (flip the default if it holds)
+#   7. speculative decoding A/B vs the bf16 headline (acceptance-rate
+#      dependent; see PERF_NOTES round 7 for the win condition)
 #
 # Each step has its own timeout so one hang doesn't eat the session.
 set -u
@@ -39,10 +41,13 @@ run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
     LLMQ_BENCH_PRESET=tower-plus-9b python bench.py
 run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
+run 1800 bench_spec3   env LLMQ_BENCH_TRY_QUANT=0 \
+    LLMQ_BENCH_SPEC_TOKENS=3 python bench.py
 
 echo "=== summary"
 grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null
 echo "Next: compare bench_autolayout vs bench_bf16; if auto-layout holds,"
+echo "compare bench_spec3 vs bench_bf16 and record the acceptance rate;"
 echo "default LLMQ_PARAM_AUTO_LAYOUT=1 on TPU in engine.py; flip the"
 echo "LLMQ_DECODE_KERNEL fallback in ops/dispatch.py to kernel_v123's"
 echo "winner; record the best line in PERF_NOTES."
