@@ -2,6 +2,7 @@
 
 from llmq_tpu.analysis.checkers.blocking import BlockingCallChecker
 from llmq_tpu.analysis.checkers.cancellation import CancelledSwallowChecker
+from llmq_tpu.analysis.checkers.collective_axis import CollectiveAxisChecker
 from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
 from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
 from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
@@ -12,6 +13,7 @@ ALL_CHECKERS = (
     BlockingCallChecker,
     CancelledSwallowChecker,
     JaxHostSyncChecker,
+    CollectiveAxisChecker,
 )
 
 #: rule id -> Rule, across every registered checker.

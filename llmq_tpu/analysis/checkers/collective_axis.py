@@ -1,0 +1,118 @@
+"""collective-axis: hand-written collectives must name axes via constants.
+
+The mesh axis names are defined ONCE in ``parallel/mesh.py`` (``DP_AXIS``
+/ ``SP_AXIS`` / ``TP_AXIS``) and every hand-written collective — the
+ring-attention and collective-matmul shard_map bodies, the attention
+dispatch wrappers — must reference them through those constants. A
+string literal like ``lax.psum(x, "tp")`` still runs today, but it
+silently decouples from the mesh definition: rename an axis (or thread a
+submesh) and the literal keeps compiling against whatever axis happens
+to share the spelling, or fails at trace time far from the real cause.
+This is exactly the class of drift the tp-overlap rings multiplied the
+surface for, so the lint gate pins it.
+
+Flagged: any ``jax.lax`` collective call (``psum``, ``ppermute``,
+``all_gather``, ``psum_scatter``, ``all_to_all``, ``pmean``/``pmax``/
+``pmin``, ``axis_index``, ``pcast``...) whose axis-name argument —
+positional or ``axis_name=`` keyword — is a string literal or a
+tuple/list containing one. Names and attribute references
+(``TP_AXIS``, ``mesh_lib.TP_AXIS``) pass; ``parallel/mesh.py`` itself
+(the constants' definition site) is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+)
+
+COLLECTIVE_AXIS = Rule(
+    "collective-axis",
+    "error",
+    "hand-written collective names its axis as a string literal "
+    "instead of the parallel.mesh constants",
+)
+
+# jax.lax collective -> index of its axis-name positional arg (after the
+# operand(s)); the keyword is ``axis_name`` for all of them except
+# axis_index, whose single positional IS the axis name.
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "all_gather": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "pcast": 1,
+    "axis_index": 0,
+}
+
+# The constants' own definition site is the one place literals belong.
+_EXEMPT_SUFFIXES = ("parallel/mesh.py",)
+
+
+def _literal_axis(node: ast.AST) -> Optional[str]:
+    """The offending literal spelling when ``node`` is (or contains) a
+    string-literal axis name; None when it's a proper reference."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return repr(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                return repr(el.value)
+    return None
+
+
+class CollectiveAxisChecker(Checker):
+    rules = (COLLECTIVE_AXIS,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        path = str(source.path).replace("\\", "/")
+        if path.endswith(_EXEMPT_SUFFIXES):
+            return
+        imports = ImportMap(source.tree)
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = imports.resolve(node.func) or ""
+            if not resolved.startswith("jax.lax."):
+                continue
+            name = resolved.rsplit(".", 1)[1]
+            pos = _COLLECTIVES.get(name)
+            if pos is None:
+                continue
+            axis_arg: Optional[ast.AST] = None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+                    break
+            if axis_arg is None and len(node.args) > pos:
+                axis_arg = node.args[pos]
+            if axis_arg is None:
+                continue
+            literal = _literal_axis(axis_arg)
+            if literal is None:
+                continue
+            yield Violation(
+                rule=COLLECTIVE_AXIS,
+                path=source.path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"jax.lax.{name} axis_name is the string literal "
+                    f"{literal}; use the parallel.mesh constants "
+                    "(TP_AXIS/SP_AXIS/DP_AXIS) so collectives follow the "
+                    "mesh definition"
+                ),
+            )

@@ -284,10 +284,17 @@ def worker() -> None:
                    "is bit-identical; sampled distributions stay exact "
                    "via rejection sampling — pays off on workloads that "
                    "copy prompt spans). Default: LLMQ_SPEC_TOKENS or 0")
+@click.option("--tp-overlap", default=None,
+              type=click.Choice(["off", "on", "auto"]),
+              help="Tensor-parallel collective overlap: 'on' replaces "
+                   "GSPMD's per-layer all-reduces with chunked ppermute "
+                   "rings that hide ICI hops behind matmul chunks; 'auto' "
+                   "A/Bs ring-vs-GSPMD on this host's chips. Default: "
+                   "LLMQ_TP_OVERLAP or off")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
                dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block,
-               spec_tokens):
+               spec_tokens, tp_overlap):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -305,6 +312,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         enable_prefix_caching=prefix_caching,
         decode_block=decode_block,
         spec_tokens=spec_tokens,
+        tp_overlap=tp_overlap,
     )
 
 

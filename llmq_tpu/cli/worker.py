@@ -28,6 +28,7 @@ def run_tpu_worker(
     enable_prefix_caching: bool = False,
     decode_block: Optional[int] = None,
     spec_tokens: Optional[int] = None,
+    tp_overlap: Optional[str] = None,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
@@ -52,6 +53,7 @@ def run_tpu_worker(
         enable_prefix_caching=enable_prefix_caching,
         decode_block=decode_block,
         spec_tokens=spec_tokens,
+        tp_overlap=tp_overlap,
     )
     _run(worker)
 

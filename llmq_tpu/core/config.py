@@ -169,6 +169,14 @@ class Config(BaseModel):
         "keep the exact output distribution via rejection sampling.",
     )
 
+    tp_overlap: str = Field(
+        default_factory=lambda: (_env("LLMQ_TP_OVERLAP") or "off").lower(),
+        description="Tensor-parallel collective overlap: 'on' replaces "
+        "GSPMD's per-layer all-reduces with chunked ppermute rings "
+        "(ops/collective_matmul.py), 'auto' A/Bs ring-vs-GSPMD on the "
+        "deployment hardware, 'off' keeps the literal GSPMD programs.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

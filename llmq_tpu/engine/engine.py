@@ -91,6 +91,7 @@ from llmq_tpu.engine.scheduler import (
 from llmq_tpu.engine.tokenizer import Tokenizer
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.models.transformer import Params, Transformer, make_kv_pages
+from llmq_tpu.ops import dispatch as _dispatch
 from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
 from llmq_tpu.parallel.sharding import kv_page_pspec, param_shardings
 
@@ -183,6 +184,17 @@ class EngineConfig:
     # stop set exceeds it, so min_tokens suppression always covers the
     # full set — no silent truncation.
     stop_id_capacity: int = 8
+    # Tensor-parallel collective overlap: "on" replaces GSPMD's two
+    # blocking per-layer all-reduces (after o_proj and down_proj) with
+    # the chunked bidirectional ppermute rings in
+    # ops/collective_matmul.py, so each ICI hop hides behind the next
+    # chunk's matmul; "off" (default) traces the literal pre-existing
+    # programs — the decode_block=1 / spec_tokens=0 precedent; "auto"
+    # lets kernel_autotune A/B ring-vs-GSPMD per deployment.
+    # LLMQ_TP_OVERLAP pins over this. Greedy outputs are token-identical
+    # either way (the ring reduces in a different order, so float
+    # bitstreams may differ at bf16).
+    tp_overlap: str = "off"
 
     def __post_init__(self):
         self.decode_block = int(self.decode_block)
@@ -199,6 +211,11 @@ class EngineConfig:
         if self.spec_ngram < 1:
             raise ValueError(
                 f"spec_ngram={self.spec_ngram} (want >= 1)"
+            )
+        self.tp_overlap = str(self.tp_overlap).lower()
+        if self.tp_overlap not in ("off", "on", "auto"):
+            raise ValueError(
+                f"tp_overlap={self.tp_overlap!r} (want off|on|auto)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -269,7 +286,21 @@ class EngineCore:
         self.tokenizer = tokenizer
         self.cfg = engine_config or EngineConfig()
         self.mesh = mesh if mesh is not None else make_mesh(tensor_parallel=1)
-        self.model = Transformer(model_config, mesh=self.mesh)
+        # Resolved once, before any trace: the mode is a static field on
+        # the frozen Transformer, so every jit variant (prefill buckets,
+        # decode, verify, chunked prefill) sees the same choice and the
+        # donation/sharding contracts are untouched.
+        self.tp_overlap = _dispatch.resolve_tp_overlap(
+            self.cfg.tp_overlap,
+            self.mesh,
+            hidden_size=model_config.hidden_size,
+            intermediate_size=model_config.intermediate_size,
+            max_seqs=self.cfg.max_num_seqs,
+            logger=logger,
+        )
+        self.model = Transformer(
+            model_config, mesh=self.mesh, tp_overlap=self.tp_overlap
+        )
 
         self._param_shardings = param_shardings(
             self.mesh, model_config, params=params
@@ -353,11 +384,18 @@ class EngineCore:
             # tp==1 scope (ops/pallas_matmul.py): demote to the XLA int8
             # path before this engine traces. Process-wide by design —
             # workers and bench build exactly one engine per process.
+            # With tp_overlap=on the restriction only bites the
+            # column-parallel GSPMD sites: the overlap rings' chunk
+            # matmuls are plain local calls and keep the Pallas kernel
+            # (ops/collective_matmul.py checks the env var directly).
             logger.warning(
                 "LLMQ_INT8_MATMUL=pallas is single-chip-only (tp=%d mesh); "
                 "using the XLA int8 matmul path for the rest of this "
-                "process",
+                "process%s",
                 tp_size,
+                " (tp_overlap ring chunks keep the Pallas path)"
+                if self.tp_overlap == "on"
+                else "",
             )
             from llmq_tpu.models import quant as _qm
 
@@ -1793,6 +1831,9 @@ class EngineCore:
             # heartbeats instead of guessing from env vars.
             decode_kernel=kern,
             kv_dtype=str(jnp.dtype(self.cfg.kv_dtype)),
+            # Resolved at build time (env pin / config / autotune) — may
+            # differ from cfg.tp_overlap ("auto", or forced off on tp=1).
+            tp_overlap=self.tp_overlap,
         )
         if self.cfg.spec_tokens > 0:
             # What speculation actually dispatches: the multi-query

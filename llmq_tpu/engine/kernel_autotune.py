@@ -196,6 +196,173 @@ def run_ab(
         return "v1", False
 
 
+def run_tp_overlap_ab(
+    *,
+    hidden_size: int,
+    intermediate_size: int,
+    max_seqs: int = 192,
+    num_layers: int = 8,
+    dtype: str = "bfloat16",
+) -> tuple:
+    """In-process GSPMD-vs-ring A/B for ``tp_overlap`` (the child body).
+
+    Times a decode-shaped row-parallel layer pair — o_proj-like [S, H] x
+    [H, H] and down_proj-like [S, I] x [I, H] with a column-parallel up
+    projection between them, chained over ``num_layers`` so nothing can
+    be elided — once with GSPMD's all-reduces and once with the
+    ``ops/collective_matmul`` ppermute rings, over ALL visible devices as
+    the tp axis. Returns ``("off", False)`` off-TPU or on any failure —
+    never raises; ``measured`` is True only for a real timing.
+    """
+    try:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if jax.devices()[0].platform != "tpu":
+            return "off", False  # ICI overlap is the whole point
+        from llmq_tpu.ops import collective_matmul as cm
+        from llmq_tpu.parallel.mesh import TP_AXIS, make_mesh
+
+        tp = len(jax.devices())
+        if tp <= 1 or hidden_size % tp or intermediate_size % tp:
+            return "off", False
+        mesh = make_mesh(tensor_parallel=tp)
+        plan = cm.ring_plan(mesh)
+        H, I, S = hidden_size, intermediate_size, max_seqs
+        dt = jnp.dtype(dtype)
+
+        def rnd(seed, shape, spec):
+            arr = jax.random.normal(
+                jax.random.key(seed), shape, jnp.float32
+            ).astype(dt) * (0.5 / shape[0] ** 0.5)
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        wo = rnd(0, (H, H), P(TP_AXIS, None))  # o_proj-like, row-parallel
+        wu = rnd(1, (H, I), P(None, TP_AXIS))  # up-like, column-parallel
+        wd = rnd(2, (I, H), P(TP_AXIS, None))  # down-like, row-parallel
+        x0 = rnd(3, (S, H), P(None, None))
+
+        @functools.partial(jax.jit, static_argnames=("which",))
+        def run(h, *, which):
+            ring = which == "ring"
+
+            def mm(a, w):
+                return cm.row_parallel_matmul(a, w, plan if ring else None)
+
+            def layer(_, h):
+                h = h + mm(h, wo)
+                # Column-parallel up stays GSPMD for BOTH candidates (the
+                # model keeps it GSPMD too); its [S, I] output is
+                # tp-sharded, which is exactly the ring's down input spec.
+                return h + mm(h @ wu, wd)
+
+            return jax.lax.fori_loop(0, num_layers, layer, h)
+
+        def timeit(which, n=10):
+            jax.block_until_ready(run(x0, which=which))
+            t0 = time.monotonic()
+            for _ in range(n):
+                out = run(x0, which=which)
+            jax.block_until_ready(out)
+            return (time.monotonic() - t0) / (n * num_layers)
+
+        times = {which: timeit(which) for which in ("gspmd", "ring")}
+        diff = float(
+            jnp.max(
+                jnp.abs(
+                    run(x0, which="ring").astype(jnp.float32)
+                    - run(x0, which="gspmd").astype(jnp.float32)
+                )
+            )
+        )
+        # The ring must win by a real margin (5%) AND agree numerically
+        # (different reduction order, so a loose tolerance — greedy
+        # token parity is asserted elsewhere, this guards against a
+        # broken ring, not ulps).
+        choice = (
+            "on" if times["ring"] < 0.95 * times["gspmd"] and diff < 0.5
+            else "off"
+        )
+        shown = " ".join(f"{k}={v*1e6:.1f}us" for k, v in times.items())
+        print(
+            f"kernel-autotune: tp-overlap A/B {shown} per layer "
+            f"(tp={tp}, |diff|={diff:.2e}) -> {choice}",
+            file=sys.stderr,
+        )
+        return choice, True
+    except Exception as exc:  # noqa: BLE001 — never endanger the caller
+        print(
+            f"kernel-autotune: tp-overlap A/B failed ({exc!r}); using off",
+            file=sys.stderr,
+        )
+        return "off", False
+
+
+def autotune_tp_overlap(
+    *,
+    hidden_size: int,
+    intermediate_size: int,
+    max_seqs: int = 192,
+    tp: Optional[int] = None,
+    dtype: str = "bfloat16",
+    timeout_s: Optional[float] = None,
+    logger=None,
+) -> Optional[str]:
+    """Subprocess A/B driver for ``tp_overlap=auto``.
+
+    Same contract as :func:`autotune_decode_kernel`: returns the winning
+    mode ("on"/"off"), or ``None`` when the probe does not apply
+    (CPU-pinned platform, ``LLMQ_KERNEL_AUTOTUNE=0``); failures and
+    timeouts return "off" (the conservative literal-GSPMD default).
+    Deliberately does NOT short-circuit on ``LLMQ_TP_OVERLAP`` — env
+    precedence belongs to ``ops/dispatch.resolve_tp_overlap``, whose
+    ``auto`` branch only reaches here when no pin is set. Note the libtpu
+    exclusivity caveat: call this BEFORE the parent initialises its
+    backend (the worker/bench pattern), or the child cannot grab the
+    chip and the probe degrades to "off".
+    """
+    if os.environ.get("LLMQ_KERNEL_AUTOTUNE", "1").lower() in ("0", "false"):
+        return None
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return None  # no ICI to overlap
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("LLMQ_BENCH_AB_TIMEOUT", 420))
+    argv = [
+        sys.executable,
+        "-m",
+        "llmq_tpu.engine.kernel_autotune",
+        "tp-overlap",
+        str(hidden_size),
+        str(intermediate_size),
+        str(max_seqs),
+        dtype,
+    ]
+    try:
+        proc = subprocess.run(
+            argv, timeout=timeout_s, capture_output=True, text=True
+        )
+        sys.stderr.write(proc.stderr[-600:])
+        choice = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode == 0 and choice in ("on", "off"):
+            detail = (proc.stderr.strip().splitlines() or ["no detail"])[-1]
+            if logger is not None:
+                logger.info("tp_overlap: %s (A/B %s)", choice, detail)
+            return choice
+        msg = f"tp-overlap A/B rc={proc.returncode}; using off"
+    except subprocess.TimeoutExpired:
+        msg = "tp-overlap A/B timed out; using off"
+    except Exception as exc:  # noqa: BLE001
+        msg = f"tp-overlap A/B failed ({exc!r}); using off"
+    if logger is not None:
+        logger.warning(msg)
+    else:
+        print(f"kernel-autotune: {msg}", file=sys.stderr)
+    return "off"
+
+
 def autotune_decode_kernel(
     *,
     num_heads: int,
@@ -287,20 +454,31 @@ def _cache_key(shapes: tuple, identity: str, kv_dtype: str) -> str:
     )
 
 
+def _tp_overlap_cache_key(
+    hidden: int, inter: int, seqs: int, tp: int, dtype: str, identity: str
+) -> str:
+    return f"tpovl:h{hidden}:i{inter}:s{seqs}:tp{tp}:{dtype}:{identity}"
+
+
 def resolve_choice(
-    shapes: tuple, identity: str, measure, kv_dtype: str = "bfloat16"
+    shapes: tuple, identity: str, measure, kv_dtype: str = "bfloat16",
+    *, key: Optional[str] = None, valid: tuple = ("v1", "v2", "v3")
 ) -> str:
     """Cache-or-measure for the probing child. ``measure()`` must return
     ``(choice, measured)`` — only MEASURED results are ever stored (the
-    A/B's internal failure fallbacks must not pin a stale v1)."""
+    A/B's internal failure fallbacks must not pin a stale v1).
+
+    ``key``/``valid`` generalize the cache beyond the decode-kernel probe
+    (the tp-overlap A/B passes its own key and ``("on", "off")``);
+    defaults keep the original decode-kernel behaviour."""
     import json
 
     path = cache_path_from_env()
-    key = _cache_key(shapes, identity, kv_dtype)
+    key = key if key is not None else _cache_key(shapes, identity, kv_dtype)
     if path is not None and path.exists():
         try:
             entry = json.loads(path.read_text()).get(key)
-            if entry and entry.get("choice") in ("v1", "v2", "v3"):
+            if entry and entry.get("choice") in valid:
                 print(
                     f"kernel-autotune: cached A/B for this chip -> "
                     f"{entry['choice']} ({path})",
@@ -333,6 +511,38 @@ def _main() -> None:
 
         force_cpu_platform()
     import jax
+
+    if len(sys.argv) > 1 and sys.argv[1] == "tp-overlap":
+        # tp-overlap mode: argv = ["tp-overlap", hidden, inter, seqs,
+        # dtype?]. Must print a mode and exit 0 even on CPU (the
+        # preflight suite executes every scripted leg in tiny mode).
+        hidden, inter, seqs = (int(a) for a in sys.argv[2:5])
+        dtype = sys.argv[5] if len(sys.argv) > 5 else "bfloat16"
+        dev = jax.devices()[0]
+        identity = f"{dev.device_kind or dev.platform}/jax{jax.__version__}"
+        tp = len(jax.devices())
+
+        def measure_overlap():
+            return run_tp_overlap_ab(
+                hidden_size=hidden,
+                intermediate_size=inter,
+                max_seqs=seqs,
+                dtype=dtype,
+            )
+
+        print(
+            resolve_choice(
+                (),
+                identity,
+                measure_overlap,
+                dtype,
+                key=_tp_overlap_cache_key(
+                    hidden, inter, seqs, tp, dtype, identity
+                ),
+                valid=("on", "off"),
+            )
+        )
+        return
 
     shapes = tuple(int(a) for a in sys.argv[1:7])
     kv_dtype = sys.argv[7] if len(sys.argv) > 7 else "bfloat16"

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from llmq_tpu.models import quant as qm
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.ops import attention as attn_ops
+from llmq_tpu.ops import collective_matmul as cm
 from llmq_tpu.ops import dispatch as attn_dispatch
 
 Params = Dict[str, Any]
@@ -95,17 +96,30 @@ def apply_rope(
     return out.astype(x.dtype)
 
 
-def _mlp(h: jnp.ndarray, lp: Params, activation: str) -> jnp.ndarray:
+def _mlp(
+    h: jnp.ndarray,
+    lp: Params,
+    activation: str,
+    plan: "cm.TpRingPlan | None" = None,
+) -> jnp.ndarray:
     gate = qm.matmul(h, lp["gate_proj"])
     up = qm.matmul(h, lp["up_proj"])
     if activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
         act = jax.nn.silu(gate)
-    return qm.matmul(act * up, lp["down_proj"])
+    # down_proj is the row-parallel projection GSPMD follows with a
+    # blocking all-reduce; with a tp-overlap plan it runs as the chunked
+    # ppermute ring instead (plan=None is the literal qm.matmul).
+    return cm.row_parallel_matmul(act * up, lp["down_proj"], plan)
 
 
-def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
+def _moe_mlp(
+    h: jnp.ndarray,
+    lp: Params,
+    config: ModelConfig,
+    plan: "cm.TpRingPlan | None" = None,
+) -> jnp.ndarray:
     """Sparse mixture-of-experts MLP (qwen2_moe/qwen3_moe semantics),
     TPU-first: tokens are sorted by routed expert and each expert's group
     runs as one ``jax.lax.ragged_dot`` (grouped matmul on the MXU) — the
@@ -148,8 +162,8 @@ def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
         act = jax.nn.gelu(gate, approximate=True) * up
     else:
         act = jax.nn.silu(gate) * up
-    down = jax.lax.ragged_dot(
-        act, qm.dequantize(lp["expert_down_proj"], x.dtype), group_sizes
+    down = cm.row_parallel_ragged_matmul(
+        act, lp["expert_down_proj"], group_sizes, x.dtype, plan
     )
 
     w_sorted = top_w.reshape(-1)[order].astype(down.dtype)  # [N*k]
@@ -166,6 +180,7 @@ def _moe_mlp(h: jnp.ndarray, lp: Params, config: ModelConfig) -> jnp.ndarray:
                 "down_proj": lp["shared_down_proj"],
             },
             config.activation,
+            plan,
         )
         out = out + jax.nn.sigmoid(x @ lp["shared_expert_gate"]) * shared
     return out.reshape(*lead, H)
@@ -184,11 +199,20 @@ class Transformer:
     kernels in ``shard_map`` over the tp axis (ops/dispatch.py); the
     pure-XLA fallback ignores it (GSPMD partitions it directly).
     ``attn_backend``: "auto" | "pallas" | "xla".
+
+    ``tp_overlap``: the RESOLVED mode from
+    ``ops/dispatch.resolve_tp_overlap`` — "on" routes the row-parallel
+    projections (o_proj, down_proj, expert_down_proj, shared_down_proj)
+    through the chunked ppermute rings in ``ops/collective_matmul.py``
+    instead of GSPMD's per-layer all-reduces; "off" traces the literal
+    pre-existing programs. Static (a frozen field), so every iteration
+    of the layer scan — and every jit variant — sees the same choice.
     """
 
     config: ModelConfig
     mesh: Any = None
     attn_backend: str = "auto"
+    tp_overlap: str = "off"
 
     # --- shared layer body -------------------------------------------------
     def _qkv(
@@ -219,9 +243,10 @@ class Transformer:
     ) -> jnp.ndarray:
         cfg = self.config
         one_plus = cfg.model_type.startswith("gemma")
+        plan = cm.ring_plan(self.mesh) if self.tp_overlap == "on" else None
         *lead, _, _ = attn_out.shape
         attn_flat = attn_out.reshape(*lead, cfg.num_heads * cfg.head_dim_)
-        attn_proj = qm.matmul(attn_flat, lp["o_proj"])
+        attn_proj = cm.row_parallel_matmul(attn_flat, lp["o_proj"], plan)
         if cfg.post_norms:
             attn_proj = rms_norm(
                 attn_proj, lp["post_attn_norm"], cfg.rms_norm_eps, one_plus=one_plus
@@ -229,9 +254,9 @@ class Transformer:
         h = h + attn_proj
         mlp_in = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, one_plus=one_plus)
         mlp_out = (
-            _moe_mlp(mlp_in, lp, cfg)
+            _moe_mlp(mlp_in, lp, cfg, plan)
             if cfg.num_experts
-            else _mlp(mlp_in, lp, cfg.activation)
+            else _mlp(mlp_in, lp, cfg.activation, plan)
         )
         if cfg.post_norms:
             mlp_out = rms_norm(

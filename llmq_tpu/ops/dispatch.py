@@ -242,6 +242,56 @@ def verify_kernel_plan(
     return "chunked_prefill", False
 
 
+def resolve_tp_overlap(
+    mode: str,
+    mesh: Optional[Mesh],
+    *,
+    hidden_size: Optional[int] = None,
+    intermediate_size: Optional[int] = None,
+    max_seqs: Optional[int] = None,
+    logger=None,
+) -> str:
+    """Resolve ``EngineConfig.tp_overlap`` to the mode the engine will
+    actually run: ``"on"`` (chunked ppermute rings from
+    ``ops/collective_matmul.py`` replace GSPMD's per-layer all-reduces)
+    or ``"off"`` (the literal pre-existing programs).
+
+    Unlike the kernel plans above, this is resolved ONCE at engine build
+    time and carried as a static field on the ``Transformer`` — so the
+    ``auto`` branch is free to run a subprocess A/B (it never executes at
+    trace time). Precedence mirrors ``decode_kernel``: the
+    ``LLMQ_TP_OVERLAP`` env pin wins over the config value, and any mesh
+    without a tp axis degenerates to ``off`` (there is no all-reduce to
+    hide).
+    """
+    env = (os.environ.get("LLMQ_TP_OVERLAP") or "").lower()
+    if env:
+        if env not in ("off", "on", "auto"):
+            raise ValueError(f"LLMQ_TP_OVERLAP={env!r} (want off|on|auto)")
+        mode = env
+    mode = (mode or "off").lower()
+    if mode not in ("off", "on", "auto"):
+        raise ValueError(f"tp_overlap={mode!r} (want off|on|auto)")
+    if _tp_degree(mesh) <= 1:
+        return "off"
+    if mode != "auto":
+        return mode
+    if jax.default_backend() != "tpu" or not (hidden_size and intermediate_size):
+        # Nothing to measure off-TPU (ICI overlap is the whole point),
+        # and without shapes an A/B would be meaningless.
+        return "off"
+    from llmq_tpu.engine.kernel_autotune import autotune_tp_overlap
+
+    choice = autotune_tp_overlap(
+        hidden_size=hidden_size,
+        intermediate_size=intermediate_size,
+        max_seqs=max_seqs or 192,
+        tp=_tp_degree(mesh),
+        logger=logger,
+    )
+    return choice if choice in ("on", "off") else "off"
+
+
 def decode_attention_fused_write(
     q: jnp.ndarray,  # [S, n_heads, d]
     k_pages: jnp.ndarray,  # [L, P, page, n_kv, d] (or unstacked)

@@ -4,7 +4,12 @@ Megatron-style tensor parallelism expressed as weight shardings only —
 GSPMD propagates them through the jitted prefill/decode programs and
 inserts the ICI collectives (all-gather on the column-parallel outputs,
 reduce-scatter/psum after the row-parallel matmuls). No hand-written
-collectives in the model code.
+collectives in the model code, with ONE deliberate exception: when
+``EngineConfig.tp_overlap`` resolves to "on", the row-parallel
+projections route through the chunked ``lax.ppermute`` rings in
+``ops/collective_matmul.py`` (shard_map over the same tp axis and the
+same weight shardings below), hiding each ICI hop behind the next chunk's
+matmul instead of paying GSPMD's blocking per-layer all-reduces.
 
 Layout (matches ``models/transformer.py::init_params``):
 

@@ -47,6 +47,7 @@ class TPUWorker(BaseWorker):
         enable_prefix_caching: bool = False,
         decode_block: Optional[int] = None,
         spec_tokens: Optional[int] = None,
+        tp_overlap: Optional[str] = None,
         **kwargs,
     ) -> None:
         self.model = model
@@ -63,6 +64,7 @@ class TPUWorker(BaseWorker):
         self._enable_prefix_caching = enable_prefix_caching
         self._decode_block = decode_block
         self._spec_tokens = spec_tokens
+        self._tp_overlap = tp_overlap
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
@@ -101,6 +103,7 @@ class TPUWorker(BaseWorker):
         # backend is initialised in this process (libtpu is exclusive).
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, self._autotune_kernel)
+        await loop.run_in_executor(None, self._autotune_tp_overlap)
         self.engine = await loop.run_in_executor(None, self._build_engine)
         self.logger.info("Engine ready: %s", self.engine.stats())
 
@@ -145,6 +148,33 @@ class TPUWorker(BaseWorker):
         )
         if choice is not None:
             os.environ["LLMQ_DECODE_KERNEL"] = choice
+
+    def _autotune_tp_overlap(self) -> None:
+        """Resolve ``tp_overlap=auto`` by A/B-ing the ppermute rings
+        against GSPMD on this host's chips — run HERE, before any JAX
+        backend initialises in this process, because the probing child
+        needs exclusive libtpu. Exports the choice via ``LLMQ_TP_OVERLAP``
+        so ``resolve_tp_overlap`` inside the engine picks it up without
+        re-probing. No-op unless the configured mode is 'auto' (an
+        explicit env pin already wins everywhere)."""
+        if os.environ.get("LLMQ_TP_OVERLAP"):
+            return
+        mode = (self._tp_overlap or self.config.tp_overlap or "off").lower()
+        if mode != "auto":
+            return
+        cfg = self._model_config_host()
+        if cfg is None:
+            return
+        from llmq_tpu.engine.kernel_autotune import autotune_tp_overlap
+
+        choice = autotune_tp_overlap(
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            max_seqs=self._max_num_seqs or self.config.max_num_seqs or 192,
+            logger=self.logger,
+        )
+        if choice is not None:
+            os.environ["LLMQ_TP_OVERLAP"] = choice
 
     def _resolve_pool_dtype(self) -> str:
         """The KV pool dtype _build_engine will actually use, as a
@@ -260,6 +290,12 @@ class TPUWorker(BaseWorker):
         spec = self._spec_tokens or self.config.spec_tokens
         if spec and spec > 0:
             overrides["spec_tokens"] = spec
+        # Tensor-parallel overlap: per-worker flag > LLMQ_TP_OVERLAP env >
+        # default off. The engine resolves 'auto' (and reports the
+        # resolved mode in stats() → heartbeats).
+        ov = (self._tp_overlap or self.config.tp_overlap or "off").lower()
+        if ov != "off":
+            overrides["tp_overlap"] = ov
         # KV cache dtype: per-worker flag > LLMQ_KV_DTYPE env > the
         # compute dtype. "fp8" stores pages as float8_e5m2 (half the KV
         # bytes; kernels convert on-chip) — vLLM kv-cache-dtype parity.

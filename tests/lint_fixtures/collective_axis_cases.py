@@ -1,0 +1,68 @@
+"""collective-axis: axis names in hand-written collectives must be the
+parallel.mesh constants, not string literals."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.lax import psum
+
+from llmq_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+_PERM = [(0, 1), (1, 0)]
+
+
+def bad_literal_positional(x):
+    return jax.lax.psum(x, "tp")  # EXPECT[collective-axis]
+
+
+def bad_literal_keyword(x):
+    return jax.lax.all_gather(x, axis_name="tp", tiled=True)  # EXPECT[collective-axis]
+
+
+def bad_literal_via_from_import(x):
+    return lax.ppermute(x, "tp", _PERM)  # EXPECT[collective-axis]
+
+
+def bad_literal_direct_import(x):
+    return psum(x, "dp")  # EXPECT[collective-axis]
+
+
+def bad_literal_in_tuple(x):
+    return jax.lax.pmean(x, ("dp", "tp"))  # EXPECT[collective-axis]
+
+
+def bad_axis_index():
+    return jax.lax.axis_index("tp")  # EXPECT[collective-axis]
+
+
+def bad_reduce_scatter(x):
+    return jax.lax.psum_scatter(x, "tp", tiled=True)  # EXPECT[collective-axis]
+
+
+def good_constant_positional(x):
+    return jax.lax.psum(x, TP_AXIS)
+
+
+def good_constant_keyword(x):
+    return jax.lax.all_gather(x, axis_name=TP_AXIS, tiled=True)
+
+
+def good_constant_tuple(x):
+    return jax.lax.pmean(x, (DP_AXIS, TP_AXIS))
+
+
+def good_axis_index():
+    return jax.lax.axis_index(TP_AXIS)
+
+
+def good_variable_axis(x, axis):
+    return jax.lax.psum(x, axis)  # a parameter is a reference, not a literal
+
+
+def good_non_collective_literal(x):
+    # String literals elsewhere in lax calls are not axis names.
+    return jnp.asarray(jax.lax.convert_element_type(x, "float32"))
+
+
+def good_suppressed(x):
+    return jax.lax.psum(x, "tp")  # llmq: ignore[collective-axis]

@@ -6,6 +6,7 @@ preset picking — gets unit coverage beyond the CPU smoke runs.
 """
 
 import json
+import os
 import subprocess
 
 import pytest
@@ -103,47 +104,113 @@ class TestPickPreset:
         )
 
 
+class TestLastHardwareMetricLine:
+    """bench._last_hardware_metric_line: the CPU-fallback re-emit source.
+    Newest PERF_RESULTS/*.log wins; within a file the last valid metric
+    line (value > 0, no error) wins; watchdog/failure lines never
+    qualify."""
+
+    def _log(self, root, name, payloads, mtime):
+        path = root / "PERF_RESULTS" / name
+        path.parent.mkdir(exist_ok=True)
+        path.write_text(
+            "\n".join(
+                p if isinstance(p, str) else json.dumps(p) for p in payloads
+            )
+            + "\n"
+        )
+        os.utime(path, (mtime, mtime))
+
+    def test_no_results_dir(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert bench._last_hardware_metric_line() is None
+
+    def test_last_valid_line_of_newest_log_wins(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        old = {"metric": "m", "value": 4000.0, "vs_baseline": 0.8}
+        early = {"metric": "m", "value": 4500.0, "vs_baseline": 0.9}
+        final = {"metric": "m", "value": 4700.0, "vs_baseline": 0.94}
+        self._log(tmp_path, "bench_old.log", [old], mtime=1000)
+        self._log(
+            tmp_path, "bench_new.log",
+            ["bench: noise line", early, final], mtime=2000,
+        )
+        assert bench._last_hardware_metric_line() == final
+
+    def test_failure_lines_never_qualify(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        self._log(
+            tmp_path, "bench_bad.log",
+            [
+                {"metric": "m", "value": 0.0, "vs_baseline": 0.0,
+                 "error": "hung"},
+                {"metric": "m", "value": 0.0, "vs_baseline": 0.0},
+                "not json {",
+            ],
+            mtime=3000,
+        )
+        good = {"metric": "m", "value": 4800.0, "vs_baseline": 0.96}
+        self._log(tmp_path, "bench_good.log", [good], mtime=1000)
+        # The newest file holds only disqualified lines; the older
+        # hardware measurement is still the answer.
+        assert bench._last_hardware_metric_line() == good
+
+
 class TestTrimPlan:
     """bench.trim_plan: budget-aware phase trimming against the seconds
     left on LLMQ_BENCH_DEADLINE. The proven bf16 headline is reserved
-    first and never dropped; speculative phases drop quant-first, then
-    the spec-decode rung, then the extra ladder rungs, then the A/B."""
+    first and never dropped; speculative phases drop the tp-overlap rung
+    first, then quant, then the spec-decode rung, then the extra ladder
+    rungs, then the A/B."""
 
     KW = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
-              spec_s=360.0, proven_s=300.0)
+              spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0)
 
     def test_no_deadline_runs_everything(self):
         assert bench.trim_plan(None, **self.KW) == {
             "quant": True, "kernel_ab": True, "full_ladder": True,
-            "spec_ladder": True}
+            "spec_ladder": True, "tp_overlap": True}
 
     def test_roomy_budget_runs_everything(self):
+        # 300 (proven) + 1500 + 420 + 720 + 360 + 240 = 3540 fits.
         assert bench.trim_plan(3600.0, **self.KW) == {
             "quant": True, "kernel_ab": True, "full_ladder": True,
-            "spec_ladder": True}
+            "spec_ladder": True, "tp_overlap": True}
 
-    def test_quant_dropped_first(self):
+    def test_tp_overlap_dropped_first(self):
+        # Everything but the tp-overlap rung fits (budget 3000 after the
+        # floor), + 240 does not.
+        plan = bench.trim_plan(3300.0, **self.KW)
+        assert plan == {"quant": True, "kernel_ab": True,
+                        "full_ladder": True, "spec_ladder": True,
+                        "tp_overlap": False}
+
+    def test_quant_dropped_second(self):
         # 300 (proven) + 420 + 720 + 360 fits, + 1500 does not.
         plan = bench.trim_plan(2000.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": True, "spec_ladder": True}
+                        "full_ladder": True, "spec_ladder": True,
+                        "tp_overlap": False}
 
-    def test_spec_rung_dropped_second(self):
+    def test_spec_rung_dropped_third(self):
         # 300 + 420 + 720 fits, + 360 (spec rung) does not.
         plan = bench.trim_plan(1600.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": True, "spec_ladder": False}
+                        "full_ladder": True, "spec_ladder": False,
+                        "tp_overlap": False}
 
-    def test_ladder_dropped_third(self):
+    def test_ladder_dropped_fourth(self):
         # 300 + 420 fits, + 720 does not.
         plan = bench.trim_plan(800.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": False, "spec_ladder": False}
+                        "full_ladder": False, "spec_ladder": False,
+                        "tp_overlap": False}
 
     def test_everything_but_proven_dropped(self):
         plan = bench.trim_plan(350.0, **self.KW)
         assert plan == {"quant": False, "kernel_ab": False,
-                        "full_ladder": False, "spec_ladder": False}
+                        "full_ladder": False, "spec_ladder": False,
+                        "tp_overlap": False}
 
     def test_proven_floor_reserved_before_phases(self):
         # Exactly quant+ab+ladder+spec of budget but NO room for the
@@ -152,6 +219,7 @@ class TestTrimPlan:
         assert plan["quant"] is False
 
     def test_boundaries_inclusive(self):
+        assert bench.trim_plan(3540.0, **self.KW)["tp_overlap"] is True
         assert bench.trim_plan(3300.0, **self.KW)["quant"] is True
         assert bench.trim_plan(1800.0, **self.KW)["spec_ladder"] is True
         assert bench.trim_plan(1440.0, **self.KW)["full_ladder"] is True
@@ -162,6 +230,14 @@ class TestTrimPlan:
         # the extra ladder rungs — no budget keeps spec while dropping
         # the ladder.
         for remaining in (350.0, 720.0, 800.0, 1440.0, 1600.0, 1800.0,
-                          2000.0, 3000.0, 3300.0, 3600.0):
+                          2000.0, 3000.0, 3300.0, 3540.0, 3600.0):
             plan = bench.trim_plan(remaining, **self.KW)
             assert not (plan["spec_ladder"] and not plan["full_ladder"])
+
+    def test_tp_overlap_never_outlives_quant(self):
+        # Drop order invariant: the tp-overlap rung is the most
+        # speculative phase — no budget keeps it while dropping quant.
+        for remaining in (350.0, 720.0, 800.0, 1440.0, 1600.0, 1800.0,
+                          2000.0, 3000.0, 3300.0, 3540.0, 3600.0):
+            plan = bench.trim_plan(remaining, **self.KW)
+            assert not (plan["tp_overlap"] and not plan["quant"])

@@ -1,0 +1,263 @@
+"""Numerics for the tp-overlap collective matmuls (ops/collective_matmul).
+
+Every ring variant is checked against a ``jnp.einsum`` + ``lax.psum``
+shard_map reference — the exact computation GSPMD's row-parallel
+partitioning performs — on the suite's 8-virtual-device CPU mesh, for
+dense (f32 + bf16), int8-quantized, and MoE ragged shapes, over pure-tp
+and dp×tp meshes, in both the unidirectional and bidirectional splits.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import llmq_tpu.ops.collective_matmul as cm
+from llmq_tpu.models import quant as qm
+from llmq_tpu.parallel.mesh import TP_AXIS, make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _mesh_tp8():
+    return make_mesh(tensor_parallel=8)
+
+
+def _plan(mesh):
+    plan = cm.ring_plan(mesh)
+    assert plan is not None
+    return plan
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=jnp.float32).astype(dtype)
+
+
+def _gspmd_row_reference(x, w, mesh):
+    """What GSPMD emits for a row-parallel matmul: local einsum over the
+    K shard, then one all-reduce."""
+
+    def body(xc, wc):
+        return jax.lax.psum(jnp.einsum("mk,kn->mn", xc, wc), TP_AXIS)
+
+    fn = cm._shard_mapped(
+        body, mesh, in_specs=(P(None, TP_AXIS), P(TP_AXIS, None)),
+        out_specs=P(None, None),
+    )
+    return fn(x, w)
+
+
+class TestRingPlan:
+    def test_none_mesh(self):
+        assert cm.ring_plan(None) is None
+
+    def test_tp1_mesh(self):
+        assert cm.ring_plan(make_mesh(tensor_parallel=1)) is None
+
+    def test_tp8(self):
+        plan = cm.ring_plan(_mesh_tp8())
+        assert (plan.tp, plan.dp) == (8, 1)
+
+    def test_dp_tp(self):
+        plan = cm.ring_plan(make_mesh(tensor_parallel=4, data_parallel=2))
+        assert (plan.tp, plan.dp) == (4, 2)
+
+    def test_splits(self):
+        assert cm._splits(32, 8) == (16, True)  # bidirectional
+        assert cm._splits(24, 8) == (8, False)  # unidirectional
+
+
+class TestRowParallelDense:
+    def test_bidirectional_f32(self):
+        # N=32 splits 2*tp=16 ways -> both counter-rotating rings engage.
+        plan = _plan(_mesh_tp8())
+        x = _rand(0, (6, 64))
+        w = _rand(1, (64, 32))
+        got = cm.row_parallel_matmul(x, w, plan)
+        ref = _gspmd_row_reference(x, w, plan.mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_unidirectional_f32(self):
+        # N=24 divides tp=8 but not 16 -> single forward ring.
+        plan = _plan(_mesh_tp8())
+        x = _rand(2, (4, 16))
+        w = _rand(3, (16, 24))
+        got = cm.row_parallel_matmul(x, w, plan)
+        ref = _gspmd_row_reference(x, w, plan.mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_bf16(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(4, (8, 128), jnp.bfloat16)
+        w = _rand(5, (128, 128), jnp.bfloat16)
+        got = cm.row_parallel_matmul(x, w, plan)
+        ref = _gspmd_row_reference(x, w, plan.mesh)
+        assert got.dtype == jnp.bfloat16
+        # The ring reduces partials in a different order than the
+        # all-reduce; for bf16 (~8 mantissa bits) sums of magnitude ~30
+        # one ulp is ~0.25, so bound by that rather than a tight atol.
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+            rtol=5e-2, atol=0.5,
+        )
+
+    def test_3d_activation(self):
+        # [B, T, K] flattens to [B*T, K] and reshapes back.
+        plan = _plan(_mesh_tp8())
+        x = _rand(6, (2, 3, 32))
+        w = _rand(7, (32, 32))
+        got = cm.row_parallel_matmul(x, w, plan)
+        assert got.shape == (2, 3, 32)
+        ref = jnp.einsum("btk,kn->btn", x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_dp_sharded_lead(self):
+        # dp=2 x tp=4: M=8 divides dp, each dp row runs its own ring.
+        plan = _plan(make_mesh(tensor_parallel=4, data_parallel=2))
+        x = _rand(8, (8, 32))
+        w = _rand(9, (32, 64))
+        got = cm.row_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+    def test_dp_indivisible_lead_replicates(self):
+        # M=3 does not divide dp=2 -> replicated lead axis, still correct.
+        plan = _plan(make_mesh(tensor_parallel=4, data_parallel=2))
+        x = _rand(10, (3, 32))
+        w = _rand(11, (32, 64))
+        got = cm.row_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+
+class TestRowParallelInt8:
+    def test_int8_matches_gspmd_dequant(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(12, (6, 64))
+        w = qm.quantize_array(_rand(13, (64, 32)), axis=0)
+        got = cm.row_parallel_matmul(x, w, plan)
+        ref = _gspmd_row_reference(x, qm.dequantize(w, x.dtype), plan.mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_int8_unidirectional(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(14, (4, 16))
+        w = qm.quantize_array(_rand(15, (16, 24)), axis=0)
+        got = cm.row_parallel_matmul(x, w, plan)
+        ref = qm.matmul(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_int8_pallas_chunks(self, monkeypatch):
+        # The ring's chunk matmuls stay Pallas-eligible under tp>1 —
+        # the restriction the GSPMD path must impose.  interpret mode
+        # exercises the kernel on CPU.
+        monkeypatch.setenv("LLMQ_INT8_MATMUL", "pallas")
+        plan = _plan(_mesh_tp8())
+        x = _rand(16, (8, 128), jnp.bfloat16)
+        w = qm.quantize_array(_rand(17, (128, 128)), axis=0)
+        got = cm.row_parallel_matmul(x, w, plan)
+        monkeypatch.setenv("LLMQ_INT8_MATMUL", "")
+        ref = cm.row_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref, dtype=np.float32),
+            rtol=5e-2, atol=0.5,
+        )
+
+
+class TestRowParallelRagged:
+    def _case(self, key, E, M, Im, H, quantized):
+        x = _rand(key, (M, Im))
+        w_full = _rand(key + 1, (E, Im, H))
+        gs = jnp.array([M // E] * E, dtype=jnp.int32)
+        w = qm.quantize_array(w_full, axis=1) if quantized else w_full
+        ref = jax.lax.ragged_dot(x, qm.dequantize(w, x.dtype) if quantized else w_full, gs)
+        return x, w, gs, ref
+
+    def test_dense(self):
+        plan = _plan(_mesh_tp8())
+        x, w, gs, ref = self._case(20, 4, 16, 32, 32, quantized=False)
+        got = cm.row_parallel_ragged_matmul(x, w, gs, x.dtype, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_int8(self):
+        plan = _plan(_mesh_tp8())
+        x, w, gs, ref = self._case(24, 4, 16, 32, 32, quantized=True)
+        got = cm.row_parallel_ragged_matmul(x, w, gs, x.dtype, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_uneven_groups(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(28, (10, 16))
+        w = _rand(29, (3, 16, 24))
+        gs = jnp.array([1, 6, 3], dtype=jnp.int32)
+        got = cm.row_parallel_ragged_matmul(x, w, gs, x.dtype, plan)
+        ref = jax.lax.ragged_dot(x, w, gs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_indivisible_falls_back(self):
+        # Im=30 does not divide tp=8 -> literal ragged_dot fallback.
+        plan = _plan(_mesh_tp8())
+        x = _rand(32, (8, 30))
+        w = _rand(33, (2, 30, 24))
+        gs = jnp.array([5, 3], dtype=jnp.int32)
+        got = cm.row_parallel_ragged_matmul(x, w, gs, x.dtype, plan)
+        ref = jax.lax.ragged_dot(x, w, gs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestColumnParallel:
+    def test_dense(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(40, (6, 32))
+        w = _rand(41, (32, 64))
+        got = cm.column_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+    def test_int8(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(42, (6, 32))
+        w = qm.quantize_array(_rand(43, (32, 64)), axis=0)
+        got = cm.column_parallel_matmul(x, w, plan)
+        ref = qm.matmul(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+    def test_3d_activation(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(44, (2, 3, 32))
+        w = _rand(45, (32, 64))
+        got = cm.column_parallel_matmul(x, w, plan)
+        assert got.shape == (2, 3, 64)
+        ref = jnp.einsum("btk,kn->btn", x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestFallbacks:
+    def test_plan_none_is_literal_matmul(self):
+        x = _rand(50, (4, 16))
+        w = _rand(51, (16, 24))
+        got = cm.row_parallel_matmul(x, w, None)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(qm.matmul(x, w)))
+
+    def test_indivisible_n(self):
+        # N=30 divides neither 8 nor 16 -> fallback, still correct.
+        plan = _plan(_mesh_tp8())
+        x = _rand(52, (4, 16))
+        w = _rand(53, (16, 30))
+        got = cm.row_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+    def test_indivisible_k(self):
+        plan = _plan(_mesh_tp8())
+        x = _rand(54, (4, 20))
+        w = _rand(55, (20, 32))
+        got = cm.row_parallel_matmul(x, w, plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+    def test_stacked_weight_falls_back(self):
+        # Per-layer stacked [L, K, N] weights never hit the ring.
+        plan = _plan(_mesh_tp8())
+        x = _rand(56, (4, 16))
+        w = _rand(57, (2, 16, 24))
+        got = cm.row_parallel_matmul(x, w[0], plan)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w[0]), rtol=1e-5, atol=1e-5)

@@ -209,6 +209,54 @@ def test_soak_spec_decode_matches_non_spec_golden(seed):
             assert outs[rid].finish_reason == golden[rid].finish_reason, rid
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_tp_overlap_ring_matches_gspmd_golden(seed):
+    """The chunked collective-matmul rings (ops/collective_matmul.py)
+    compose losslessly with the full feature stack: a tp=8 tight-pool
+    engine with tp_overlap=on, chunked prefill, prefix caching,
+    preemption, fused decode blocks (decode_block=2) AND speculative
+    verification (spec_tokens=2) must emit greedy outputs
+    token-identical to a roomy tp=8 GSPMD engine (tp_overlap off — the
+    exact programs the rings replace). Sampled rows are budget-checked
+    only, as in the spec soak."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (conftest forces 8 host devices)")
+    rng = np.random.default_rng(seed)
+    reqs = _requests(rng, 28)
+
+    def tp8_core(num_pages, tp_overlap, **over):
+        eng = dict(
+            max_num_seqs=6, max_model_len=64, page_size=8,
+            num_pages=num_pages, kv_dtype=jnp.float32,
+            min_prefill_bucket=16, max_prefill_batch=2,
+            tp_overlap=tp_overlap,
+        )
+        eng.update(over)
+        return EngineCore(
+            CFG, PARAMS, ByteTokenizer(), mesh=make_mesh(tensor_parallel=8),
+            engine_config=EngineConfig(**eng),
+        )
+
+    tight = tp8_core(
+        20, "on", prefill_chunk_size=8, enable_prefix_caching=True,
+        decode_block=2, spec_tokens=2,
+    )
+    assert tight.tp_overlap == "on"
+    outs = _drive(tight, reqs, np.random.default_rng(seed + 100))
+    tight.scheduler.check_invariants()
+    st = tight.stats()
+    assert st["tp_overlap"] == "on"
+    assert st["spec_proposed"] > 0
+    roomy = tp8_core(120, "off")
+    assert roomy.tp_overlap == "off"
+    golden = _drive(roomy, reqs, np.random.default_rng(seed + 100))
+    for rid, _, p in reqs:
+        assert outs[rid].completion_tokens <= p.max_tokens
+        if p.temperature == 0.0:
+            assert outs[rid].token_ids == golden[rid].token_ids, rid
+            assert outs[rid].finish_reason == golden[rid].finish_reason, rid
+
+
 def test_spec_verify_rejection_sampling_distribution():
     """The verify sampler's marginal at each position must be EXACTLY
     the request's sampling distribution regardless of what was drafted

@@ -34,6 +34,7 @@ CASES = [
     ("blocking_cases.py", {"blocking-async", "blocking-async-io"}),
     ("cancellation_cases.py", {"cancelled-swallow"}),
     ("jax_cases.py", {"jax-host-sync", "jax-donate"}),
+    ("collective_axis_cases.py", {"collective-axis"}),
 ]
 
 

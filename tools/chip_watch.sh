@@ -30,6 +30,10 @@ run() {  # run <timeout-s> <name> <cmd...>
 #    counts — decides the production default.
 run 900 ab_s224 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 224 128
 run 600 ab_s192 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 192 128
+# 1b. ICI collectives + tp-overlap ring A/B: only meaningful on a
+#     multi-chip slice (exits with a note on one chip), cheap enough to
+#     keep early in case the window closes.
+run 300 collectives python tools/profile_collectives.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

@@ -34,6 +34,9 @@ grep -q tpu "$OUT/probe.log" || { echo "chip unreachable; aborting"; exit 1; }
 
 run 900 kernel_v123   python tools/profile_kernel_v2.py
 run 300 int8_fusion   python tools/profile_int8_matmul.py
+# ICI microbench: decides whether the tp-overlap ring matmuls pay on
+# this slice (single-chip sessions exit immediately with a note).
+run 300 collectives   python tools/profile_collectives.py
 # NB: `VAR=x run ...` would leak past the function call in bash — use
 # `env` so each override dies with its step.
 run 1800 bench_bf16   python bench.py
