@@ -257,9 +257,12 @@ def worker() -> None:
 @click.option("--max-num-seqs", type=int, default=None, help="Engine batch slots")
 @click.option("--max-model-len", type=int, default=None, help="Context window cap")
 @click.option("--dtype", default="bfloat16", show_default=True,
-              type=click.Choice(["bfloat16", "float32", "int8"]),
+              type=click.Choice(["bfloat16", "float32", "int8", "int4"]),
               help="int8 = weight-only quantization (bf16 compute); "
-                   "halves HBM footprint and weight bandwidth")
+                   "halves HBM footprint and weight bandwidth. "
+                   "int4 = AWQ-style group-quantized layer weights "
+                   "(per-group scale+zero, bf16 compute); quarters the "
+                   "layer-weight footprint (embed/lm_head stay int8)")
 @click.option("--kv-dtype", default=None,
               type=click.Choice(["auto", "bf16", "fp8", "fp8_e5m2"]),
               help="KV cache storage dtype: fp8 (float8_e5m2) halves KV "
@@ -291,10 +294,17 @@ def worker() -> None:
                    "rings that hide ICI hops behind matmul chunks; 'auto' "
                    "A/Bs ring-vs-GSPMD on this host's chips. Default: "
                    "LLMQ_TP_OVERLAP or off")
+@click.option("--mixed-step", default=None,
+              type=click.Choice(["off", "on"]),
+              help="Piggyback scheduling: fuse one pending request's "
+                   "prefill chunk into each decode dispatch (the "
+                   "bandwidth-bound decode step's idle MXU does the "
+                   "prefill; greedy outputs unchanged). Requires "
+                   "--prefill-chunk. Default: LLMQ_MIXED_STEP or off")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
                dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block,
-               spec_tokens, tp_overlap):
+               spec_tokens, tp_overlap, mixed_step):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -313,6 +323,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         decode_block=decode_block,
         spec_tokens=spec_tokens,
         tp_overlap=tp_overlap,
+        mixed_step=mixed_step,
     )
 
 

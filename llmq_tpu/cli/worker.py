@@ -29,6 +29,7 @@ def run_tpu_worker(
     decode_block: Optional[int] = None,
     spec_tokens: Optional[int] = None,
     tp_overlap: Optional[str] = None,
+    mixed_step: Optional[str] = None,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
@@ -54,6 +55,7 @@ def run_tpu_worker(
         decode_block=decode_block,
         spec_tokens=spec_tokens,
         tp_overlap=tp_overlap,
+        mixed_step=mixed_step,
     )
     _run(worker)
 

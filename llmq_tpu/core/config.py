@@ -177,6 +177,14 @@ class Config(BaseModel):
         "deployment hardware, 'off' keeps the literal GSPMD programs.",
     )
 
+    mixed_step: str = Field(
+        default_factory=lambda: (_env("LLMQ_MIXED_STEP") or "off").lower(),
+        description="Piggyback scheduling: 'on' fuses one pending "
+        "request's prefill chunk into each decode dispatch (shared "
+        "paged-KV writes, one executable) instead of alternating whole "
+        "dispatches. Requires prefill_chunk_size.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

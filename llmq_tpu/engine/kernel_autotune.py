@@ -301,6 +301,96 @@ def run_tp_overlap_ab(
         return "off", False
 
 
+def run_int4_matmul_ab(
+    *,
+    hidden_size: int,
+    intermediate_size: int,
+    max_seqs: int = 192,
+    group_size: int = 128,
+) -> tuple:
+    """In-process Pallas-vs-XLA A/B for the int4 group-quantized matmul
+    (the child body).
+
+    Times a decode-shaped MLP projection — [S, H] x [H, I] with
+    per-group scale+zero int4 weights — as the XLA dequantize-then-
+    matmul and as the dequant-in-VMEM kernel
+    (``ops/pallas_matmul.int4_matmul_pallas``). Decode is weight-stream
+    bound, so whichever streams the packed bytes faster wins. Returns
+    ``("xla", False)`` off-TPU (interpret-mode timings are meaningless)
+    or on any failure — never raises; ``measured`` is True only for a
+    real timing.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.devices()[0].platform != "tpu":
+            return "xla", False
+        from llmq_tpu.models import quant as qm
+        from llmq_tpu.ops.pallas_matmul import int4_matmul_pallas
+
+        H, I, S = hidden_size, intermediate_size, max_seqs
+        w = jax.random.normal(jax.random.key(0), (H, I), jnp.float32)
+        qt = qm.quantize_array_int4(w, group_size=group_size)
+        x = jax.random.normal(jax.random.key(1), (S, H), jnp.bfloat16)
+
+        xla_f = jax.jit(
+            lambda: x
+            @ qm.dequantize_int4_parts(
+                qt["q"], qt["scale"], qt["zero"], jnp.bfloat16
+            )
+        )
+        pallas_f = jax.jit(
+            lambda: int4_matmul_pallas(x, qt["q"], qt["scale"], qt["zero"])
+        )
+
+        def timeit(f, n=10):
+            jax.block_until_ready(f())
+            t0 = time.monotonic()
+            for _ in range(n):
+                out = f()
+            jax.block_until_ready(out)
+            return (time.monotonic() - t0) / n
+
+        times = {"xla": timeit(xla_f), "pallas": timeit(pallas_f)}
+        diff = float(
+            jnp.max(
+                jnp.abs(
+                    pallas_f().astype(jnp.float32)
+                    - xla_f().astype(jnp.float32)
+                )
+            )
+        )
+        # Same contract as the tp-overlap A/B: a real margin (5%) AND
+        # numerical agreement (different accumulation order — the
+        # kernel compensates in f32, XLA reduces in bf16 — so the bound
+        # guards against a broken kernel, not ulps).
+        choice = (
+            "pallas"
+            if times["pallas"] < 0.95 * times["xla"] and diff < 0.5
+            else "xla"
+        )
+        shown = " ".join(f"{k}={v*1e6:.1f}us" for k, v in times.items())
+        print(
+            f"kernel-autotune: int4-matmul A/B {shown} "
+            f"(HxI {H}x{I}, S={S}, |diff|={diff:.2e}) -> {choice}",
+            file=sys.stderr,
+        )
+        return choice, True
+    except Exception as exc:  # noqa: BLE001 — never endanger the caller
+        print(
+            f"kernel-autotune: int4-matmul A/B failed ({exc!r}); using xla",
+            file=sys.stderr,
+        )
+        return "xla", False
+
+
+def _int4_matmul_cache_key(
+    hidden: int, inter: int, seqs: int, group: int, identity: str
+) -> str:
+    return f"int4mm:h{hidden}:i{inter}:s{seqs}:g{group}:{identity}"
+
+
 def autotune_tp_overlap(
     *,
     hidden_size: int,
@@ -540,6 +630,36 @@ def _main() -> None:
                     hidden, inter, seqs, tp, dtype, identity
                 ),
                 valid=("on", "off"),
+            )
+        )
+        return
+
+    if len(sys.argv) > 1 and sys.argv[1] == "int4-matmul":
+        # int4-matmul mode: argv = ["int4-matmul", hidden, inter, seqs,
+        # group?]. Must print a mode and exit 0 even on CPU (the
+        # preflight suite executes every scripted leg in tiny mode).
+        hidden, inter, seqs = (int(a) for a in sys.argv[2:5])
+        group = int(sys.argv[5]) if len(sys.argv) > 5 else 128
+        dev = jax.devices()[0]
+        identity = f"{dev.device_kind or dev.platform}/jax{jax.__version__}"
+
+        def measure_int4():
+            return run_int4_matmul_ab(
+                hidden_size=hidden,
+                intermediate_size=inter,
+                max_seqs=seqs,
+                group_size=group,
+            )
+
+        print(
+            resolve_choice(
+                (),
+                identity,
+                measure_int4,
+                key=_int4_matmul_cache_key(
+                    hidden, inter, seqs, group, identity
+                ),
+                valid=("pallas", "xla"),
             )
         )
         return

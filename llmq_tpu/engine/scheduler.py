@@ -33,6 +33,27 @@ class OutOfPages(Exception):
     """No free KV pages; caller should preempt or defer."""
 
 
+def mixed_token_budget(
+    chunk_size: int, decode_rows: int, remaining: int, *, min_tokens: int = 1
+) -> int:
+    """Prefill positions one piggybacked chunk segment may claim in a
+    mixed (decode + prefill) dispatch iteration.
+
+    The per-iteration token budget is ``chunk_size`` (the fused
+    executable's fixed chunk width): each decodable row consumes one
+    budget token for its own decode position, and the head-of-line
+    prefill gets the remainder. A busy batch therefore trickles the
+    prompt in small segments (the decode rows' latency is protected),
+    while an idle batch prefills at full chunk width. ``min_tokens``
+    floors the segment so prefill always makes progress even when
+    decode_rows >= chunk_size; the segment can never exceed the chunk
+    row's physical width (``chunk_size``) or the prompt's ``remaining``
+    positions. Returns 0 when nothing remains."""
+    if remaining <= 0:
+        return 0
+    return min(remaining, max(chunk_size - decode_rows, min_tokens), chunk_size)
+
+
 class PageAllocator:
     """Refcounted free-list allocator over the physical KV page pool.
 

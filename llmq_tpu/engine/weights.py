@@ -223,7 +223,7 @@ def load_checkpoint(
     *,
     dtype=jnp.bfloat16,
     mesh: Optional[Mesh] = None,
-    quantize: bool = False,
+    quantize: bool | str = False,
 ) -> Params:
     """Load an HF checkpoint directory into the stacked param layout.
 
@@ -237,8 +237,13 @@ def load_checkpoint(
     symmetric per-channel int8 *while streaming* — blocks are quantized
     on the host and land on device already int8, so the full-precision
     copy never exists in HBM (the point: a ~9B bf16 model that can't fit
-    a 16 GB chip loads at ~half the bytes). ``dtype`` remains the
-    compute/scale dtype. See ``models/quant.py``.
+    a 16 GB chip loads at ~half the bytes). ``quantize="int4"``
+    (``--dtype int4``) puts the LAYER matmul weights on the AWQ-style
+    group rung instead — packed two-codes-per-byte with per-group
+    scale/zero tensors, a QUARTER of the bf16 bytes — while the
+    embedding table and LM head stay int8 (the logit end is the
+    precision-sensitive one). ``dtype`` remains the compute/scale
+    dtype. See ``models/quant.py``.
     """
     from llmq_tpu.models import quant as qm
 
@@ -248,6 +253,10 @@ def load_checkpoint(
     reader = _TensorReader(model_path)
     L = config.num_layers
     np_dtype = _np_dtype(dtype)
+    quant_mode = (
+        "int4" if str(quantize).lower() == "int4"
+        else ("int8" if quantize else None)
+    )
 
     specs = None
     if mesh is not None:
@@ -280,22 +289,86 @@ def load_checkpoint(
         ).astype(np.int8)
         return q, scale
 
+    def _np_quant_int4(arr: np.ndarray):
+        """Host-side int4 group quantization of one [.., K, N] block —
+        the numpy mirror of ``quant.quantize_array_int4`` (np.rint and
+        jnp.round both round half to even, so device and streamed loads
+        produce identical codes)."""
+        a32 = np.asarray(arr, np.float32)
+        k = a32.shape[-2]
+        if k % 2:
+            raise ValueError(f"int4 needs an even contraction dim, got {k}")
+        group = qm.int4_group(k)
+        g = k // group
+        ag = a32.reshape(*a32.shape[:-2], g, group, a32.shape[-1])
+        amin = ag.min(axis=-2)
+        amax = ag.max(axis=-2)
+        scale = np.where(amax > amin, (amax - amin) / 15.0, 1.0).astype(
+            np.float32
+        )
+        zero = np.rint(-amin / scale).astype(np.float32)
+        q = np.clip(
+            np.rint(ag / scale[..., None, :] + zero[..., None, :]), 0, 15
+        ).astype(np.uint8)
+        q = q.reshape(a32.shape)
+        packed = q[..., 0::2, :] | (q[..., 1::2, :] << 4)
+        return packed, scale, zero
+
+    def _finish_quant_int4(buf, scales: np.ndarray, zeros: np.ndarray, name: str):
+        """Pair a packed-uint8 device buffer with its group scale/zero
+        tensors. q keeps the weight's own spec (the packed axis IS the
+        contraction axis); scale/zero replicate their group axis — same
+        layout ``quant.quantized_specs`` produces."""
+        weight_spec = streamer._sharding(name + ".q")
+        s_host = scales.astype(np_dtype)
+        z_host = zeros.astype(np_dtype)
+        if weight_spec is None:
+            return {
+                "q": buf,
+                "scale": jax.device_put(s_host),
+                "zero": jax.device_put(z_host),
+            }
+        parts = list(weight_spec.spec) + [None] * (
+            buf.ndim - len(weight_spec.spec)
+        )
+        sz = NamedSharding(mesh, P(*(parts[:-2] + [None] + parts[-1:])))
+        return {
+            "q": buf,
+            "scale": jax.device_put(s_host, sz),
+            "zero": jax.device_put(z_host, sz),
+        }
+
     def stacked(our_name: str, fmt: str, *, transpose: bool = False):
         """Stream layer tensors into a [L, ...] device stack."""
         shape0 = reader.shape(fmt.format(i=0))
         if transpose:
             shape0 = shape0[::-1]
-        full = (L, *shape0)
-        quant = quantize and our_name in qm.QUANTIZED_LAYER_KEYS
-
-        scales = np.ones((L, *shape0[:-2], shape0[-1]), np.float32) if quant else None
+        quant = bool(quant_mode) and our_name in qm.QUANTIZED_LAYER_KEYS
+        int4 = quant and quant_mode == "int4"
+        if int4:
+            full = (L, *shape0[:-2], shape0[-2] // 2, shape0[-1])
+            g = shape0[-2] // qm.int4_group(shape0[-2])
+            scales = np.ones((L, *shape0[:-2], g, shape0[-1]), np.float32)
+            zeros = np.zeros_like(scales)
+        else:
+            full = (L, *shape0)
+            scales = (
+                np.ones((L, *shape0[:-2], shape0[-1]), np.float32)
+                if quant
+                else None
+            )
+            zeros = None
 
         def blocks():
             for i in range(L):
                 arr = reader.get(fmt.format(i=i))
                 if transpose:
                     arr = arr.T
-                if quant:
+                if int4:
+                    arr, s, z = _np_quant_int4(arr)
+                    scales[i] = s
+                    zeros[i] = z
+                elif quant:
                     arr, s = _np_quant(arr, axis=-2)
                     scales[i] = s
                 yield i, arr[None]
@@ -303,11 +376,13 @@ def load_checkpoint(
         buf = streamer.stream(
             f"layers.{our_name}" + (".q" if quant else ""),
             full,
-            jnp.int8 if quant else dtype,
+            (jnp.uint8 if int4 else jnp.int8) if quant else dtype,
             blocks(),
         )
         if not quant:
             return buf
+        if int4:
+            return _finish_quant_int4(buf, scales, zeros, f"layers.{our_name}")
         return _finish_quant(buf, scales, f"layers.{our_name}", row_wise=False)
 
     def big2d(our_name: str, hf_name: str, *, transpose: bool = False):
@@ -326,7 +401,9 @@ def load_checkpoint(
         chunk = max(1, _CHUNK_BYTES // max(1, cols * itemsize))
         shape = (cols, rows) if transpose else (rows, cols)
         axis = 1 if transpose else 0
-        quant = quantize and our_name in qm.QUANTIZED_TOP_KEYS
+        # Top-level tensors stay on the int8 rung under either quantize
+        # mode — see the load_checkpoint docstring.
+        quant = bool(quant_mode) and our_name in qm.QUANTIZED_TOP_KEYS
         # embed quantizes per ROW (lookup axis); lm_head (streamed
         # transposed, [H, V]) per output column — both reduce over the
         # stored tensor's column axis, so the block math is identical.
@@ -387,15 +464,29 @@ def load_checkpoint(
             """Stream a [L, E, in, out] expert stack one (layer, expert)
             tensor at a time — host RSS stays ~1 expert tensor."""
             shape0 = reader.shape(fmt.format(i=0, e=0))[::-1]  # transposed
-            full = (L, E, *shape0)
-            quant = quantize and our_name in qm.QUANTIZED_LAYER_KEYS
-            scales = np.ones((L, E, shape0[-1]), np.float32) if quant else None
+            quant = bool(quant_mode) and our_name in qm.QUANTIZED_LAYER_KEYS
+            int4 = quant and quant_mode == "int4"
+            if int4:
+                full = (L, E, shape0[-2] // 2, shape0[-1])
+                g = shape0[-2] // qm.int4_group(shape0[-2])
+                scales = np.ones((L, E, g, shape0[-1]), np.float32)
+                zeros = np.zeros_like(scales)
+            else:
+                full = (L, E, *shape0)
+                scales = (
+                    np.ones((L, E, shape0[-1]), np.float32) if quant else None
+                )
+                zeros = None
 
             def blocks():
                 for i in range(L):
                     for e in range(E):
                         arr = reader.get(fmt.format(i=i, e=e)).T
-                        if quant:
+                        if int4:
+                            arr, s, z = _np_quant_int4(arr)
+                            scales[i, e] = s
+                            zeros[i, e] = z
+                        elif quant:
                             arr, s = _np_quant(arr, axis=-2)
                             scales[i, e] = s
                         yield (i, e), arr[None, None]
@@ -403,11 +494,15 @@ def load_checkpoint(
             buf = streamer.stream(
                 f"layers.{our_name}" + (".q" if quant else ""),
                 full,
-                jnp.int8 if quant else dtype,
+                (jnp.uint8 if int4 else jnp.int8) if quant else dtype,
                 blocks(),
             )
             if not quant:
                 return buf
+            if int4:
+                return _finish_quant_int4(
+                    buf, scales, zeros, f"layers.{our_name}"
+                )
             return _finish_quant(
                 buf, scales, f"layers.{our_name}", row_wise=False
             )

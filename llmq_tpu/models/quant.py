@@ -25,10 +25,33 @@ converted from int8 on the fly (XLA fuses the convert into the dot
 operand read — the HBM side stays int8).
 
 Embeddings quantize per ROW (the lookup axis): ``q[ids] * scale[ids]``.
+
+int4 (``--dtype int4``) extends the ladder one rung below int8 with
+AWQ-style asymmetric group quantization:
+
+    {"q": uint8[..., in/2, out], "scale": f[..., groups, out],
+     "zero": f[..., groups, out]}
+
+Two 4-bit codes pack per byte along the CONTRACTION axis (even row in
+the low nibble, odd row in the high nibble), so the packed axis maps
+1:1 onto the weight's contraction axis for sharding and the ring
+chunks of ``ops/collective_matmul.py`` — which slice the OUTPUT axis —
+never see the packing at all. Per-group affine dequant is
+
+    w ≈ (unpack(q) - zero) * scale,   q ∈ [0, 15], zero an integer float
+
+with ``group`` input rows per (scale, zero) pair. The zero-point does
+NOT commute with the contraction (unlike int8's symmetric per-column
+scale), so every consumer dequantizes before the dot: the Pallas
+kernel (``LLMQ_INT4_MATMUL=pallas``) dequantizes per block in VMEM,
+the XLA fallback materializes one layer slice, and ring chunks
+dequantize per chunk. ``dequantize_int4_parts`` is the single
+definition of that affine math — kernels and references share it.
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Any, Dict
 
@@ -62,6 +85,89 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "scale" in w
 
 
+def is_int4(w: Any) -> bool:
+    """True for the packed int4 group-quantized dict (int8 has no zero-point)."""
+    return is_quantized(w) and "zero" in w
+
+
+# Default AWQ-style group size (input rows per scale/zero pair).
+INT4_GROUP_SIZE = 128
+
+
+def int4_group(k: int, group_size: int = INT4_GROUP_SIZE) -> int:
+    """Largest usable group size: ``group_size`` when it divides the
+    contraction dim, else the gcd (tiny test models have K < 128)."""
+    return group_size if k % group_size == 0 else math.gcd(k, group_size)
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack 4-bit codes ``[..., K, N] -> uint8[..., K//2, N]`` along the
+    contraction axis: even rows in the low nibble, odd rows high."""
+    lo = q[..., 0::2, :].astype(jnp.uint8)
+    hi = q[..., 1::2, :].astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_int4(qp: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: ``uint8[..., K//2, N] -> int32[..., K, N]``."""
+    lo = (qp & 0xF).astype(jnp.int32)
+    hi = (qp >> 4).astype(jnp.int32)
+    stacked = jnp.stack([lo, hi], axis=-2)  # [..., K//2, 2, N]
+    return stacked.reshape(*qp.shape[:-2], qp.shape[-2] * 2, qp.shape[-1])
+
+
+def dequantize_int4_parts(
+    qp: jnp.ndarray, scale: jnp.ndarray, zero: jnp.ndarray, dtype
+) -> jnp.ndarray:
+    """The one affine-dequant definition: unpack, subtract the per-group
+    zero-point, scale — all in f32 — then cast. Kernels, ring chunks,
+    the XLA fallback, and test references all call (or mirror) this so
+    numerics agree across backends."""
+    q = unpack_int4(qp).astype(jnp.float32)
+    k = q.shape[-2]
+    groups = scale.shape[-2]
+    group = k // groups
+    qg = q.reshape(*q.shape[:-2], groups, group, q.shape[-1])
+    deq = (qg - zero.astype(jnp.float32)[..., :, None, :]) * scale.astype(
+        jnp.float32
+    )[..., :, None, :]
+    return deq.reshape(*q.shape).astype(dtype)
+
+
+def quantize_array_int4(
+    w: jnp.ndarray,
+    *,
+    group_size: int = INT4_GROUP_SIZE,
+    scale_dtype=jnp.float32,
+) -> Params:
+    """Asymmetric int4 group quantization over the contraction
+    (second-to-last) axis. Weights only — embeddings and the LM head
+    stay on the int8 rung (logit parity is precision-sensitive and
+    their bytes are amortized over the whole batch)."""
+    k = w.shape[-2]
+    if k % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, got {k}")
+    group = int4_group(k, group_size)
+    groups = k // group
+    w32 = w.astype(jnp.float32)
+    wg = w32.reshape(*w32.shape[:-2], groups, group, w32.shape[-1])
+    wmin = wg.min(axis=-2)
+    wmax = wg.max(axis=-2)
+    scale = (wmax - wmin) / 15.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    # Zero-points are stored as floats (rounded to integers for AWQ
+    # fidelity) rather than packed 4-bit, so they need no [0, 15] clip —
+    # an all-positive group legitimately wants a negative zero-point.
+    zero = jnp.round(-wmin / scale)
+    q = jnp.round(wg / scale[..., :, None, :] + zero[..., :, None, :])
+    q = jnp.clip(q, 0, 15).astype(jnp.uint8).reshape(*w32.shape)
+    return {
+        "q": pack_int4(q),
+        "scale": scale.astype(scale_dtype),
+        "zero": zero.astype(scale_dtype),
+    }
+
+
 def quantize_array(
     w: jnp.ndarray, *, axis: int, scale_dtype=jnp.float32
 ) -> Params:
@@ -82,6 +188,16 @@ def quantize_array_donated(w, *, axis: int, scale_dtype=jnp.float32) -> Params:
     """``quantize_array`` freeing the input buffer on dispatch — for
     init/load flows where the full-precision tree would not fit HBM."""
     return quantize_array(w, axis=axis, scale_dtype=scale_dtype)
+
+
+@partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("group_size", "scale_dtype")
+)
+def quantize_array_int4_donated(
+    w, *, group_size: int = INT4_GROUP_SIZE, scale_dtype=jnp.float32
+) -> Params:
+    """``quantize_array_int4`` freeing the input buffer on dispatch."""
+    return quantize_array_int4(w, group_size=group_size, scale_dtype=scale_dtype)
 
 
 # Set by disable_pallas_matmul(); checked at trace time alongside the
@@ -112,8 +228,38 @@ def _pallas_int8_enabled() -> bool:
     return os.environ.get("LLMQ_INT8_MATMUL", "").lower() == "pallas"
 
 
+def _pallas_int4_enabled() -> bool:
+    """``LLMQ_INT4_MATMUL=pallas``: route int4 matmuls through the
+    group-dequantize-in-VMEM Pallas kernel. Shares the process-wide
+    :func:`disable_pallas_matmul` kill switch with int8 — on tp>1
+    meshes the opaque ``pallas_call`` would break GSPMD partitioning,
+    but the ring chunks of ``ops/collective_matmul.py`` still use the
+    kernel locally (they check the env directly, like int8)."""
+    import os
+
+    if _PALLAS_DISABLED_REASON is not None:
+        return False
+    return os.environ.get("LLMQ_INT4_MATMUL", "").lower() == "pallas"
+
+
 def matmul(x: jnp.ndarray, w: Any) -> jnp.ndarray:
-    """``x @ w`` for a plain array or an int8-quantized weight."""
+    """``x @ w`` for a plain array or an int8/int4-quantized weight."""
+    if is_int4(w):
+        if _pallas_int4_enabled() and w["q"].ndim == 2:
+            from llmq_tpu.ops.pallas_matmul import int4_matmul_pallas
+
+            lead = x.shape[:-1]
+            out = int4_matmul_pallas(
+                x.reshape(-1, x.shape[-1]),
+                w["q"],
+                w["scale"],
+                w["zero"],
+                interpret=jax.default_backend() != "tpu",
+            )
+            return out.reshape(*lead, out.shape[-1])
+        # XLA fallback: affine zero-points do not commute with the dot,
+        # so dequantize the (single layer slice of) weight first.
+        return x @ dequantize_int4_parts(w["q"], w["scale"], w["zero"], x.dtype)
     if is_quantized(w):
         if _pallas_int8_enabled() and w["q"].ndim == 2:
             from llmq_tpu.ops.pallas_matmul import int8_matmul_pallas
@@ -137,6 +283,8 @@ def dequantize(w: Any, dtype) -> jnp.ndarray:
     """Materialize the full-precision weight (grouped-matmul operands —
     ``lax.ragged_dot`` takes a real array). One layer's slice at a time
     inside the scan, so the transient stays small."""
+    if is_int4(w):
+        return dequantize_int4_parts(w["q"], w["scale"], w["zero"], dtype)
     if is_quantized(w):
         return w["q"].astype(dtype) * w["scale"].astype(dtype)[..., None, :]
     return w
@@ -161,17 +309,29 @@ def tied_head_matmul(h: jnp.ndarray, embed: Any) -> jnp.ndarray:
 
 
 def quantize_params(
-    params: Params, scale_dtype=jnp.float32, *, donate: bool = False
+    params: Params,
+    scale_dtype=jnp.float32,
+    *,
+    donate: bool = False,
+    bits: int = 8,
+    group_size: int = INT4_GROUP_SIZE,
 ) -> Params:
     """Quantize a loaded/initialized param tree (returns a new tree).
     Used by the preset / random-init path and tests; checkpoint loads
     quantize while streaming (``engine/weights.py``) so the bf16 copy
     never exists on device.
 
+    ``bits=4`` puts the layer matmul weights on the int4 group rung;
+    the embedding table and LM head stay int8 on either rung (per-row /
+    per-column symmetric — logits are the precision-sensitive end and
+    those two tensors are not the decode bandwidth term).
+
     ``donate=True`` frees each full-precision buffer as it is consumed —
     required when the bf16 tree alone nearly fills HBM (a 9B preset on a
     16 GB chip): peak HBM is then one tensor's bf16+int8, not two whole
     trees. The input tree's quantized leaves are unusable afterwards."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     donate_args = (0,) if donate else ()
 
     @partial(jax.jit, donate_argnums=donate_args)
@@ -182,11 +342,16 @@ def quantize_params(
     def _quant_rows(w):
         return quantize_array(w, axis=-1, scale_dtype=scale_dtype)
 
+    @partial(jax.jit, donate_argnums=donate_args)
+    def _quant_w4(w):
+        return quantize_array_int4(w, group_size=group_size, scale_dtype=scale_dtype)
+
+    quant_layer = _quant_w4 if bits == 4 else _quant_w
     out: Params = dict(params)
     layers = dict(params["layers"])
     for key in QUANTIZED_LAYER_KEYS:
         if key in layers:
-            layers[key] = _quant_w(layers[key])
+            layers[key] = quant_layer(layers[key])
     out["layers"] = layers
     out["embed"] = _quant_rows(params["embed"])
     if "lm_head" in params:
@@ -205,6 +370,13 @@ def quantized_specs(specs: Params, params: Params) -> Params:
         if is_quantized(param_node):
             spec = spec_node
             parts = list(spec) + [None] * (param_node["q"].ndim - len(spec))
+            if is_int4(param_node):
+                # Packed q keeps the weight spec (the packed axis IS the
+                # contraction axis, halved). Scale/zero replicate their
+                # group axis: group tensors are 1/group the weight bytes,
+                # and groups need not divide tp.
+                sz = P(*(parts[:-2] + [None] + parts[-1:]))
+                return {"q": spec, "scale": sz, "zero": sz}
             # The reduced axis is structural, not inferable from shapes
             # (square weights are common): only "embed" quantizes per ROW
             # (last axis reduced); every weight reduces the contraction

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -370,6 +370,64 @@ class Transformer:
         last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
         return self._logits(params, last_h), k_pages, v_pages
 
+    # --- shared paged-chunk trunk ------------------------------------------
+    def _paged_chunk_trunk(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [B, C] query tokens (padding rows arbitrary)
+        positions: jnp.ndarray,  # [B, C] absolute positions (−1 = padding)
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [B, pages_per_seq]
+        *,
+        backend: Optional[str] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """The write-then-attend layer scan shared by chunked prefill,
+        speculative verify, and the fused mixed step: write each row's
+        valid positions' K/V into the paged cache, attend every query
+        against everything cached so far (causal), run the MLP. Returns
+        the full hidden grid ``[B, C, H]`` — callers choose which
+        positions become logits. Per-row positions must satisfy the
+        leading-contiguous-run contract of
+        ``ops/dispatch.chunked_prefill_attention``."""
+        cfg = self.config
+        inv_freq = compute_rope_inv_freq(cfg)
+        h = self._embed(params, tokens)  # [B, C, H]
+        windows = self._window_for_layers()
+        one_plus = cfg.model_type.startswith("gemma")
+        attn_backend = self.attn_backend if backend is None else backend
+
+        def layer_fn(carry, xs):
+            h, kps, vps = carry
+            lp, window, li = xs
+            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
+            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            kps, vps = attn_ops.write_kv_pages(
+                kps, vps, k, v, block_tables, positions, layer=li
+            )
+            attn_out = attn_dispatch.chunked_prefill_attention(
+                q,
+                kps,
+                vps,
+                block_tables,
+                positions,
+                scale=cfg.attn_scale,
+                sliding_window=window,
+                softcap=cfg.attn_softcap,
+                mesh=self.mesh,
+                backend=attn_backend,
+                layer=li,
+            )
+            h = self._finish_layer(lp, h, attn_out)
+            return (h, kps, vps), None
+
+        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        return jax.lax.scan(
+            layer_fn,
+            (h, k_pages, v_pages),
+            (params["layers"], windows, layer_idx),
+        )[0]
+
     # --- chunked prefill ---------------------------------------------------
     def prefill_chunk(
         self,
@@ -392,47 +450,54 @@ class Transformer:
         row's ``last_in_chunk`` position (meaningful only on a row's
         final chunk) plus the updated pages.
         """
-        cfg = self.config
-        B, C = tokens.shape
-        inv_freq = compute_rope_inv_freq(cfg)
-        h = self._embed(params, tokens)  # [B, C, H]
-        windows = self._window_for_layers()
-        one_plus = cfg.model_type.startswith("gemma")
-
-        def layer_fn(carry, xs):
-            h, kps, vps = carry
-            lp, window, li = xs
-            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
-            q, k, v = self._qkv(lp, x, positions, inv_freq)
-            kps, vps = attn_ops.write_kv_pages(
-                kps, vps, k, v, block_tables, positions, layer=li
-            )
-            attn_out = attn_dispatch.chunked_prefill_attention(
-                q,
-                kps,
-                vps,
-                block_tables,
-                positions,
-                scale=cfg.attn_scale,
-                sliding_window=window,
-                softcap=cfg.attn_softcap,
-                mesh=self.mesh,
-                backend=self.attn_backend,
-                layer=li,
-            )
-            h = self._finish_layer(lp, h, attn_out)
-            return (h, kps, vps), None
-
-        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (h, k_pages, v_pages), _ = jax.lax.scan(
-            layer_fn,
-            (h, k_pages, v_pages),
-            (params["layers"], windows, layer_idx),
+        h, k_pages, v_pages = self._paged_chunk_trunk(
+            params, tokens, positions, k_pages, v_pages, block_tables
         )
         last_h = jnp.take_along_axis(
             h, last_in_chunk[:, None, None], axis=1
         )[:, 0]
         return self._logits(params, last_h), k_pages, v_pages
+
+    # --- fused mixed prefill+decode ----------------------------------------
+    def mixed(
+        self,
+        params: Params,
+        tokens: jnp.ndarray,  # [S, C] combined query grid (see engine)
+        positions: jnp.ndarray,  # [S, C] absolute positions (−1 = padding)
+        k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
+        v_pages: jnp.ndarray,
+        block_tables: jnp.ndarray,  # [S, pages_per_seq]
+        gather_idx: jnp.ndarray,  # [S] which chunk position becomes the
+        #                           row's logits (decode rows: 0; the
+        #                           piggy row: its segment's last valid)
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One fused mixed step: every active decode slot scores its
+        single next position while ONE pending request's prefill chunk
+        segment rides along in the same grid — decode rows occupy column
+        0 of the ``[S, C]`` grid (a one-position leading run at their
+        context length), the piggy row carries its budgeted segment (a
+        leading run at the chunk offset). The paged-KV writes keep rows
+        isolated, so decode math is position-for-position identical to
+        the plain decode step; only the LM-head input is gathered
+        per-row (``gather_idx``) to avoid an S·C logit grid. Returns
+        (logits [S, V], k_pages, v_pages)."""
+        cfg = self.config
+        kernel, _ = attn_dispatch.mixed_kernel_plan(
+            cfg.num_heads, cfg.num_kv_heads, self.mesh, self.attn_backend
+        )
+        h, k_pages, v_pages = self._paged_chunk_trunk(
+            params,
+            tokens,
+            positions,
+            k_pages,
+            v_pages,
+            block_tables,
+            backend="xla" if kernel == "xla" else self.attn_backend,
+        )
+        row_h = jnp.take_along_axis(
+            h, gather_idx[:, None, None], axis=1
+        )[:, 0]
+        return self._logits(params, row_h), k_pages, v_pages
 
     # --- speculative verify ------------------------------------------------
     def verify(
@@ -456,41 +521,8 @@ class Transformer:
         next verify step at the same positions, so no cache rollback is
         needed.
         """
-        cfg = self.config
-        inv_freq = compute_rope_inv_freq(cfg)
-        h = self._embed(params, tokens)  # [S, Q, H]
-        windows = self._window_for_layers()
-        one_plus = cfg.model_type.startswith("gemma")
-
-        def layer_fn(carry, xs):
-            h, kps, vps = carry
-            lp, window, li = xs
-            x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
-            q, k, v = self._qkv(lp, x, positions, inv_freq)
-            kps, vps = attn_ops.write_kv_pages(
-                kps, vps, k, v, block_tables, positions, layer=li
-            )
-            attn_out = attn_dispatch.chunked_prefill_attention(
-                q,
-                kps,
-                vps,
-                block_tables,
-                positions,
-                scale=cfg.attn_scale,
-                sliding_window=window,
-                softcap=cfg.attn_softcap,
-                mesh=self.mesh,
-                backend=self.attn_backend,
-                layer=li,
-            )
-            h = self._finish_layer(lp, h, attn_out)
-            return (h, kps, vps), None
-
-        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (h, k_pages, v_pages), _ = jax.lax.scan(
-            layer_fn,
-            (h, k_pages, v_pages),
-            (params["layers"], windows, layer_idx),
+        h, k_pages, v_pages = self._paged_chunk_trunk(
+            params, tokens, positions, k_pages, v_pages, block_tables
         )
         return self._logits(params, h), k_pages, v_pages
 
@@ -595,7 +627,7 @@ CHUNKED_INIT_F32_BYTES = 1 << 30
 
 def init_params(
     config: ModelConfig, key: jax.Array, dtype=jnp.float32,
-    *, quantize: bool = False,
+    *, quantize: bool | str = False,
 ) -> Params:
     """Random init (testing / benchmarks without a checkpoint).
 
@@ -603,15 +635,23 @@ def init_params(
     directly: each big weight is quantized with a donated jit the moment
     it is created, so peak HBM is the int8 tree plus ONE full-precision
     tensor — a 9B preset quantizes on a 16 GB chip where init-then-
-    quantize would OOM on the bf16 tree alone."""
+    quantize would OOM on the bf16 tree alone. ``quantize="int4"`` puts
+    the layer matmul weights on the packed int4 group rung instead
+    (embed/lm_head stay int8, mirroring the checkpoint loader)."""
     cfg = config
+    quant_mode = (
+        "int4" if str(quantize).lower() == "int4"
+        else ("int8" if quantize else None)
+    )
     d = cfg.head_dim_
     L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
     keys = iter(jax.random.split(key, 16))
 
-    def w(key, shape, fan_in, *, q: bool = False, axis: int = -2):
+    def w(key, shape, fan_in, *, q: bool = False, axis: int = -2,
+          top: bool = False):
+        int4 = bool(q) and quant_mode == "int4" and not top
         f32_bytes = 4 * math.prod(shape)
-        if quantize and q and f32_bytes > CHUNKED_INIT_F32_BYTES and len(shape) > 2:
+        if quant_mode and q and f32_bytes > CHUNKED_INIT_F32_BYTES and len(shape) > 2:
             # Big stacked weights (a 9B gate_proj is ~11 GB in f32):
             # generate + quantize one leading-axis slice at a time so the
             # full-precision transient is one LAYER, not the whole stack —
@@ -624,18 +664,22 @@ def init_params(
                     / math.sqrt(fan_in)
                 ).astype(dtype)
                 parts.append(
-                    qm.quantize_array_donated(
+                    qm.quantize_array_int4_donated(arr, scale_dtype=dtype)
+                    if int4
+                    else qm.quantize_array_donated(
                         arr, axis=axis, scale_dtype=dtype
                     )
                 )
             return {
-                "q": jnp.stack([p["q"] for p in parts]),
-                "scale": jnp.stack([p["scale"] for p in parts]),
+                key_: jnp.stack([p[key_] for p in parts])
+                for key_ in parts[0]
             }
         arr = (
             jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
         ).astype(dtype)
-        if quantize and q:
+        if quant_mode and q:
+            if int4:
+                return qm.quantize_array_int4_donated(arr, scale_dtype=dtype)
             return qm.quantize_array_donated(arr, axis=axis, scale_dtype=dtype)
         return arr
 
@@ -676,12 +720,14 @@ def init_params(
         layers["post_attn_norm"] = jnp.ones((L, H), dtype)
         layers["post_mlp_norm"] = jnp.ones((L, H), dtype)
     params: Params = {
-        "embed": w(next(keys), (cfg.vocab_size, H), H, q=True, axis=-1),
+        "embed": w(next(keys), (cfg.vocab_size, H), H, q=True, axis=-1,
+                   top=True),
         "layers": layers,
         "final_norm": jnp.ones((H,), dtype),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w(next(keys), (H, cfg.vocab_size), H, q=True)
+        params["lm_head"] = w(next(keys), (H, cfg.vocab_size), H, q=True,
+                              top=True)
     return params
 
 

@@ -196,6 +196,46 @@ def paged_prefill_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v).astype(out_dtype)
 
 
+def mixed_query_grid(
+    tokens: jnp.ndarray,  # [S] current decode token per slot
+    ctx: jnp.ndarray,  # [S] context length − 1 per slot
+    active: jnp.ndarray,  # [S] bool — slot is decoding
+    chunk_tokens: jnp.ndarray,  # [C] piggybacked prefill segment tokens
+    chunk_positions: jnp.ndarray,  # [C] absolute positions (−1 = padding)
+    slot: jnp.ndarray,  # scalar int — the piggy sequence's slot
+    max_kv_pos: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Query grids for a mixed (decode + piggybacked prefill) dispatch.
+
+    Builds the ``[S, C]`` token/position grids one fused model call
+    consumes: every decodable row becomes a single-query row
+    ``[ctx, -1, ...]`` (exactly the decode step's position, padded to the
+    chunk width), and the piggy sequence's slot — while it is still
+    mid-prefill, i.e. inactive — carries the prefill segment instead.
+    Once the piggy activates (its final segment sampled), ``is_chunk``
+    goes False for its slot and it decodes like any other row.
+
+    Every row satisfies the chunked-prefill kernel contract (a LEADING
+    CONTIGUOUS run of valid positions, then −1 padding): decode rows are
+    a run of length 1 (or empty when inactive / past the page map, which
+    routes their write to the scratch page), and the caller builds the
+    segment as ``[s .. s+n−1, −1, ...]``. Returns
+    ``(q_tokens [S, C], q_positions [S, C], is_chunk [S])``."""
+    S = tokens.shape[0]
+    base_tok = jnp.zeros((S, chunk_tokens.shape[0]), tokens.dtype)
+    base_tok = base_tok.at[:, 0].set(tokens)
+    base_pos = jnp.full(base_tok.shape, -1, ctx.dtype)
+    base_pos = base_pos.at[:, 0].set(
+        jnp.where(active & (ctx < max_kv_pos), ctx, -1)
+    )
+    is_chunk = (jnp.arange(S) == slot) & ~active
+    q_tokens = jnp.where(is_chunk[:, None], chunk_tokens[None, :], base_tok)
+    q_positions = jnp.where(
+        is_chunk[:, None], chunk_positions[None, :], base_pos
+    )
+    return q_tokens, q_positions, is_chunk
+
+
 def write_prompt_kv_pages(
     k_pages: jnp.ndarray,  # [L, P, page_size, n_kv, d] (stacked only)
     v_pages: jnp.ndarray,

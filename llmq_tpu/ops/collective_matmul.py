@@ -34,6 +34,12 @@ the engine must disable process-wide for the GSPMD tp>1 path (an opaque
 usable inside the ring. The chunk path therefore checks the
 ``LLMQ_INT8_MATMUL`` env var directly rather than
 ``quant._pallas_int8_enabled()``, which the process-wide disable gates.
+int4 group-quantized weights ride the same rings: each device
+affine-dequantizes its own contraction shard per chunk (zero-points
+don't commute with the reduce the way int8's end-scale does, but the
+per-device partials are an exact linear split of the contraction), with
+``LLMQ_INT4_MATMUL=pallas`` routing chunks through the packed Pallas
+kernel.
 
 Every hand-written collective here names its axis via the
 ``parallel.mesh`` constants — enforced by the ``collective-axis`` lint
@@ -108,6 +114,12 @@ def _pallas_chunk_matmul() -> bool:
     to protect GSPMD-partitioned call sites, and ring chunks are local
     calls that restriction does not apply to."""
     return os.environ.get("LLMQ_INT8_MATMUL", "").lower() == "pallas"
+
+
+def _pallas_chunk_matmul_int4() -> bool:
+    """int4 counterpart of :func:`_pallas_chunk_matmul` — same direct env
+    check, same local-call exemption from the process-wide disable."""
+    return os.environ.get("LLMQ_INT4_MATMUL", "").lower() == "pallas"
 
 
 def _splits(n_out: int, tp: int) -> Tuple[int, bool]:
@@ -197,22 +209,60 @@ def row_parallel_matmul(
     ``qm.matmul`` (GSPMD inserts the all-reduce) when ``plan`` is None
     or the static shapes don't split over the ring."""
     quantized = qm.is_quantized(w)
+    int4 = qm.is_int4(w)
     arr = w["q"] if quantized else w
+    # int4 packs two K rows per byte: the CONTRACTION length is twice the
+    # stored axis, and the packed axis itself must still split over tp.
+    k_eff = arr.shape[0] * 2 if int4 else arr.shape[0]
     if (
         plan is None
         or arr.ndim != 2
-        or arr.shape[0] % plan.tp != 0
+        or k_eff % plan.tp != 0
         or arr.shape[1] % plan.tp != 0
-        or x.shape[-1] != arr.shape[0]
+        or x.shape[-1] != k_eff
+        or (int4 and (arr.shape[0] % plan.tp != 0
+                      or w["scale"].shape[0] % plan.tp != 0))
     ):
         return qm.matmul(x, w)
-    K, N = arr.shape
+    K, N = k_eff, arr.shape[1]
     lead = x.shape[:-1]
     x2 = x.reshape(-1, K)
     lead_axis = _lead_axis(plan, x2.shape[0])
-    use_pallas = quantized and _pallas_chunk_matmul()
+    use_pallas = quantized and not int4 and _pallas_chunk_matmul()
+    use_pallas4 = int4 and _pallas_chunk_matmul_int4()
 
-    if quantized:
+    if int4:
+
+        def chunk(x_local, operands, start, size):
+            q, scale, zero = operands
+            qc = jax.lax.dynamic_slice_in_dim(q, start, size, axis=1)
+            sc = jax.lax.dynamic_slice_in_dim(scale, start, size, axis=1)
+            zc = jax.lax.dynamic_slice_in_dim(zero, start, size, axis=1)
+            if use_pallas4:
+                from llmq_tpu.ops.pallas_matmul import int4_matmul_pallas
+
+                return int4_matmul_pallas(
+                    x_local, qc, sc, zc,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            return x_local @ qm.dequantize_int4_parts(
+                qc, sc, zc, x_local.dtype
+            )
+
+        operands = (w["q"], w["scale"], w["zero"])
+        # The affine zero-point does NOT commute across devices like
+        # int8's end-scale, but each device's partial product uses the
+        # fully dequantized LOCAL K rows, so the ring's cross-device sum
+        # is an exact linear split of the contraction. Scale/zero shard
+        # their group axis alongside q's packed K axis (groups align
+        # with K shards because G % tp == 0, guarded above); at rest
+        # they are replicated, so the reshard is a local slice.
+        operand_specs = (
+            P(TP_AXIS, None),
+            P(TP_AXIS, None),
+            P(TP_AXIS, None),
+        )
+    elif quantized:
 
         def chunk(x_local, operands, start, size):
             q, scale = operands
@@ -267,18 +317,44 @@ def row_parallel_ragged_matmul(
     the ring reduces chunk by chunk. The token axis stays REPLICATED —
     ragged group boundaries don't align with a dp split of the rows."""
     quantized = qm.is_quantized(w)
+    int4 = qm.is_int4(w)
     arr = w["q"] if quantized else w
+    im_eff = arr.shape[1] * 2 if int4 else arr.shape[1]
     if (
         plan is None
         or arr.ndim != 3
-        or arr.shape[1] % plan.tp != 0
+        or im_eff % plan.tp != 0
         or arr.shape[2] % plan.tp != 0
-        or x.shape[-1] != arr.shape[1]
+        or x.shape[-1] != im_eff
+        or (int4 and (arr.shape[1] % plan.tp != 0
+                      or w["scale"].shape[1] % plan.tp != 0))
     ):
         return jax.lax.ragged_dot(x, qm.dequantize(w, dtype), group_sizes)
     H = arr.shape[2]
 
-    if quantized:
+    if int4:
+
+        def chunk(x_local, operands, start, size):
+            q, scale, zero, gs = operands
+            qc = jax.lax.dynamic_slice_in_dim(q, start, size, axis=2)
+            sc = jax.lax.dynamic_slice_in_dim(scale, start, size, axis=2)
+            zc = jax.lax.dynamic_slice_in_dim(zero, start, size, axis=2)
+            return jax.lax.ragged_dot(
+                x_local, qm.dequantize_int4_parts(qc, sc, zc, dtype), gs
+            )
+
+        operands = (w["q"], w["scale"], w["zero"], group_sizes)
+        # Packed Im axis and the matching group axis shard together (see
+        # row_parallel_matmul); each device dequantizes its own expert
+        # Im-rows per chunk, so the ring reduce is again an exact linear
+        # split of the per-expert contraction.
+        operand_specs = (
+            P(None, TP_AXIS, None),
+            P(None, TP_AXIS, None),
+            P(None, TP_AXIS, None),
+            P(None),
+        )
+    elif quantized:
 
         def chunk(x_local, operands, start, size):
             q, scale, gs = operands
@@ -329,16 +405,18 @@ def column_parallel_matmul(
     keeps activations reduce-scattered between the projections, and as
     the measured shape in ``tools/profile_collectives.py``."""
     quantized = qm.is_quantized(w)
+    int4 = qm.is_int4(w)
     arr = w["q"] if quantized else w
+    k_eff = arr.shape[0] * 2 if int4 else arr.shape[0]
     if (
         plan is None
         or arr.ndim != 2
-        or arr.shape[0] % plan.tp != 0
+        or k_eff % plan.tp != 0
         or arr.shape[1] % plan.tp != 0
-        or x.shape[-1] != arr.shape[0]
+        or x.shape[-1] != k_eff
     ):
         return qm.matmul(x, w)
-    K, N = arr.shape
+    K, N = k_eff, arr.shape[1]
     tp = plan.tp
     size = K // tp
     lead = x.shape[:-1]
@@ -347,6 +425,14 @@ def column_parallel_matmul(
 
     def body(x_local, wl, *rest):
         i = jax.lax.axis_index(TP_AXIS)
+        if int4:
+            # The affine zero-point can't ride the int8 end-scale trick,
+            # and the ring walks the FULL local K — dequantize this
+            # device's [K, N/tp] column shard once up front (the weight
+            # here is N-sharded, so packing and groups are untouched).
+            scale_l, zero_l = rest
+            wl = qm.dequantize_int4_parts(wl, scale_l, zero_l, x_local.dtype)
+            rest = ()
 
         def partial_for(held, s):
             src = (i - s) % tp  # which x chunk `held` is, after s hops
@@ -361,12 +447,15 @@ def column_parallel_matmul(
             return held, acc + partial_for(held, s)
 
         _, acc = jax.lax.fori_loop(1, tp, step, (x_local, acc))
-        if rest:  # quantized: per-column scale shard applies at the end
+        if rest:  # int8: per-column scale shard applies at the end
             (scale_local,) = rest
             acc = acc * scale_local.astype(acc.dtype)
         return acc
 
-    if quantized:
+    if int4:
+        operands = (w["q"], w["scale"], w["zero"])
+        operand_specs = (P(None, TP_AXIS), P(None, TP_AXIS), P(None, TP_AXIS))
+    elif quantized:
         operands = (w["q"], w["scale"])
         operand_specs = (P(None, TP_AXIS), P(TP_AXIS))
     else:

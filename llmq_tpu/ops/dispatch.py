@@ -242,6 +242,33 @@ def verify_kernel_plan(
     return "chunked_prefill", False
 
 
+def mixed_kernel_plan(
+    n_heads: int, n_kv: int, mesh: Optional[Mesh] = None,
+    backend: str = "auto",
+) -> tuple:
+    """(kernel_name, fused_write) for the fused mixed prefill+decode
+    step: one [S, C] query grid where every active decode row carries a
+    single position (a one-element leading run at its context length)
+    and the piggybacked prefill row carries its budgeted chunk segment
+    (a leading contiguous run at the chunk offset) — BOTH forms satisfy
+    the leading-contiguous-run contract of
+    :func:`chunked_prefill_attention`, so the mixed step scores through
+    the same paged path speculative ``verify`` already uses, and the
+    plan mirrors :func:`verify_kernel_plan`. ``fused_write`` is always
+    False: the prefill segment writes C rows of K/V that its own later
+    positions must attend (``write_kv_pages`` lands before the read).
+
+    Same contract as :func:`decode_kernel_plan`: a pure function of
+    (shapes, mesh, env), consulted at trace time from every iteration of
+    the fused mixed-block ``lax.scan``."""
+    backend = resolve_backend() if backend == "auto" else backend
+    tp = _tp_degree(mesh)
+    tp_ok = tp == 1 or (n_heads % tp == 0 and n_kv % tp == 0)
+    if backend != "pallas" or not tp_ok:
+        return "xla", False
+    return "chunked_prefill", False
+
+
 def resolve_tp_overlap(
     mode: str,
     mesh: Optional[Mesh],

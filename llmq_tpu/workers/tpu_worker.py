@@ -48,6 +48,7 @@ class TPUWorker(BaseWorker):
         decode_block: Optional[int] = None,
         spec_tokens: Optional[int] = None,
         tp_overlap: Optional[str] = None,
+        mixed_step: Optional[str] = None,
         **kwargs,
     ) -> None:
         self.model = model
@@ -65,6 +66,7 @@ class TPUWorker(BaseWorker):
         self._decode_block = decode_block
         self._spec_tokens = spec_tokens
         self._tp_overlap = tp_overlap
+        self._mixed_step = mixed_step
         self.engine = None
         self._usage: dict = {}
         super().__init__(queue, **kwargs)
@@ -85,6 +87,14 @@ class TPUWorker(BaseWorker):
                 "--prefix-caching requires --prefill-chunk (or "
                 "LLMQ_PREFILL_CHUNK): only chunked prefill can start "
                 "mid-prompt"
+            )
+        if (self._mixed_step or self.config.mixed_step or "off").lower() == "on" and not (
+            self._prefill_chunk_size or self.config.prefill_chunk_size
+        ):
+            raise ValueError(
+                "--mixed-step on requires --prefill-chunk (or "
+                "LLMQ_PREFILL_CHUNK): the fused dispatch piggybacks "
+                "fixed-size prefill chunks"
             )
 
     # --- identity (reference vllm_worker.py:39-50) ------------------------
@@ -210,12 +220,15 @@ class TPUWorker(BaseWorker):
         )
         # int8 = weight-only quantization: weights stored int8 (half the
         # HBM footprint/bandwidth — what fits a ~9B model on one 16 GB
-        # chip), compute and KV stay bf16 (models/quant.py).
-        quantize = self._dtype == "int8"
+        # chip), compute and KV stay bf16 (models/quant.py). int4 =
+        # AWQ-style group quantization of the layer weights (quarter the
+        # bytes; embed/lm_head stay int8).
+        quantize = self._dtype if self._dtype in ("int8", "int4") else False
         dtype = {
             "bfloat16": jnp.bfloat16,
             "float32": jnp.float32,
             "int8": jnp.bfloat16,
+            "int4": jnp.bfloat16,
         }[self._dtype]
 
         spec = self.model
@@ -296,6 +309,12 @@ class TPUWorker(BaseWorker):
         ov = (self._tp_overlap or self.config.tp_overlap or "off").lower()
         if ov != "off":
             overrides["tp_overlap"] = ov
+        # Piggyback scheduling: per-worker flag > LLMQ_MIXED_STEP env >
+        # default off. The engine re-checks the prefill-chunk requirement
+        # and reports mixed_steps/mixed_prefill_tokens in stats().
+        mx = (self._mixed_step or self.config.mixed_step or "off").lower()
+        if mx != "off":
+            overrides["mixed_step"] = mx
         # KV cache dtype: per-worker flag > LLMQ_KV_DTYPE env > the
         # compute dtype. "fp8" stores pages as float8_e5m2 (half the KV
         # bytes; kernels convert on-chip) — vLLM kv-cache-dtype parity.

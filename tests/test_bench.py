@@ -103,6 +103,18 @@ class TestPickPreset:
             "tower-plus-9b"
         )
 
+    def test_16gb_int4_picks_9b(self):
+        assert bench.pick_preset(16 * 2**30, "tpu", int4=True) == (
+            "tower-plus-9b"
+        )
+
+    def test_8gb_int4_beats_int8_preset(self):
+        # Quartered weight bytes admit a larger architecture than int8
+        # on the same HBM.
+        gb8 = 8 * 2**30
+        assert bench.pick_preset(gb8, "tpu", int4=True) == "qwen2.5-7b"
+        assert bench.pick_preset(gb8, "tpu", int8=True) == "qwen2.5-3b"
+
 
 class TestLastHardwareMetricLine:
     """bench._last_hardware_metric_line: the CPU-fallback re-emit source.
@@ -159,85 +171,102 @@ class TestLastHardwareMetricLine:
 class TestTrimPlan:
     """bench.trim_plan: budget-aware phase trimming against the seconds
     left on LLMQ_BENCH_DEADLINE. The proven bf16 headline is reserved
-    first and never dropped; speculative phases drop the tp-overlap rung
-    first, then quant, then the spec-decode rung, then the extra ladder
-    rungs, then the A/B."""
+    first and never dropped; speculative phases drop the int4 attempt
+    first, then the tp-overlap rung, then quant, then the spec-decode
+    rung, then the mixed-step rung, then the extra ladder rungs, then
+    the A/B."""
 
     KW = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
-              spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0)
+              spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0,
+              int4_s=1500.0, mixed_s=300.0)
+    ALL = {"quant": True, "kernel_ab": True, "full_ladder": True,
+           "spec_ladder": True, "tp_overlap": True, "int4_ladder": True,
+           "mixed_step": True}
+    # Remaining-seconds sweep covering every drop boundary (phase sums
+    # + the 300 s proven floor): see the per-test comments.
+    SWEEP = (350.0, 720.0, 800.0, 1440.0, 1500.0, 1740.0, 1900.0,
+             2100.0, 2500.0, 3600.0, 3700.0, 3840.0, 4000.0, 5340.0,
+             5400.0)
 
     def test_no_deadline_runs_everything(self):
-        assert bench.trim_plan(None, **self.KW) == {
-            "quant": True, "kernel_ab": True, "full_ladder": True,
-            "spec_ladder": True, "tp_overlap": True}
+        assert bench.trim_plan(None, **self.KW) == self.ALL
 
     def test_roomy_budget_runs_everything(self):
-        # 300 (proven) + 1500 + 420 + 720 + 360 + 240 = 3540 fits.
-        assert bench.trim_plan(3600.0, **self.KW) == {
-            "quant": True, "kernel_ab": True, "full_ladder": True,
-            "spec_ladder": True, "tp_overlap": True}
+        # 300 (proven) + 1500 (int4) + 240 + 1500 + 360 + 300 + 720
+        # + 420 = 5340 fits.
+        assert bench.trim_plan(5400.0, **self.KW) == self.ALL
 
-    def test_tp_overlap_dropped_first(self):
-        # Everything but the tp-overlap rung fits (budget 3000 after the
-        # floor), + 240 does not.
-        plan = bench.trim_plan(3300.0, **self.KW)
-        assert plan == {"quant": True, "kernel_ab": True,
-                        "full_ladder": True, "spec_ladder": True,
+    def test_int4_dropped_first(self):
+        # Everything but the int4 attempt fits (3540 after the floor),
+        # + 1500 does not.
+        plan = bench.trim_plan(4000.0, **self.KW)
+        assert plan == {**self.ALL, "int4_ladder": False}
+
+    def test_tp_overlap_dropped_second(self):
+        plan = bench.trim_plan(3700.0, **self.KW)
+        assert plan == {**self.ALL, "int4_ladder": False,
                         "tp_overlap": False}
 
-    def test_quant_dropped_second(self):
-        # 300 (proven) + 420 + 720 + 360 fits, + 1500 does not.
-        plan = bench.trim_plan(2000.0, **self.KW)
-        assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": True, "spec_ladder": True,
-                        "tp_overlap": False}
+    def test_quant_dropped_third(self):
+        # 300 (proven) + 420 + 720 + 360 + 300 fits, + 1500 does not.
+        plan = bench.trim_plan(2500.0, **self.KW)
+        assert plan == {**self.ALL, "int4_ladder": False,
+                        "tp_overlap": False, "quant": False}
 
-    def test_spec_rung_dropped_third(self):
-        # 300 + 420 + 720 fits, + 360 (spec rung) does not.
-        plan = bench.trim_plan(1600.0, **self.KW)
-        assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": True, "spec_ladder": False,
-                        "tp_overlap": False}
+    def test_spec_rung_dropped_fourth(self):
+        # 300 + 420 + 720 + 300 fits, + 360 (spec rung) does not.
+        plan = bench.trim_plan(1900.0, **self.KW)
+        assert plan == {**self.ALL, "int4_ladder": False,
+                        "tp_overlap": False, "quant": False,
+                        "spec_ladder": False}
 
-    def test_ladder_dropped_fourth(self):
+    def test_mixed_rung_dropped_fifth(self):
+        # 300 + 420 + 720 fits, + 300 (mixed rung) does not.
+        plan = bench.trim_plan(1500.0, **self.KW)
+        assert plan == {**self.ALL, "int4_ladder": False,
+                        "tp_overlap": False, "quant": False,
+                        "spec_ladder": False, "mixed_step": False}
+
+    def test_ladder_dropped_sixth(self):
         # 300 + 420 fits, + 720 does not.
         plan = bench.trim_plan(800.0, **self.KW)
-        assert plan == {"quant": False, "kernel_ab": True,
-                        "full_ladder": False, "spec_ladder": False,
-                        "tp_overlap": False}
+        assert plan == {k: False for k in self.ALL} | {"kernel_ab": True}
 
     def test_everything_but_proven_dropped(self):
         plan = bench.trim_plan(350.0, **self.KW)
-        assert plan == {"quant": False, "kernel_ab": False,
-                        "full_ladder": False, "spec_ladder": False,
-                        "tp_overlap": False}
+        assert plan == {k: False for k in self.ALL}
 
     def test_proven_floor_reserved_before_phases(self):
-        # Exactly quant+ab+ladder+spec of budget but NO room for the
-        # proven floor on top -> the floor wins, quant goes.
-        plan = bench.trim_plan(3000.0, **self.KW)
-        assert plan["quant"] is False
+        # Exactly the full phase sum of budget but NO room for the
+        # proven floor on top -> the floor wins, int4 goes.
+        plan = bench.trim_plan(5040.0, **self.KW)
+        assert plan["int4_ladder"] is False
 
     def test_boundaries_inclusive(self):
-        assert bench.trim_plan(3540.0, **self.KW)["tp_overlap"] is True
-        assert bench.trim_plan(3300.0, **self.KW)["quant"] is True
-        assert bench.trim_plan(1800.0, **self.KW)["spec_ladder"] is True
+        assert bench.trim_plan(5340.0, **self.KW)["int4_ladder"] is True
+        assert bench.trim_plan(3840.0, **self.KW)["tp_overlap"] is True
+        assert bench.trim_plan(3600.0, **self.KW)["quant"] is True
+        assert bench.trim_plan(2100.0, **self.KW)["spec_ladder"] is True
+        assert bench.trim_plan(1740.0, **self.KW)["mixed_step"] is True
         assert bench.trim_plan(1440.0, **self.KW)["full_ladder"] is True
         assert bench.trim_plan(720.0, **self.KW)["kernel_ab"] is True
 
-    def test_spec_never_outlives_ladder(self):
-        # Drop order invariant: the spec rung is more speculative than
-        # the extra ladder rungs — no budget keeps spec while dropping
-        # the ladder.
-        for remaining in (350.0, 720.0, 800.0, 1440.0, 1600.0, 1800.0,
-                          2000.0, 3000.0, 3300.0, 3540.0, 3600.0):
+    def test_drop_order_invariants(self):
+        # A more speculative phase never survives a less speculative
+        # one's drop, at any budget.
+        order = ("int4_ladder", "tp_overlap", "quant", "spec_ladder",
+                 "mixed_step", "full_ladder", "kernel_ab")
+        for remaining in self.SWEEP:
             plan = bench.trim_plan(remaining, **self.KW)
-            assert not (plan["spec_ladder"] and not plan["full_ladder"])
+            for earlier, later in zip(order, order[1:]):
+                assert not (plan[earlier] and not plan[later]), (
+                    remaining, earlier, later, plan
+                )
 
-    def test_tp_overlap_never_outlives_quant(self):
-        # Drop order invariant: the tp-overlap rung is the most
-        # speculative phase — no budget keeps it while dropping quant.
-        for remaining in (350.0, 720.0, 800.0, 1440.0, 1600.0, 1800.0,
-                          2000.0, 3000.0, 3300.0, 3540.0, 3600.0):
-            plan = bench.trim_plan(remaining, **self.KW)
-            assert not (plan["tp_overlap"] and not plan["quant"])
+    def test_legacy_defaults_omit_new_rungs_free(self):
+        # Callers that never pass int4_s/mixed_s get them at zero cost:
+        # the keys exist but never consume budget.
+        kw = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
+                  spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0)
+        plan = bench.trim_plan(3540.0, **kw)
+        assert plan["tp_overlap"] is True and plan["int4_ladder"] is True

@@ -342,3 +342,61 @@ def test_soak_int8_tight_pool_matches_int8_golden():
         if p.temperature == 0.0:
             assert outs[rid].token_ids == golden[rid].token_ids, rid
         assert outs[rid].completion_tokens <= p.max_tokens
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_soak_mixed_step_matches_alternate_dispatch_golden(seed):
+    """Piggyback scheduling composes losslessly with the full feature
+    stack: a tight-pool engine fusing prefill chunk segments into its
+    decode dispatches (mixed_step=on) — under prefix caching,
+    preemption, fused decode blocks (decode_block=2) AND speculative
+    verification (spec_tokens=2) — must emit greedy outputs
+    token-identical to the alternate-dispatch engine (mixed_step=off,
+    otherwise identical config: the exact dispatch pattern the fusion
+    replaces). The decode rows' math is unchanged inside a mixed
+    dispatch, so greedy streams match token for token; sampled rows are
+    budget-checked. Also pins that the mixed path actually ran (the
+    ISSUE 6 acceptance line: mixed_steps > 0 with nonzero piggybacked
+    prefill tokens)."""
+    rng = np.random.default_rng(seed)
+    reqs = _requests(rng, 28)
+    mixed = _core(
+        20, prefill_chunk_size=8, enable_prefix_caching=True,
+        decode_block=2, spec_tokens=2, mixed_step="on",
+    )
+    outs = _drive(mixed, reqs, np.random.default_rng(seed + 100))
+    mixed.scheduler.check_invariants()
+    st = mixed.stats()
+    assert st["mixed_step"] == "on"
+    assert st["mixed_steps"] > 0
+    assert st["mixed_prefill_tokens"] > 0
+    # Each mixed dispatch runs decode_block device iterations, counted
+    # in the same ledgers as plain decode dispatches.
+    assert st["decode_dispatches"] >= st["mixed_steps"]
+    base = _core(
+        20, prefill_chunk_size=8, enable_prefix_caching=True,
+        decode_block=2, spec_tokens=2,
+    )
+    golden = _drive(base, reqs, np.random.default_rng(seed + 100))
+    assert base.stats()["mixed_steps"] == 0
+    for rid, _, p in reqs:
+        assert outs[rid].completion_tokens <= p.max_tokens
+        if p.temperature == 0.0:
+            assert outs[rid].token_ids == golden[rid].token_ids, rid
+            assert outs[rid].finish_reason == golden[rid].finish_reason, rid
+
+
+def test_mixed_step_requires_prefill_chunking():
+    with pytest.raises(ValueError, match="prefill_chunk_size"):
+        _core(40, mixed_step="on")
+
+
+def test_mixed_step_env_pin(monkeypatch):
+    """LLMQ_MIXED_STEP pins over the config, like LLMQ_TP_OVERLAP."""
+    monkeypatch.setenv("LLMQ_MIXED_STEP", "off")
+    core = _core(40, prefill_chunk_size=8, mixed_step="on")
+    assert core.mixed_step == "off"
+    monkeypatch.setenv("LLMQ_MIXED_STEP", "on")
+    core = _core(40, prefill_chunk_size=8)
+    assert core.mixed_step == "on"
+    assert "greedy" in core._mixedfill_jits

@@ -543,3 +543,69 @@ def test_decode_v3_through_model():
     np.testing.assert_allclose(
         outs["pallas"][2][:, 1:], outs["xla"][2][:, 1:], rtol=1e-6, atol=1e-6
     )
+
+
+class TestMixedQueryGrid:
+    """ops/attention.mixed_query_grid: the [S, C] grid one fused mixed
+    (decode + piggybacked prefill) dispatch consumes. Every row must
+    satisfy the chunked-prefill kernel contract — a leading contiguous
+    run of valid positions, then -1 padding."""
+
+    def _grid(self, **over):
+        kw = dict(
+            tokens=jnp.asarray([7, 8, 9, 10], jnp.int32),
+            ctx=jnp.asarray([3, 0, 5, 2], jnp.int32),
+            active=jnp.asarray([True, False, True, False]),
+            chunk_tokens=jnp.asarray([21, 22, 23], jnp.int32),
+            chunk_positions=jnp.asarray([4, 5, -1], jnp.int32),
+            slot=jnp.asarray(1, jnp.int32),
+            max_kv_pos=64,
+        )
+        kw.update(over)
+        return ref_ops.mixed_query_grid(**kw)
+
+    def test_decode_rows_are_single_position_runs(self):
+        q_tok, q_pos, is_chunk = self._grid()
+        np.testing.assert_array_equal(np.asarray(q_tok[0]), [7, 0, 0])
+        np.testing.assert_array_equal(np.asarray(q_pos[0]), [3, -1, -1])
+        np.testing.assert_array_equal(np.asarray(q_pos[2]), [5, -1, -1])
+
+    def test_chunk_row_carries_segment(self):
+        q_tok, q_pos, is_chunk = self._grid()
+        np.testing.assert_array_equal(np.asarray(is_chunk),
+                                      [False, True, False, False])
+        np.testing.assert_array_equal(np.asarray(q_tok[1]), [21, 22, 23])
+        np.testing.assert_array_equal(np.asarray(q_pos[1]), [4, 5, -1])
+
+    def test_inactive_non_chunk_rows_are_all_padding(self):
+        _, q_pos, is_chunk = self._grid()
+        assert not bool(is_chunk[3])  # inactive but not the piggy slot
+        np.testing.assert_array_equal(np.asarray(q_pos[3]), [-1, -1, -1])
+
+    def test_active_piggy_slot_decodes_normally(self):
+        # After activation (final segment scattered) the slot is active:
+        # it must get its decode position, not the chunk segment.
+        q_tok, q_pos, is_chunk = self._grid(
+            active=jnp.asarray([True, True, True, False])
+        )
+        assert not bool(is_chunk[1])
+        np.testing.assert_array_equal(np.asarray(q_tok[1]), [8, 0, 0])
+        np.testing.assert_array_equal(np.asarray(q_pos[1]), [0, -1, -1])
+
+    def test_past_page_map_routes_to_scratch(self):
+        _, q_pos, _ = self._grid(
+            ctx=jnp.asarray([3, 0, 64, 2], jnp.int32), max_kv_pos=64
+        )
+        np.testing.assert_array_equal(np.asarray(q_pos[2]), [-1, -1, -1])
+
+    def test_rows_keep_leading_contiguous_contract(self):
+        _, q_pos, _ = self._grid()
+        pos = np.asarray(q_pos)
+        for row in pos:
+            valid = row >= 0
+            n = int(valid.sum())
+            assert valid[:n].all() and not valid[n:].any(), row
+            if n > 1:
+                np.testing.assert_array_equal(
+                    row[:n], np.arange(row[0], row[0] + n)
+                )

@@ -1,4 +1,4 @@
-"""Int8 dequantize-in-VMEM matmul kernel vs the XLA reference
+"""Int8/int4 dequantize-in-VMEM matmul kernels vs a float64 reference
 (interpret mode off-TPU, same pattern as test_pallas_attention)."""
 
 import jax
@@ -7,11 +7,18 @@ import numpy as np
 import pytest
 
 from llmq_tpu.models import quant as qm
-from llmq_tpu.ops.pallas_matmul import int8_matmul_pallas
+from llmq_tpu.ops.pallas_matmul import int4_matmul_pallas, int8_matmul_pallas
 
 
 def _ref(x, q, scale):
-    return (x.astype(jnp.float32) @ q.astype(jnp.float32)) * scale
+    # Float64 truth, not a float32 matmul: the kernel's compensated
+    # (Kahan) accumulator is CLOSER to the exact product than a plain
+    # f32 reference is — at (256, 512, 520) the kernel errs ~9e-5 vs
+    # truth while the f32 reference errs ~3e-4, so comparing against
+    # the f32 matmul would fail on the REFERENCE's rounding.
+    return (
+        np.asarray(x, np.float64) @ np.asarray(q, np.float64)
+    ) * np.asarray(scale, np.float64)
 
 
 @pytest.mark.parametrize(
@@ -31,7 +38,7 @@ def test_matches_reference(M, K, N):
         x, q, scale, block_m=16, block_n=64, block_k=32, interpret=True
     )
     np.testing.assert_allclose(
-        np.asarray(out), np.asarray(_ref(x, q, scale)), rtol=1e-5, atol=1e-4
+        np.asarray(out, np.float64), _ref(x, q, scale), rtol=1e-5, atol=1e-4
     )
 
 
@@ -118,3 +125,51 @@ def test_prefill_through_model_matches_xla_path(monkeypatch):
     monkeypatch.setenv("LLMQ_INT8_MATMUL", "pallas")
     got = prefill()
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+# --- int4 group-quantized kernel ----------------------------------------
+
+
+@pytest.mark.parametrize(
+    "M,K,N,group",
+    [
+        (8, 32, 48, 16),  # tiny, two groups per k-block
+        (16, 128, 64, 128),  # one group spanning the whole K
+        (64, 256, 136, 32),  # ragged N (padding path), multi k-block
+    ],
+)
+def test_int4_matches_dequant_reference(M, K, N, group):
+    kx, kw = jax.random.split(jax.random.key(7))
+    w = jax.random.normal(kw, (K, N), jnp.float32)
+    qt = qm.quantize_array_int4(w, group_size=group)
+    x = jax.random.normal(kx, (M, K), jnp.float32)
+    out = int4_matmul_pallas(
+        x, qt["q"], qt["scale"], qt["zero"], block_m=16, block_n=64,
+        interpret=True,
+    )
+    ref = np.asarray(x, np.float64) @ np.asarray(
+        qm.dequantize_int4_parts(
+            qt["q"], qt["scale"], qt["zero"], jnp.float32
+        ),
+        np.float64,
+    )
+    np.testing.assert_allclose(np.asarray(out, np.float64), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_int4_quant_matmul_env_dispatch(monkeypatch):
+    """quant.matmul routes int4 weights through the kernel under
+    LLMQ_INT4_MATMUL=pallas and agrees with its own XLA dequant path,
+    including >2D activations (the [B, T, H] prefill shape)."""
+    w = jax.random.normal(jax.random.key(11), (64, 48), jnp.float32)
+    qt = qm.quantize_array_int4(w, group_size=32)
+    x = jax.random.normal(jax.random.key(12), (2, 6, 64), jnp.float32)
+
+    monkeypatch.delenv("LLMQ_INT4_MATMUL", raising=False)
+    xla = qm.matmul(x, qt)
+    monkeypatch.setenv("LLMQ_INT4_MATMUL", "pallas")
+    pallas = qm.matmul(x, qt)
+    assert pallas.shape == xla.shape == (2, 6, 48)
+    np.testing.assert_allclose(
+        np.asarray(pallas), np.asarray(xla), rtol=1e-4, atol=1e-4
+    )

@@ -18,6 +18,7 @@ kernel-autotune A/B, one bench) actually run. The heavyweight commands
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shlex
@@ -41,6 +42,7 @@ TINY_ENV = {
     "LLMQ_BENCH_GEN": "6",
     "LLMQ_BENCH_SEQS": "2",
     "LLMQ_BENCH_TRY_QUANT": "0",
+    "LLMQ_BENCH_PREFILL_CHUNK": "4",
     "LLMQ_BENCH_DEADLINE": "240",
     "PROF_S": "4",
     "PROF_H": "8",
@@ -233,6 +235,44 @@ def test_bench_tiny_spec_runs():
     assert '"metric"' in proc.stdout
     assert '"spec_tokens": 2' in proc.stdout
     assert '"acceptance_rate"' in proc.stdout
+
+
+def test_bench_tiny_mixed_step_runs():
+    """One representative bench command runs end to end on CPU with the
+    piggyback mixed-step dispatch pinned on; the metric line reports the
+    mode plus nonzero fused-dispatch counters (a mixed run that never
+    piggybacked a prefill token silently fell back to the split path)."""
+    proc = _run(
+        {
+            **TINY_ENV,
+            "LLMQ_MIXED_STEP": "on",
+            "LLMQ_BENCH_PREFILL_CHUNK": "4",
+        },
+        ["python", "bench.py"],
+        timeout=400,
+    )
+    _assert_ran("bench:tiny-mixed", proc)
+    assert '"metric"' in proc.stdout
+    payload = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    )
+    assert payload["mixed_step"] == "on"
+    assert payload["mixed_steps"] > 0
+    assert payload["mixed_prefill_tokens"] > 0
+
+
+def test_bench_tiny_int4_runs():
+    """One representative bench command runs end to end on CPU with the
+    int4 group-quantized weight ladder, emitting the metric line with
+    the dtype recorded."""
+    proc = _run(
+        {**TINY_ENV, "LLMQ_BENCH_DTYPE": "int4"},
+        ["python", "bench.py"],
+        timeout=400,
+    )
+    _assert_ran("bench:tiny-int4", proc)
+    assert '"metric"' in proc.stdout
+    assert '"dtype": "int4"' in proc.stdout
 
 
 @pytest.mark.slow

@@ -111,8 +111,10 @@ class TestQuantModel:
                               quantize=True)
         # Same tree: paths, shapes, dtypes (values differ — the chunked
         # path draws per-slice keys).
-        flat_a = jax.tree.leaves_with_path(one_shot)
-        flat_b = jax.tree.leaves_with_path(chunked)
+        # jax.tree.leaves_with_path only exists from jax 0.4.40; the
+        # tree_util spelling works on every supported version.
+        flat_a = jax.tree_util.tree_leaves_with_path(one_shot)
+        flat_b = jax.tree_util.tree_leaves_with_path(chunked)
         assert [p for p, _ in flat_a] == [p for p, _ in flat_b]
         for (pa, a), (_, b) in zip(flat_a, flat_b):
             assert a.shape == b.shape, pa
@@ -341,3 +343,176 @@ class TestQuantLoad:
             if not core.has_work:
                 break
         assert finished["r1"].completion_tokens == 6
+
+
+class TestInt4Math:
+    """AWQ-style int4 group quantization (``--dtype int4``): packing,
+    affine dequant, matmul routing, and the parameter ladder."""
+
+    def test_pack_unpack_roundtrip(self):
+        q = jax.random.randint(jax.random.key(0), (64, 48), 0, 16, jnp.int32)
+        packed = qm.pack_int4(q.astype(jnp.uint8))
+        assert packed.shape == (32, 48) and packed.dtype == jnp.uint8
+        np.testing.assert_array_equal(
+            np.asarray(qm.unpack_int4(packed)), np.asarray(q)
+        )
+
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.key(1), (128, 48), jnp.float32)
+        qt = qm.quantize_array_int4(w, group_size=32)
+        assert qt["q"].dtype == jnp.uint8 and qt["q"].shape == (64, 48)
+        assert qt["scale"].shape == qt["zero"].shape == (4, 48)
+        deq = qm.dequantize_int4_parts(
+            qt["q"], qt["scale"], qt["zero"], jnp.float32
+        )
+        # Affine 4-bit over a [wmin, wmax] group: half a step of rounding
+        # plus the zero-point's own rounding (≤ half a step more).
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        bound = np.repeat(np.asarray(qt["scale"]), 32, axis=0) * 1.01
+        assert (err <= bound).all(), float((err - bound).max())
+
+    def test_all_positive_group_representable(self):
+        # Regression: a clipped zero-point made all-positive groups
+        # unrepresentable (q=0 then decoded far below the group's wmin).
+        w = jnp.abs(jax.random.normal(jax.random.key(2), (64, 8))) + 3.0
+        qt = qm.quantize_array_int4(w, group_size=32)
+        deq = qm.dequantize_int4_parts(
+            qt["q"], qt["scale"], qt["zero"], jnp.float32
+        )
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        bound = np.repeat(np.asarray(qt["scale"]), 32, axis=0) * 1.01
+        assert (err <= bound).all(), float((err - bound).max())
+
+    def test_group_size_fallback_divides(self):
+        assert qm.int4_group(256) == 128
+        assert qm.int4_group(192) == 64  # gcd(192, 128)
+        assert qm.int4_group(130) == 2
+
+    def test_odd_contraction_axis_rejected(self):
+        w = jax.random.normal(jax.random.key(3), (33, 8), jnp.float32)
+        with pytest.raises(ValueError):
+            qm.quantize_array_int4(w)
+
+    def test_matmul_matches_dequantized_einsum(self):
+        x = jax.random.normal(jax.random.key(4), (4, 128), jnp.float32)
+        w = jax.random.normal(jax.random.key(5), (128, 48), jnp.float32)
+        qt = qm.quantize_array_int4(w, group_size=64)
+        direct = qm.matmul(x, qt)
+        via_deq = x @ qm.dequantize_int4_parts(
+            qt["q"], qt["scale"], qt["zero"], jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(direct), np.asarray(via_deq), rtol=1e-5, atol=1e-5
+        )
+
+    def test_stacked_matmul_and_specs(self):
+        w = jax.random.normal(jax.random.key(6), (2, 64, 24), jnp.float32)
+        qt = qm.quantize_array_int4(w, group_size=32)
+        assert qt["q"].shape == (2, 32, 24)
+        assert qt["scale"].shape == (2, 2, 24)
+        x = jax.random.normal(jax.random.key(7), (2, 5, 64), jnp.float32)
+        out = qm.matmul(x, qt)
+        ref = jnp.einsum(
+            "bik,bkn->bin",
+            x,
+            qm.dequantize_int4_parts(
+                qt["q"], qt["scale"], qt["zero"], jnp.float32
+            ),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+        # Sharding specs: q inherits the weight spec; scale/zero keep the
+        # trailing-axis (N) sharding with the group axis replicated.
+        from jax.sharding import PartitionSpec as P
+
+        specs = qm.quantized_specs(P(None, None, "tp"), qt)  # column-parallel
+        assert specs["q"] == P(None, None, "tp")
+        assert specs["scale"] == P(None, None, "tp")
+        assert specs["zero"] == P(None, None, "tp")
+        # Row-parallel (tp on the contraction axis): scale/zero fully
+        # replicated at rest — the ring reshards its group axis at use.
+        specs = qm.quantized_specs(P(None, "tp", None), qt)
+        assert specs["q"] == P(None, "tp", None)
+        assert specs["scale"] == P(None, None, None)
+        assert specs["zero"] == P(None, None, None)
+
+
+class TestInt4Model:
+    def test_quantize_params_bits4_ladder(self):
+        """bits=4 puts the LAYER matmuls on the int4 rung; embed and
+        lm_head (lookup/row-quantized tensors) stay int8."""
+        params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+        q4 = qm.quantize_params(params, bits=4)
+        gate = q4["layers"]["gate_proj"]
+        assert qm.is_int4(gate) and gate["q"].dtype == jnp.uint8
+        assert qm.is_quantized(q4["embed"]) and not qm.is_int4(q4["embed"])
+        assert q4["embed"]["q"].dtype == jnp.int8
+        if "lm_head" in q4:
+            assert not qm.is_int4(q4["lm_head"])
+
+    def test_prefill_logit_tolerance_int4(self):
+        """HF-parity-style tier for the int4 rung: logits close to full
+        precision, looser than int8 (4 bits carry 16 levels/group)."""
+        params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+        qparams = qm.quantize_params(params, bits=4)
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 12), 1, CFG.vocab_size
+        )
+        ref = _prefill_logits(CFG, params, tokens)
+        got = _prefill_logits(CFG, qparams, tokens)
+        denom = float(jnp.max(jnp.abs(ref)) + 1e-6)
+        rel = float(jnp.max(jnp.abs(got - ref))) / denom
+        # The tiny CFG (hidden 64 → one or two groups per column) is a
+        # worst case for 4-bit: measured rel ~0.41 / cosine ~0.943 vs the
+        # f32 reference.  The bounds below catch sign/zero-point bugs
+        # (which push cosine toward 0) without flaking on honest 4-bit
+        # rounding at toy widths.
+        assert rel < 0.60, f"relative logit error {rel:.3f}"
+        cos = float(
+            jnp.sum(ref * got)
+            / (jnp.linalg.norm(ref) * jnp.linalg.norm(got) + 1e-9)
+        )
+        assert cos > 0.90, f"logit cosine {cos:.4f}"
+
+    def test_init_params_int4_matches_quantize_params_structure(self):
+        direct = init_params(
+            CFG, jax.random.key(0), dtype=jnp.float32, quantize="int4"
+        )
+        offline = qm.quantize_params(
+            init_params(CFG, jax.random.key(0), dtype=jnp.float32), bits=4
+        )
+        flat_a = jax.tree_util.tree_leaves_with_path(direct)
+        flat_b = jax.tree_util.tree_leaves_with_path(offline)
+        assert [p for p, _ in flat_a] == [p for p, _ in flat_b]
+        for (pa, a), (_, b) in zip(flat_a, flat_b):
+            assert a.shape == b.shape, pa
+            assert a.dtype == b.dtype, pa
+
+    def test_engine_end_to_end_int4(self):
+        params = init_params(
+            CFG, jax.random.key(0), dtype=jnp.float32, quantize="int4"
+        )
+        from llmq_tpu.parallel import make_mesh
+
+        core = EngineCore(
+            CFG, params, ByteTokenizer(),
+            mesh=make_mesh(tensor_parallel=1),
+            engine_config=EngineConfig(
+                max_num_seqs=2, max_model_len=64, page_size=8,
+                num_pages=32, kv_dtype=jnp.float32,
+                min_prefill_bucket=16, max_prefill_batch=2,
+            ),
+        )
+        core.add_request(
+            "a", prompt="int4 smoke",
+            params=SamplingParams(
+                temperature=0.0, max_tokens=6, ignore_eos=True
+            ),
+        )
+        outs = []
+        for _ in range(200):
+            outs += core.step()
+            if not core.has_work:
+                break
+        assert len(outs) == 1 and outs[0].completion_tokens == 6

@@ -285,3 +285,41 @@ class TestPrefixCaching:
         sched.admit()
         assert s3.prefix_len == 0  # cache was invalidated by eviction
         sched.check_invariants()
+
+
+class TestMixedTokenBudget:
+    """Pure token-budget policy for piggyback (mixed) dispatches."""
+
+    def test_idle_batch_gets_full_chunk(self):
+        from llmq_tpu.engine.scheduler import mixed_token_budget
+
+        assert mixed_token_budget(256, 0, 1000) == 256
+
+    def test_decode_rows_claim_budget_first(self):
+        from llmq_tpu.engine.scheduler import mixed_token_budget
+
+        assert mixed_token_budget(256, 192, 1000) == 64
+        assert mixed_token_budget(8, 3, 100) == 5
+
+    def test_min_tokens_floor_guarantees_progress(self):
+        from llmq_tpu.engine.scheduler import mixed_token_budget
+
+        # Even a decode batch wider than the chunk leaves the prefill
+        # one position per iteration — it must never starve.
+        assert mixed_token_budget(8, 8, 100) == 1
+        assert mixed_token_budget(8, 500, 100) == 1
+        assert mixed_token_budget(8, 500, 100, min_tokens=4) == 4
+
+    def test_capped_by_remaining_and_chunk(self):
+        from llmq_tpu.engine.scheduler import mixed_token_budget
+
+        assert mixed_token_budget(256, 0, 10) == 10  # prompt tail
+        assert mixed_token_budget(8, 0, 100) == 8  # physical chunk width
+        # min_tokens can never push past the chunk row's width.
+        assert mixed_token_budget(8, 100, 100, min_tokens=99) == 8
+
+    def test_done_prompt_takes_nothing(self):
+        from llmq_tpu.engine.scheduler import mixed_token_budget
+
+        assert mixed_token_budget(256, 5, 0) == 0
+        assert mixed_token_budget(256, 5, -3) == 0

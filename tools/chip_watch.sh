@@ -65,6 +65,14 @@ run 1800 bench_int8_fp8kv_9b env LLMQ_BENCH_DTYPE=int8 LLMQ_BENCH_KV_DTYPE=fp8 L
 run 1800 bench_spec3 env LLMQ_BENCH_TRY_QUANT=0 LLMQ_BENCH_SPEC_TOKENS=3 python bench.py
 # 9. Param auto-layout A/B against step 2.
 run 1800 bench_autolayout env LLMQ_BENCH_TRY_QUANT=0 LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
+# 9b. int4 ladder: the kernel A/B (XLA dequant vs dequant-in-VMEM) at
+#    the decode MLP shape, then the 3B headline — int4 quarters weight
+#    bytes but costs real fidelity, so only a clear tok/s win counts.
+run 600  int4_kernel python tools/profile_kernel_v2.py --int4
+run 1800 bench_int4_3b env LLMQ_BENCH_DTYPE=int4 LLMQ_BENCH_PRESET=qwen2.5-3b python bench.py
+# 9c. Piggyback mixed dispatch: prefill chunks ride the decode step's
+#    idle MXU (PERF_NOTES round 9); compare wall split vs bench_bf16_2.
+run 1800 bench_mixed env LLMQ_BENCH_TRY_QUANT=0 LLMQ_MIXED_STEP=on LLMQ_BENCH_PREFILL_CHUNK=256 python bench.py
 # 10. Queue-drain artifact on the real engine (VERDICT weak #4): the
 #    end-to-end broker->worker->results harness at a TPU preset.
 run 1800 queue_drain_tpu python performance_benchmark.py \

@@ -46,6 +46,15 @@ run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
 run 1800 bench_autolayout env LLMQ_PARAM_AUTO_LAYOUT=1 python bench.py
 run 1800 bench_spec3   env LLMQ_BENCH_TRY_QUANT=0 \
     LLMQ_BENCH_SPEC_TOKENS=3 python bench.py
+# int4 ladder: quarter weight bytes; kernel A/B first (XLA dequant vs
+# the dequant-in-VMEM Pallas kernel at the decode MLP shape), then the
+# headline — int4's fidelity cost means only a clear tok/s win counts.
+run 600  int4_kernel   python tools/profile_kernel_v2.py --int4
+run 1800 bench_int4_3b env LLMQ_BENCH_DTYPE=int4 python bench.py
+# piggyback mixed dispatch: prefill chunks ride the decode step's idle
+# MXU (PERF_NOTES round 9) — compare against bench_bf16's wall split.
+run 1800 bench_mixed   env LLMQ_BENCH_TRY_QUANT=0 LLMQ_MIXED_STEP=on \
+    LLMQ_BENCH_PREFILL_CHUNK=256 python bench.py
 
 echo "=== summary"
 grep -h '"metric"' "$OUT"/bench_*.log 2>/dev/null
