@@ -956,6 +956,19 @@ def main() -> None:
     # counters proving the winning run actually fused prefill work.
     mixed_resolved = "off"
     mixed_counts = (0, 0)  # (mixed_steps, mixed_prefill_tokens)
+    # TTFT/ITL percentiles of the run that produced the headline number
+    # (ms, from the engine's host-side histograms; empty until a rung
+    # wins).
+    lat_metrics: dict = {}
+
+    def _latency_from_stats(stats: dict) -> dict:
+        return {
+            k: stats[k]
+            for k in (
+                "ttft_p50_ms", "ttft_p95_ms", "itl_p50_ms", "itl_p95_ms"
+            )
+            if stats.get(k) is not None
+        }
     # LLMQ_BENCH_KV_DTYPE: "auto" (or empty) means "pick for me" — the
     # compute dtype, exactly like unset. Anything else names the pool
     # dtype explicitly ("fp8" -> float8_e5m2 pages, half the KV bytes;
@@ -1034,6 +1047,7 @@ def main() -> None:
                 best = (out / elapsed, max_seqs, out, elapsed)
                 win_stats = core.stats()
                 spec_rate = win_stats.get("acceptance_rate", 0.0)
+                lat_metrics = _latency_from_stats(win_stats)
                 overlap_resolved = core.tp_overlap
                 mixed_resolved = core.mixed_step
                 mixed_counts = (
@@ -1095,7 +1109,9 @@ def main() -> None:
                 tok_s, out_tokens, elapsed, best_block = (
                     b_tok_s, b_out, b_elapsed, block
                 )
-                spec_rate = core.stats().get("acceptance_rate", 0.0)
+                b_stats = core.stats()
+                spec_rate = b_stats.get("acceptance_rate", 0.0)
+                lat_metrics = _latency_from_stats(b_stats)
             elif b_tok_s < 0.98 * tok_s:
                 # Larger K only adds wasted post-finish iterations on
                 # top of whatever made this K lose; stop paying builds.
@@ -1139,7 +1155,8 @@ def main() -> None:
             s_elapsed = run(n_requests, f"bench-s{max_seqs}-spec{spec}")
             s_out = core.total_generated_tokens - gen_before
             s_tok_s = s_out / s_elapsed
-            s_rate = core.stats().get("acceptance_rate", 0.0)
+            s_stats = core.stats()
+            s_rate = s_stats.get("acceptance_rate", 0.0)
             print(
                 f"bench: {max_seqs} slots, spec {spec} -> "
                 f"{s_tok_s:.1f} tok/s (acceptance {s_rate:.3f})",
@@ -1149,6 +1166,7 @@ def main() -> None:
                 tok_s, out_tokens, elapsed, best_spec, spec_rate = (
                     s_tok_s, s_out, s_elapsed, spec, s_rate
                 )
+                lat_metrics = _latency_from_stats(s_stats)
             elif s_tok_s < 0.98 * tok_s:
                 print(
                     f"bench: spec {spec} past the peak; stopping ladder",
@@ -1197,6 +1215,7 @@ def main() -> None:
             if m_tok_s > tok_s:
                 tok_s, out_tokens, elapsed = m_tok_s, m_out, m_elapsed
                 spec_rate = m_stats.get("acceptance_rate", 0.0)
+                lat_metrics = _latency_from_stats(m_stats)
                 mixed_resolved = "on"
                 mixed_counts = (
                     m_stats.get("mixed_steps", 0),
@@ -1247,7 +1266,9 @@ def main() -> None:
             )
             if o_tok_s > tok_s:
                 tok_s, out_tokens, elapsed = o_tok_s, o_out, o_elapsed
-                spec_rate = core.stats().get("acceptance_rate", 0.0)
+                o_stats = core.stats()
+                spec_rate = o_stats.get("acceptance_rate", 0.0)
+                lat_metrics = _latency_from_stats(o_stats)
                 overlap_resolved = core.tp_overlap
         except Exception as exc:  # noqa: BLE001 — skip only on OOM
             if not is_oom(exc):
@@ -1281,6 +1302,18 @@ def main() -> None:
         "decode_block": best_block,
         "spec_tokens": best_spec,
         "acceptance_rate": round(float(spec_rate), 4),
+        # TTFT/ITL percentiles (ms) from the winning rung's engine
+        # histograms — absent only if the engine reported none.
+        **{
+            out_key: round(float(lat_metrics[in_key]), 3)
+            for out_key, in_key in (
+                ("ttft_p50", "ttft_p50_ms"),
+                ("ttft_p95", "ttft_p95_ms"),
+                ("itl_p50", "itl_p50_ms"),
+                ("itl_p95", "itl_p95_ms"),
+            )
+            if in_key in lat_metrics
+        },
         "mixed_step": mixed_resolved,
         **(
             {

@@ -6,6 +6,7 @@ from llmq_tpu.analysis.checkers.collective_axis import CollectiveAxisChecker
 from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
 from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
 from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
+from llmq_tpu.analysis.checkers.wallclock import WallclockDurationChecker
 
 ALL_CHECKERS = (
     OrphanTaskChecker,
@@ -14,6 +15,7 @@ ALL_CHECKERS = (
     CancelledSwallowChecker,
     JaxHostSyncChecker,
     CollectiveAxisChecker,
+    WallclockDurationChecker,
 )
 
 #: rule id -> Rule, across every registered checker.

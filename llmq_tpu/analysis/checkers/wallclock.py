@@ -1,0 +1,113 @@
+"""wallclock-duration: ``time.time()`` arithmetic used to measure durations.
+
+Wall-clock time jumps — NTP slews, suspend/resume, leap smearing — so a
+duration computed as the difference of two ``time.time()`` samples can come
+out negative or wildly large, which in this codebase silently breaks
+heartbeat cadence and latency histograms. Durations measured inside one
+process must use ``time.monotonic()`` (or ``time.perf_counter()`` for short
+spans).
+
+The rule flags a subtraction where *both* operands derive from local
+``time.time()`` samples within the same function: a direct
+``time.time() - start`` where ``start = time.time()``, or ``now - before``
+where both names were assigned from ``time.time()`` (directly or through a
+chain of simple assignments). It deliberately does NOT flag subtractions
+where one operand is a persisted wall stamp from elsewhere — a message's
+``enqueued_at``, a parameter, a config value — because cross-process ages
+*must* use wall time (monotonic clocks don't compare across hosts). That is
+exactly the broker's TTL arithmetic, which is correct as written.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+    walk_skipping_functions,
+)
+
+WALLCLOCK_DURATION = Rule(
+    "wallclock-duration",
+    "warning",
+    "duration computed from time.time() samples; use time.monotonic()",
+)
+
+
+def _is_wallclock_call(node: ast.AST, imports: ImportMap) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and not node.args
+        and not node.keywords
+        and imports.resolve(node.func) == "time.time"
+    )
+
+
+def _collect_tainted_names(fn: ast.AST, imports: ImportMap) -> Set[str]:
+    """Local names holding a ``time.time()`` sample, through assignment
+    chains (``t0 = time.time(); start = t0``). One forward pass per round
+    until the set stops growing — functions are small, chains are short."""
+    tainted: Set[str] = set()
+    while True:
+        before = len(tainted)
+        for node in walk_skipping_functions(fn.body):  # type: ignore[union-attr]
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if _is_wallclock_call(value, imports) or (
+                isinstance(value, ast.Name) and value.id in tainted
+            ):
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        tainted.add(target.id)
+        if len(tainted) == before:
+            return tainted
+
+
+class WallclockDurationChecker(Checker):
+    rules = (WALLCLOCK_DURATION,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        if not any(
+            full == "time" or full.startswith("time.")
+            for full in imports.aliases.values()
+        ) and "time" not in imports.aliases:
+            return
+        for node in ast.walk(source.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted = _collect_tainted_names(node, imports)
+
+            def _wall(operand: ast.AST) -> bool:
+                return _is_wallclock_call(operand, imports) or (
+                    isinstance(operand, ast.Name) and operand.id in tainted
+                )
+
+            for expr in walk_skipping_functions(node.body):
+                if (
+                    isinstance(expr, ast.BinOp)
+                    and isinstance(expr.op, ast.Sub)
+                    and _wall(expr.left)
+                    and _wall(expr.right)
+                ):
+                    yield Violation(
+                        rule=WALLCLOCK_DURATION,
+                        path=source.path,
+                        line=expr.lineno,
+                        col=expr.col_offset,
+                        message=(
+                            "duration computed by subtracting time.time() "
+                            "samples is not monotonic (NTP steps, "
+                            "suspend/resume); use time.monotonic()"
+                        ),
+                    )

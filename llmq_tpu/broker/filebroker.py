@@ -266,7 +266,7 @@ class FileBroker(Broker):
         async def loop() -> None:
             last_janitor = 0.0
             while True:
-                now = time.time()
+                now = time.monotonic()
                 if now - last_janitor > 5.0:
                     self._janitor(queue)
                     last_janitor = now

@@ -23,6 +23,7 @@ from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result
 from llmq_tpu.core.pipeline import PipelineConfig
 from llmq_tpu.core.template import resolve_template_string, resolve_template_value
+from llmq_tpu.obs import TRACE_FIELD, new_trace, trace_event, trace_from_payload
 
 logger = logging.getLogger(__name__)
 
@@ -122,8 +123,19 @@ class BrokerManager:
 
     # --- publish ----------------------------------------------------------
     async def publish_job(self, queue: str, job: Job) -> None:
+        # Stamp the lifecycle trace into the payload itself so it
+        # survives broker hops, redeliveries, and pipeline stage handoffs
+        # (a stage handoff lands here again, appending a second
+        # "submitted" with the next stage's queue name).
+        payload = job.model_dump(mode="json")
+        trace = trace_from_payload(payload)
+        if trace is None:
+            trace = payload[TRACE_FIELD] = new_trace(job.id)
+        trace_event(trace, "submitted", queue=queue)
         await self.broker.publish(
-            queue, job.model_dump_json().encode("utf-8"), message_id=job.id
+            queue,
+            json.dumps(payload, default=str).encode("utf-8"),
+            message_id=job.id,
         )
 
     async def publish_result(self, queue: str, result: Result) -> None:

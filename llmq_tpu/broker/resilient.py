@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Optional
@@ -42,9 +43,20 @@ from llmq_tpu.broker.base import (
     make_broker,
 )
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.obs.metrics import get_registry
 from llmq_tpu.utils.aio import reap
 
 logger = logging.getLogger(__name__)
+
+# Process-wide latency series (get-or-create: every session in this
+# process shares one). Publish latency includes outbox parking — what a
+# caller actually waited, not just the happy path.
+_publish_hist = get_registry().histogram(
+    "llmq_broker_publish_seconds", "Broker publish call latency"
+)
+_settle_hist = get_registry().histogram(
+    "llmq_broker_settle_seconds", "Broker ack/reject settle latency"
+)
 
 #: Exception classes treated as "the connection died" (everything else is a
 #: broker-side error and propagates to the caller unchanged).
@@ -318,11 +330,13 @@ class ResilientBroker(Broker):
                 # broker requeued it on disconnect, redelivery owns it now.
                 self.session.fenced_settles += 1
                 return
+            t0 = time.perf_counter()
             try:
                 if verb == "ack":
                     await inner_msg.ack()
                 else:
                     await inner_msg.reject(requeue=requeue)
+                _settle_hist.observe(time.perf_counter() - t0)
             except RECONNECT_EXCEPTIONS as exc:
                 self.session.fenced_settles += 1
                 self._connection_lost(exc)
@@ -366,6 +380,7 @@ class ResilientBroker(Broker):
         message_id: Optional[str] = None,
         headers: Optional[Dict[str, Any]] = None,
     ) -> None:
+        t0 = time.perf_counter()
         while True:
             self._check_usable()
             if self._connected.is_set():
@@ -373,6 +388,7 @@ class ResilientBroker(Broker):
                     await self.inner.publish(
                         queue, body, message_id=message_id, headers=headers
                     )
+                    _publish_hist.observe(time.perf_counter() - t0)
                     return
                 except RECONNECT_EXCEPTIONS as exc:
                     self._connection_lost(exc)
@@ -381,6 +397,7 @@ class ResilientBroker(Broker):
                     _ParkedPublish(queue, body, message_id, headers)
                 )
                 self.session.outbox_parked += 1
+                _publish_hist.observe(time.perf_counter() - t0)
                 return
             # Outbox full: block until the flush drains it (or the session
             # comes back / dies) — this is how back-pressure survives outages.

@@ -199,6 +199,45 @@ def errors(queue, limit, requeue):
 
 
 @cli.command()
+@click.argument("job_id")
+@click.option("-q", "--queue", required=True,
+              help="Queue the job was submitted to (its .results queue is "
+                   "peeked non-destructively)")
+def trace(job_id, queue):
+    """Show a job's lifecycle timeline (submitted → claimed → prefill →
+    first token → finished) from the trace record in its result."""
+    from llmq_tpu.cli.monitor import trace_job
+
+    asyncio.run(trace_job(queue, job_id))
+
+
+@cli.group()
+def monitor() -> None:
+    """Live observability dashboards."""
+
+
+@monitor.command("top")
+@click.argument("queue")
+@click.option("--interval", type=float, default=2.0, show_default=True,
+              help="Refresh period in seconds")
+@click.option("--once", is_flag=True,
+              help="Render one snapshot and exit (scripts/tests)")
+def monitor_top_cmd(queue, interval, once):
+    """Live fleet dashboard: tok/s, occupancy, TTFT/ITL percentiles,
+    reconnects — aggregated from fresh worker heartbeats."""
+    from llmq_tpu.cli.monitor import monitor_top
+
+    try:
+        asyncio.run(
+            monitor_top(
+                queue, interval=interval, iterations=1 if once else None
+            )
+        )
+    except KeyboardInterrupt:
+        pass
+
+
+@cli.command()
 @click.argument("queue")
 @click.option("--yes", is_flag=True, help="Skip confirmation")
 def clear(queue, yes):

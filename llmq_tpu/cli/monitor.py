@@ -7,17 +7,21 @@
 
 from __future__ import annotations
 
+import asyncio
+import json
 import logging
 
-from typing import List, Optional
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
 
 from rich.console import Console
 from rich.table import Table
 
-from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.broker.manager import BrokerManager, results_queue_name
 from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import load_pipeline_config
+from llmq_tpu.obs import timeline, trace_from_payload
 from llmq_tpu.workers.base import HEALTH_SUFFIX, HEARTBEAT_INTERVAL_S
 
 logger = logging.getLogger(__name__)
@@ -29,6 +33,16 @@ STALE_AFTER_S = 2 * HEARTBEAT_INTERVAL_S
 console = Console(stderr=False)
 
 BACKLOG_WARN_THRESHOLD = 10_000
+
+
+def _stale_window_text() -> str:
+    """Human wording for the heartbeat freshness window, derived from
+    ``STALE_AFTER_S`` so retuning ``HEARTBEAT_INTERVAL_S`` can never
+    desynchronize the copy from the check."""
+    secs = int(STALE_AFTER_S)
+    if secs % 60 == 0:
+        return f"{secs // 60} min"
+    return f"{secs}s"
 
 
 async def show_connection_status() -> None:
@@ -92,6 +106,31 @@ def _print_warnings(stats: QueueStats) -> None:
         )
 
 
+async def _collect_heartbeats(
+    mgr: BrokerManager, queue: str
+) -> Dict[str, WorkerHealth]:
+    """Drain available heartbeats non-destructively (TTL-bounded queue,
+    newest wins per worker); every peeked message is requeued so the next
+    check still sees it."""
+    beats: Dict[str, WorkerHealth] = {}
+    peeked = []
+    while True:
+        msg = await mgr.broker.get(queue + HEALTH_SUFFIX)
+        if msg is None:
+            break
+        peeked.append(msg)
+        try:
+            health = WorkerHealth.model_validate_json(msg.body)
+            prev = beats.get(health.worker_id)
+            if prev is None or health.last_seen >= prev.last_seen:
+                beats[health.worker_id] = health
+        except Exception as exc:  # noqa: BLE001 — skip malformed beats
+            logger.debug("Skipping malformed heartbeat: %s", exc)
+    for msg in peeked:
+        await msg.reject(requeue=True)
+    return beats
+
+
 async def check_health(queue: str) -> None:
     """Queue heuristics + live worker heartbeats (the reference only had
     queue-level heuristics, monitor.py:48-75; heartbeats are llmq-tpu's
@@ -107,25 +146,7 @@ async def check_health(queue: str) -> None:
             console.print(
                 f"[yellow]⚠ Backlog: {stats.message_count_ready} ready[/yellow]"
             )
-        # Drain available heartbeats (TTL-bounded queue, newest wins per worker)
-        beats: dict[str, WorkerHealth] = {}
-        peeked = []
-        while True:
-            msg = await mgr.broker.get(queue + HEALTH_SUFFIX)
-            if msg is None:
-                break
-            peeked.append(msg)
-            try:
-                health = WorkerHealth.model_validate_json(msg.body)
-                prev = beats.get(health.worker_id)
-                if prev is None or health.last_seen >= prev.last_seen:
-                    beats[health.worker_id] = health
-            except Exception as exc:  # noqa: BLE001 — skip malformed beats
-                logger.debug("Skipping malformed heartbeat: %s", exc)
-        for msg in peeked:
-            # Non-destructive: keep heartbeats readable for the next check
-            # (they expire via queue TTL anyway).
-            await msg.reject(requeue=True)
+        beats = await _collect_heartbeats(mgr, queue)
         # Split fresh from stale: a heartbeat older than 2× the heartbeat
         # interval means the worker missed at least one beat — wedged, or
         # cut off from the broker. Stale workers don't count as liveness.
@@ -146,7 +167,8 @@ async def check_health(queue: str) -> None:
         elif not fresh:
             healthy = False
             console.print(
-                "[red]✗ No fresh worker heartbeats in the last 2 minutes[/red]"
+                f"[red]✗ No fresh worker heartbeats in the last "
+                f"{_stale_window_text()}[/red]"
             )
         if stale_ids:
             healthy = False
@@ -155,7 +177,9 @@ async def check_health(queue: str) -> None:
                 f"{STALE_AFTER_S:.0f}s)[/red]"
             )
         if beats:
-            table = Table(title="Worker heartbeats (last 2 min)")
+            table = Table(
+                title=f"Worker heartbeats (last {_stale_window_text()})"
+            )
             for col in (
                 "worker",
                 "status",
@@ -269,3 +293,167 @@ async def show_pipeline_status(pipeline_path: str) -> None:
         console.print("flow: " + " → ".join(flow_parts))
         for warning in warnings:
             console.print(f"[yellow]⚠ {warning}[/yellow]")
+
+
+# --- live dashboard ---------------------------------------------------------
+
+def _fmt_pcts(es: dict, lo_key: str, hi_key: str) -> str:
+    lo, hi = es.get(lo_key), es.get(hi_key)
+    if lo is None and hi is None:
+        return "-"
+
+    def f(v):
+        return "-" if v is None else f"{v:.0f}"
+
+    return f"{f(lo)}/{f(hi)}"
+
+
+def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
+    """One refresh frame: fleet summary line + per-worker table, built
+    from the freshest heartbeat per worker."""
+    from rich.console import Group
+
+    now = utcnow()
+    fresh = {
+        wid: h
+        for wid, h in beats.items()
+        if (now - h.last_seen).total_seconds() <= STALE_AFTER_S
+    }
+    fleet_toks = sum(
+        (h.engine_stats or {}).get("tokens_per_sec") or 0.0
+        for h in fresh.values()
+    )
+    occs = [
+        (h.engine_stats or {}).get("batch_occupancy")
+        for h in fresh.values()
+    ]
+    occs = [o for o in occs if o is not None]
+    header = (
+        f"queue [bold]{queue}[/bold] — {len(fresh)} fresh worker(s)"
+        f", {len(beats) - len(fresh)} stale"
+        f" | ready {stats.message_count_ready or 0}"
+        f" | fleet {fleet_toks:.1f} tok/s"
+    )
+    if occs:
+        header += f" | occupancy {sum(occs) / len(occs):.0%}"
+    table = Table(title=f"Worker heartbeats (last {_stale_window_text()})")
+    for col in (
+        "worker",
+        "status",
+        "jobs",
+        "tok/s",
+        "occ",
+        "ttft p50/p95 ms",
+        "itl p50/p95 ms",
+        "reconnects",
+        "last seen",
+    ):
+        table.add_column(col)
+    for wid in sorted(beats):
+        health = beats[wid]
+        es = health.engine_stats or {}
+        is_stale = (now - health.last_seen).total_seconds() > STALE_AFTER_S
+        occ = es.get("batch_occupancy")
+        table.add_row(
+            wid,
+            "[red]stale[/red]" if is_stale else health.status,
+            str(health.jobs_processed),
+            f"{es['tokens_per_sec']:.1f}" if "tokens_per_sec" in es else "-",
+            f"{occ:.0%}" if occ is not None else "-",
+            _fmt_pcts(es, "ttft_p50_ms", "ttft_p95_ms"),
+            _fmt_pcts(es, "itl_p50_ms", "itl_p95_ms"),
+            str(health.reconnects) if health.reconnects is not None else "-",
+            health.last_seen.strftime("%H:%M:%S"),
+        )
+    return Group(header, table)
+
+
+async def monitor_top(
+    queue: str,
+    *,
+    interval: float = 2.0,
+    iterations: Optional[int] = None,
+) -> None:
+    """`llmq-tpu monitor top`: live fleet dashboard over heartbeats —
+    fleet tok/s, occupancy, TTFT/ITL percentiles, reconnects. Runs until
+    interrupted (or for ``iterations`` refreshes when given: tests,
+    one-shot snapshots via ``--once``)."""
+    from rich.live import Live
+
+    async with BrokerManager(get_config()) as mgr:
+        count = 0
+        with Live(console=console, auto_refresh=False) as live:
+            while True:
+                beats = await _collect_heartbeats(mgr, queue)
+                stats = await mgr.get_queue_stats(queue)
+                live.update(_render_top(queue, beats, stats), refresh=True)
+                count += 1
+                if iterations is not None and count >= iterations:
+                    return
+                await asyncio.sleep(interval)
+
+
+# --- per-request trace ------------------------------------------------------
+
+async def trace_job(queue: str, job_id: str) -> None:
+    """`llmq-tpu trace <job_id>`: render the request's lifecycle timeline
+    from the trace record riding in its result message. Peeks the results
+    queue non-destructively (every message is requeued)."""
+    async with BrokerManager(get_config()) as mgr:
+        record = None
+        peeked = []
+        while True:
+            msg = await mgr.broker.get(results_queue_name(queue))
+            if msg is None:
+                break
+            peeked.append(msg)
+            try:
+                payload = json.loads(msg.body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue  # not a JSON result; skip it
+            if payload.get("id") == job_id:
+                record = payload
+                break
+        for msg in peeked:
+            await msg.reject(requeue=True)
+        if record is None:
+            console.print(
+                f"[red]✗ No result for job '{job_id}' in "
+                f"'{results_queue_name(queue)}'[/red]"
+            )
+            return
+        trace = trace_from_payload(record)
+        if trace is None:
+            console.print(
+                f"[yellow]Result for '{job_id}' carries no trace record "
+                "(submitted before tracing was deployed?)[/yellow]"
+            )
+            return
+        rows = timeline(trace)
+        redeliveries = trace.get("redeliveries", 0)
+        table = Table(
+            title=f"Trace: {job_id}"
+            + (f" ({redeliveries} redelivery(s))" if redeliveries else "")
+        )
+        for col in ("event", "wall clock (UTC)", "Δ ms", "details"):
+            table.add_column(col)
+        for row in rows:
+            wall = (
+                datetime.fromtimestamp(row["t_wall"], tz=timezone.utc)
+                .strftime("%H:%M:%S.%f")[:-3]
+                if row["t_wall"]
+                else "-"
+            )
+            delta = (
+                f"+{row['delta_s'] * 1000:.2f}"
+                if row["delta_s"] is not None
+                else ""
+            )
+            details = ", ".join(f"{k}={v}" for k, v in row["extras"].items())
+            table.add_row(row["name"], wall, delta, details)
+        console.print(table)
+        if len(rows) > 1 and rows[0]["t_wall"] and rows[-1]["t_wall"]:
+            total_ms = (rows[-1]["t_wall"] - rows[0]["t_wall"]) * 1000.0
+            console.print(
+                f"total {total_ms:.1f} ms across {len(rows)} events"
+            )

@@ -146,6 +146,12 @@ class WorkerHealth(BaseModel):
         description="Broker session reconnects survived (ResilientBroker "
         "session stats); None for pre-resilience workers.",
     )
+    metrics: Optional[Dict[str, Any]] = Field(
+        None,
+        description="Compact metrics-registry summary (counters/gauges as "
+        "numbers, histograms as ms-scaled percentile dicts); None for "
+        "pre-observability workers.",
+    )
 
 
 class ErrorInfo(BaseModel):

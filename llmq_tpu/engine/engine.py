@@ -92,12 +92,24 @@ from llmq_tpu.engine.scheduler import (
 from llmq_tpu.engine.tokenizer import Tokenizer
 from llmq_tpu.models.config import ModelConfig
 from llmq_tpu.models.transformer import Params, Transformer, make_kv_pages
+from llmq_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Gauge,
+    Histogram,
+    get_registry,
+    to_ms,
+)
 from llmq_tpu.ops import dispatch as _dispatch
 from llmq_tpu.ops.attention import mixed_query_grid
 from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
 from llmq_tpu.parallel.sharding import kv_page_pspec, param_shardings
 
 logger = logging.getLogger(__name__)
+
+#: ITL needs a finer low end than the default latency buckets: tokens of
+#: one fused decode block reach the host in a burst, so sub-ms gaps are
+#: the common case there.
+ITL_BUCKETS: Tuple[float, ...] = (0.0001, 0.00025, 0.0005) + DEFAULT_BUCKETS
 
 
 @dataclasses.dataclass
@@ -110,6 +122,11 @@ class RequestOutput:
     prompt_tokens: int
     completion_tokens: int
     finish_reason: str  # "stop" | "length"
+    # Host-side monotonic lifecycle stamps (enqueued/admitted/
+    # prefill_start/first_token/last_token/finished + preempt_count),
+    # filled when the engine recorded them; workers project these onto
+    # the request trace. None for sequences that predate instrumentation.
+    timing: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -508,6 +525,53 @@ class EngineCore:
         self.mixed_steps = 0  # fused decode+prefill dispatches
         self.mixed_prefill_tokens = 0  # prompt positions piggybacked
         self._started_at = time.monotonic()
+
+        # Observability: host-side only — a histogram record is a bucket
+        # increment, never inside jitted code. Per-engine instances
+        # (not registry get-or-create) so stats() percentiles never mix
+        # across the many engines a test process builds; `register`
+        # replaces same-named series, so the latest engine owns the
+        # exported ones (one engine per worker process in production).
+        self.ttft_hist = Histogram(
+            "llmq_ttft_seconds", "Enqueue-to-first-token latency"
+        )
+        self.itl_hist = Histogram(
+            "llmq_itl_seconds",
+            "Inter-token latency at the host boundary",
+            buckets=ITL_BUCKETS,
+        )
+        self._dispatch_rings: Dict[str, Deque[float]] = {}
+        self._dispatch_hists: Dict[str, Histogram] = {}
+        reg = get_registry()
+        for metric in (
+            self.ttft_hist,
+            self.itl_hist,
+            self.scheduler.queue_wait_hist,
+            self.scheduler.preempt_delay_hist,
+            Gauge(
+                "llmq_engine_tokens_per_sec",
+                "Generated tokens per second since engine start",
+                fn=lambda: self.total_generated_tokens
+                / max(1e-9, time.monotonic() - self._started_at),
+            ),
+            Gauge(
+                "llmq_engine_kv_page_utilization",
+                "Fraction of the KV page pool in use",
+                fn=lambda: (
+                    (self.scheduler.config.num_pages - 1)
+                    - self.scheduler.allocator.available
+                )
+                / max(1, self.scheduler.config.num_pages - 1),
+            ),
+            Gauge(
+                "llmq_engine_batch_occupancy",
+                "Fraction of decode slots holding a running sequence",
+                fn=lambda: len(self.scheduler.running)
+                / max(1, self.cfg.max_num_seqs),
+            ),
+        ):
+            reg.register(metric)
+
         self._resync()
         if os.environ.get("LLMQ_PARAM_AUTO_LAYOUT", "0") == "1":
             self._optimize_param_layouts()
@@ -1544,12 +1608,17 @@ class EngineCore:
                 chunk_args = jax.device_put(
                     (tokens, positions, bt, final, last), (repl,) * 5
                 )
+                t0 = time.monotonic()
+                for seq in rows:
+                    if seq.t_prefill_start == 0.0:
+                        seq.t_prefill_start = t0
                 out, self.k_pages, self.v_pages, self._dev_state = (
                     self._chunkfill_jits[chunk_mode](
                         self.params, self.k_pages, self.v_pages,
                         *chunk_args, *inv, self._dev_state,
                     )
                 )
+                self._record_dispatch("prefill", time.monotonic() - t0)
                 if snapshot:  # rows whose prompt finished in this chunk
                     for _, seq in snapshot:
                         seq.prefilled = True
@@ -1686,12 +1755,16 @@ class EngineCore:
                 # The executable must cover the piggy's sampler needs as
                 # well as the batch's (its first token samples here).
                 mode = sampling_mod.join_modes((self._mode, seq_mode))
+                t0 = time.monotonic()
+                if seq.t_prefill_start == 0.0:
+                    seq.t_prefill_start = t0
                 out, self.k_pages, self.v_pages, self._dev_state = (
                     self._mixedfill_jits[mode](
                         self.params, self.k_pages, self.v_pages,
                         *seg_args, *inv, self._dev_state,
                     )
                 )
+                self._record_dispatch("mixed", time.monotonic() - t0)
                 self.mixed_steps += 1
                 self.mixed_prefill_tokens += sum(t for _, t in segs)
                 self.decode_steps += K
@@ -1777,9 +1850,14 @@ class EngineCore:
         chunk_mode = sampling_mod.join_modes(
             sampling_mod.required_mode(s.params) for s in chunk
         )
+        t0 = time.monotonic()
+        for seq in chunk:
+            if seq.t_prefill_start == 0.0:
+                seq.t_prefill_start = t0
         out, self.k_pages, self.v_pages, self._dev_state = self._prefill_jits[
             chunk_mode
         ](self.params, self.k_pages, self.v_pages, *args, self._dev_state)
+        self._record_dispatch("prefill", time.monotonic() - t0)
         for seq in chunk:
             seq.prefilled = True
         self.prefills += len(chunk)
@@ -1890,12 +1968,35 @@ class EngineCore:
             self._resync()
         return True
 
+    def _record_dispatch(self, kind: str, seconds: float) -> None:
+        """Record the host wall-time of one device dispatch call into the
+        per-kind ring buffer + histogram. Dispatch is asynchronous, so
+        this measures the host-side launch cost, not device execution —
+        spikes mean the host blocked on the device (pipeline stalls)."""
+        ring = self._dispatch_rings.get(kind)
+        if ring is None:
+            ring = self._dispatch_rings[kind] = deque(maxlen=256)
+            hist = Histogram(
+                "llmq_dispatch_seconds",
+                "Host wall-time of one device dispatch call",
+                labels={"kind": kind},
+            )
+            self._dispatch_hists[kind] = hist
+            get_registry().register(hist)
+        ring.append(seconds)
+        self._dispatch_hists[kind].observe(seconds)
+
     def _dispatch_decode(self, finished: List[RequestOutput]) -> None:
         if not self._ensure_decode_pages(finished):
             return
+        t0 = time.monotonic()
         out, self.k_pages, self.v_pages, self._dev_state = self._decode_jits[
             self._mode
         ](self.params, self.k_pages, self.v_pages, self._dev_state)
+        self._record_dispatch(
+            "verify" if self.cfg.spec_tokens > 0 else "decode_block",
+            time.monotonic() - t0,
+        )
         self.decode_steps += self.cfg.decode_block
         self.decode_dispatches += 1
         self._push_pending(
@@ -1949,6 +2050,17 @@ class EngineCore:
     ) -> None:
         seq.output_ids.append(token)
         self.total_generated_tokens += 1
+        now = time.monotonic()
+        if seq.t_first_token == 0.0:
+            seq.t_first_token = now
+            if seq.t_enqueue > 0.0:
+                self.ttft_hist.observe(now - seq.t_enqueue)
+        elif seq.t_last_token > 0.0:
+            # Host-boundary gap: tokens of one fused decode block arrive
+            # in a burst, so sub-ms gaps are expected there (the
+            # fine-grained ITL_BUCKETS low end exists for exactly this).
+            self.itl_hist.observe(now - seq.t_last_token)
+        seq.t_last_token = now
         # Stops are checked BEFORE the page top-up: a stopping sequence
         # needs no more pages, and the pool-pressure retry below must not
         # swallow a stop/budget finish (a preempted-at-budget row would
@@ -2086,6 +2198,17 @@ class EngineCore:
         text = seq.finish_text
         if text is None:
             text = self.tokenizer.decode(seq.output_ids)
+        timing: Optional[Dict[str, float]] = None
+        if seq.t_enqueue > 0.0:
+            timing = {
+                "enqueued": seq.t_enqueue,
+                "admitted": seq.t_admit,
+                "prefill_start": seq.t_prefill_start,
+                "first_token": seq.t_first_token,
+                "last_token": seq.t_last_token,
+                "finished": time.monotonic(),
+                "preempt_count": float(seq.preempt_count),
+            }
         return RequestOutput(
             rid=seq.rid,
             text=text,
@@ -2093,6 +2216,7 @@ class EngineCore:
             prompt_tokens=len(seq.prompt_ids),
             completion_tokens=len(seq.output_ids),
             finish_reason=seq.finish_reason or "stop",
+            timing=timing,
         )
 
     def abort_all(self, note: str = "aborted") -> None:
@@ -2182,6 +2306,23 @@ class EngineCore:
             # Resolved at build time (env pin / config / autotune) — may
             # differ from cfg.tp_overlap ("auto", or forced off on tp=1).
             tp_overlap=self.tp_overlap,
+            # Latency percentiles (ms; None until the histogram has data)
+            # and per-kind recent dispatch wall-times from the 256-entry
+            # ring buffers.
+            ttft_p50_ms=to_ms(self.ttft_hist.percentile(0.50)),
+            ttft_p95_ms=to_ms(self.ttft_hist.percentile(0.95)),
+            ttft_p99_ms=to_ms(self.ttft_hist.percentile(0.99)),
+            itl_p50_ms=to_ms(self.itl_hist.percentile(0.50)),
+            itl_p95_ms=to_ms(self.itl_hist.percentile(0.95)),
+            itl_p99_ms=to_ms(self.itl_hist.percentile(0.99)),
+            dispatch_ms={
+                kind: {
+                    "recent_avg": round(sum(ring) / len(ring) * 1000.0, 3),
+                    "count": self._dispatch_hists[kind].total,
+                }
+                for kind, ring in self._dispatch_rings.items()
+                if ring
+            },
         )
         if self.cfg.spec_tokens > 0:
             # What speculation actually dispatches: the multi-query

@@ -23,14 +23,21 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.obs.metrics import Histogram
 
 
 class OutOfPages(Exception):
     """No free KV pages; caller should preempt or defer."""
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    """Histogram percentile (seconds) → rounded ms for stats dicts."""
+    return None if seconds is None else round(seconds * 1000.0, 3)
 
 
 def mixed_token_budget(
@@ -185,6 +192,15 @@ class Sequence:
     # truncated past detok_len.
     detok_len: int = 0
     detok_text: str = ""
+    # Host-side lifecycle stamps (time.monotonic(); 0.0 = not yet).
+    # These feed the queue-wait / TTFT / ITL histograms and the
+    # per-request trace record; they never influence scheduling.
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_prefill_start: float = 0.0
+    t_first_token: float = 0.0
+    t_last_token: float = 0.0
+    t_preempt: float = 0.0
 
     @property
     def num_tokens(self) -> int:
@@ -229,6 +245,16 @@ class Scheduler:
         self.prefix_hits = 0  # pages reused via the cache (stats)
         self.preemptions = 0  # recompute preemptions (stats)
         self.allocator.on_evict = self._drop_page_hashes
+        # Per-scheduler latency histograms (the owning engine registers
+        # them into the process-wide registry for /metrics export).
+        self.queue_wait_hist = Histogram(
+            "llmq_queue_wait_seconds",
+            "Enqueue-to-first-admission wait per request",
+        )
+        self.preempt_delay_hist = Histogram(
+            "llmq_preemption_delay_seconds",
+            "Preemption-to-readmission delay per recompute preemption",
+        )
 
     # --- prefix caching ---------------------------------------------------
     def _prefix_hashes(self, prompt_ids: List[int]) -> List[bytes]:
@@ -323,6 +349,8 @@ class Scheduler:
                 f"{self._pages_needed(seq.num_tokens)} KV pages; pool has "
                 f"{self.config.num_pages - 1}"
             )
+        if seq.t_enqueue == 0.0:
+            seq.t_enqueue = time.monotonic()
         self.waiting.append(seq)
 
     @property
@@ -377,6 +405,13 @@ class Scheduler:
             seq.slot = free_slots.pop(0)
             seq.admitted_at = self._tick
             self._tick += 1
+            now = time.monotonic()
+            if seq.t_preempt > 0.0:  # re-admission after a preemption
+                self.preempt_delay_hist.observe(now - seq.t_preempt)
+                seq.t_preempt = 0.0
+            elif seq.t_enqueue > 0.0 and seq.t_admit == 0.0:
+                self.queue_wait_hist.observe(now - seq.t_enqueue)
+            seq.t_admit = now
             self.slots[seq.slot] = seq
             self.running[seq.rid] = seq
             admitted.append(seq)
@@ -463,6 +498,7 @@ class Scheduler:
             seq.cacheable_pages = 0
         self._release(seq)
         seq.preempt_count += 1
+        seq.t_preempt = time.monotonic()
         self.preemptions += 1
         seq.prefilled = False  # KV is gone; re-admission re-prefills
         self.waiting.appendleft(seq)
@@ -516,6 +552,11 @@ class Scheduler:
             / max(1, total_pages),
             "preemptions": self.preemptions,
         }
+        qw = self.queue_wait_hist
+        pd = self.preempt_delay_hist
+        out["queue_wait_p50_ms"] = _ms(qw.percentile(0.50))
+        out["queue_wait_p95_ms"] = _ms(qw.percentile(0.95))
+        out["preemption_delay_p50_ms"] = _ms(pd.percentile(0.50))
         if self.config.enable_prefix_caching:
             out["prefix_cache_hit_pages"] = self.prefix_hits
         return out

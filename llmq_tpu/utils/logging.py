@@ -2,15 +2,23 @@
 
 Workers log JSON lines to stdout (machine-tailable, ``| jq .``); CLI commands
 log human-readable lines to stderr so stdout stays clean for JSONL results.
+``LLMQ_LOG_FORMAT=json`` forces the structured format everywhere (e.g. when
+shipping CLI logs to a collector); structured records carry ``worker_id`` /
+``job_id`` / ``trace_id`` whenever the logging call attached them.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 from datetime import datetime, timezone
-from typing import Optional
+from typing import Any, Dict, MutableMapping, Optional, Tuple
+
+#: Correlation attrs promoted into structured entries when present on a
+#: record (set via ``extra={...}`` or :class:`ContextLogAdapter`).
+CONTEXT_FIELDS = ("worker_id", "job_id", "trace_id")
 
 
 class JsonLineFormatter(logging.Formatter):
@@ -21,6 +29,10 @@ class JsonLineFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        for field in CONTEXT_FIELDS:
+            value = getattr(record, field, None)
+            if value is not None:
+                entry[field] = value
         if record.exc_info:
             entry["exc"] = self.formatException(record.exc_info)
         extra = getattr(record, "extra_fields", None)
@@ -29,11 +41,29 @@ class JsonLineFormatter(logging.Formatter):
         return json.dumps(entry, default=str)
 
 
+class ContextLogAdapter(logging.LoggerAdapter):
+    """LoggerAdapter that MERGES its bound context into each call's
+    ``extra`` (the stock adapter replaces per-call extras wholesale, so a
+    worker-bound adapter would silently drop ``job_id`` passed at a call
+    site). Per-call keys win over bound ones."""
+
+    def process(
+        self, msg: str, kwargs: MutableMapping[str, Any]
+    ) -> Tuple[str, MutableMapping[str, Any]]:
+        merged: Dict[str, Any] = dict(self.extra or {})
+        merged.update(kwargs.get("extra") or {})
+        kwargs["extra"] = merged
+        return msg, kwargs
+
+
 def setup_logging(
     *, structured: bool = False, level: Optional[str] = None
 ) -> None:
     """Configure root logging. ``structured=True`` → JSON lines on stdout
-    (worker mode); else human format on stderr (CLI mode)."""
+    (worker mode); else human format on stderr (CLI mode).
+    ``LLMQ_LOG_FORMAT=json`` forces structured regardless of the caller."""
+    if os.environ.get("LLMQ_LOG_FORMAT", "").lower() == "json":
+        structured = True
     if level is None:
         from llmq_tpu.core.config import get_config
 

@@ -40,6 +40,16 @@ from llmq_tpu.broker.manager import FAILED_SUFFIX, BrokerManager
 from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
+from llmq_tpu.obs import (
+    TRACE_FIELD,
+    emit_trace_event,
+    get_registry,
+    maybe_start_exporter,
+    new_trace,
+    trace_event,
+    trace_from_payload,
+)
+from llmq_tpu.utils.logging import ContextLogAdapter
 
 HEALTH_SUFFIX = ".health"
 HEALTH_TTL_MS = 120_000
@@ -62,7 +72,12 @@ class BaseWorker(abc.ABC):
         self.pipeline = pipeline
         self.stage_name = stage_name
         self.worker_id = self._generate_worker_id()
-        self.logger = logging.getLogger(f"worker.{self.worker_id}")
+        # Structured log records (LLMQ_LOG_FORMAT=json) carry worker_id
+        # on every line; call sites add job_id via extra={...}.
+        self.logger = ContextLogAdapter(
+            logging.getLogger(f"worker.{self.worker_id}"),
+            {"worker_id": self.worker_id},
+        )
         self.broker = BrokerManager(self.config)
         self.running = False
         self.jobs_processed = 0
@@ -73,6 +88,10 @@ class BaseWorker(abc.ABC):
         self._in_flight = 0
         self._drained = asyncio.Event()
         self._drained.set()
+        # Live request traces, keyed by job id, so processors (e.g. the
+        # TPU worker) can attach engine lifecycle events to the record
+        # that rides back in the Result.
+        self._job_traces: dict = {}
 
     # --- abstract surface (reference base.py:57-75) -----------------------
     @abc.abstractmethod
@@ -90,6 +109,9 @@ class BaseWorker(abc.ABC):
     # --- lifecycle --------------------------------------------------------
     async def initialize(self) -> None:
         self.logger.info("Initializing worker %s", self.worker_id)
+        # Opt-in Prometheus endpoint (LLMQ_METRICS_PORT); serves the
+        # process-wide registry the engine/scheduler/broker record into.
+        maybe_start_exporter()
         await self._initialize_processor()
         await self.broker.connect()
         if self.pipeline is not None:
@@ -124,9 +146,11 @@ class BaseWorker(abc.ABC):
                 self.queue,
                 self.concurrency,
             )
-            last_beat = 0.0
+            # Monotonic clock for the beat cadence: wall time steps (NTP
+            # slews, manual clock sets) must not skip or double beats.
+            last_beat = time.monotonic() - HEARTBEAT_INTERVAL_S
             while self.running:
-                now = time.time()
+                now = time.monotonic()
                 if now - last_beat >= HEARTBEAT_INTERVAL_S:
                     # Heartbeats pause during a broker outage (publishing
                     # them would just park stale liveness claims in the
@@ -179,10 +203,34 @@ class BaseWorker(abc.ABC):
             await self._dead_letter_unparseable(message, exc)
             self._settle_in_flight()
             return
+        # Lifecycle trace: continue the submit-time record riding in the
+        # job payload (or start one for jobs submitted without tracing).
+        # A redelivered message re-reads the ORIGINAL payload, so events
+        # stamped by a failed attempt never duplicate; the attempt count
+        # survives as the broker's delivery_count.
+        trace = trace_from_payload(job.extras()) or new_trace(job.id)
+        # delivery_count counts PRIOR attempts (0 on first delivery — see
+        # DeliveredMessage.redelivered), so it is the redelivery count.
+        trace["redeliveries"] = message.delivery_count
+        trace_event(
+            trace,
+            "claimed",
+            worker_id=self.worker_id,
+            delivery_count=message.delivery_count,
+        )
+        emit_trace_event(job.id, "claimed", worker_id=self.worker_id)
+        self._job_traces[job.id] = trace
         try:
             output = await self._run_with_timeout(job)
             duration_ms = (time.monotonic() - start) * 1000
-            result = self._build_result(job, output, duration_ms)
+            trace_event(trace, "finished", duration_ms=round(duration_ms, 3))
+            emit_trace_event(
+                job.id,
+                "finished",
+                worker_id=self.worker_id,
+                duration_ms=round(duration_ms, 3),
+            )
+            result = self._build_result(job, output, duration_ms, trace=trace)
             await self._publish_result(result)
             await message.ack()
             self.jobs_processed += 1
@@ -205,12 +253,23 @@ class BaseWorker(abc.ABC):
             )
             self.jobs_failed += 1
             self.jobs_timed_out += 1
+            emit_trace_event(
+                job.id, "requeued", worker_id=self.worker_id, reason="timeout"
+            )
             await message.reject(requeue=True)
         except ValueError as exc:
             # Job is semantically invalid — retrying can't fix it. Ack &
             # drop (reference base.py:228-235).
-            self.logger.error("Job %s invalid, dropping: %s", job.id, exc)
+            self.logger.error(
+                "Job %s invalid, dropping: %s",
+                job.id,
+                exc,
+                extra={"job_id": job.id},
+            )
             self.jobs_failed += 1
+            emit_trace_event(
+                job.id, "dropped", worker_id=self.worker_id, reason=str(exc)
+            )
             await message.ack()
         except Exception as exc:  # noqa: BLE001 — transient: requeue
             self.logger.warning(
@@ -218,10 +277,15 @@ class BaseWorker(abc.ABC):
                 job.id,
                 message.delivery_count,
                 exc,
+                extra={"job_id": job.id},
             )
             self.jobs_failed += 1
+            emit_trace_event(
+                job.id, "requeued", worker_id=self.worker_id, reason=str(exc)
+            )
             await message.reject(requeue=True)
         finally:
+            self._job_traces.pop(job.id, None)
             self._settle_in_flight()
 
     async def _run_with_timeout(self, job: Job) -> str:
@@ -242,6 +306,12 @@ class BaseWorker(abc.ABC):
         headers["x-error"] = f"unparseable job payload: {exc}"
         headers["x-worker-id"] = self.worker_id
         headers.setdefault("x-death-queue", self.queue)
+        emit_trace_event(
+            message.message_id or "unparseable",
+            "dead_lettered",
+            worker_id=self.worker_id,
+            reason=str(exc),
+        )
         try:
             await self.broker.broker.publish(
                 self.queue + FAILED_SUFFIX,
@@ -261,7 +331,13 @@ class BaseWorker(abc.ABC):
         if self._in_flight <= 0:
             self._drained.set()
 
-    def _build_result(self, job: Job, output: str, duration_ms: float) -> Result:
+    def _build_result(
+        self,
+        job: Job,
+        output: str,
+        duration_ms: float,
+        trace: Optional[dict] = None,
+    ) -> Result:
         """Result with extra-field passthrough (reference base.py:164-186).
 
         Built dict-first so a job extra named like a Result field (e.g. a
@@ -284,6 +360,10 @@ class BaseWorker(abc.ABC):
             if key in payload:
                 payload[f"job_{key}"] = payload.pop(key)
         payload.update(reserved)
+        if trace is not None:
+            # The accumulated record (submit-time events + this worker's)
+            # supersedes the job-carried copy in the passthrough.
+            payload[TRACE_FIELD] = trace
         return Result.model_validate(payload)
 
     async def _publish_result(self, result: Result) -> None:
@@ -310,6 +390,7 @@ class BaseWorker(abc.ABC):
             queue=self.queue,
             engine_stats=self._engine_stats(),
             reconnects=stats.reconnects if stats is not None else None,
+            metrics=get_registry().summary() or None,
         )
         try:
             await self.broker.broker.publish(

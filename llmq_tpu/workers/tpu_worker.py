@@ -23,6 +23,7 @@ from pathlib import Path
 from typing import Optional
 
 from llmq_tpu.core.models import Job
+from llmq_tpu.obs import trace_event_at
 from llmq_tpu.workers.base import BaseWorker
 
 PRESET_SCHEMES = ("preset://", "dummy://", "random://")
@@ -383,10 +384,40 @@ class TPUWorker(BaseWorker):
             "prompt_tokens": out.prompt_tokens,
             "completion_tokens": out.completion_tokens,
         }
+        self._trace_engine_timing(job.id, out)
         return out.text
 
-    def _build_result(self, job: Job, output: str, duration_ms: float):
-        result = super()._build_result(job, output, duration_ms)
+    def _trace_engine_timing(self, job_id: str, out) -> None:
+        """Backfill the engine's monotonic lifecycle stamps into the
+        request trace (claimed → tokenized → prefill_start → first_token
+        → decode → finished). Host-side dict writes only."""
+        trace = self._job_traces.get(job_id)
+        timing = getattr(out, "timing", None)
+        if trace is None or not timing:
+            return
+        trace_event_at(trace, "tokenized", timing.get("enqueued"))
+        trace_event_at(trace, "admitted", timing.get("admitted"))
+        trace_event_at(trace, "prefill_start", timing.get("prefill_start"))
+        trace_event_at(trace, "first_token", timing.get("first_token"))
+        preempts = int(timing.get("preempt_count", 0))
+        trace_event_at(
+            trace,
+            "decode",
+            timing.get("last_token"),
+            tokens=out.completion_tokens,
+            preempt_count=preempts,
+        )
+        if preempts:
+            # No per-preemption stamp survives readmission; record the
+            # fact (and count) at the time decoding completed.
+            trace_event_at(
+                trace, "preempted", timing.get("last_token"), count=preempts
+            )
+
+    def _build_result(
+        self, job: Job, output: str, duration_ms: float, trace=None
+    ):
+        result = super()._build_result(job, output, duration_ms, trace=trace)
         usage = self._usage.pop(job.id, None)
         if usage is not None:
             result.usage = usage

@@ -1,0 +1,94 @@
+"""wallclock-duration: time.time() differences used as durations."""
+
+import time
+from time import time as wall_now
+
+
+def bad_direct_subtraction():
+    start = time.time()
+    do_work()
+    return time.time() - start  # EXPECT[wallclock-duration]
+
+
+def bad_two_samples():
+    t0 = time.time()
+    do_work()
+    t1 = time.time()
+    elapsed = t1 - t0  # EXPECT[wallclock-duration]
+    return elapsed
+
+
+def bad_through_assignment_chain():
+    t0 = time.time()
+    start = t0
+    do_work()
+    return time.time() - start  # EXPECT[wallclock-duration]
+
+
+def bad_from_import_alias():
+    start = wall_now()
+    do_work()
+    return wall_now() - start  # EXPECT[wallclock-duration]
+
+
+def bad_heartbeat_cadence():
+    last_beat = time.time()
+    while still_running():
+        now = time.time()
+        if now - last_beat >= 30.0:  # EXPECT[wallclock-duration]
+            beat()
+            last_beat = now
+
+
+def good_monotonic():
+    start = time.monotonic()
+    do_work()
+    return time.monotonic() - start
+
+
+def good_perf_counter():
+    t0 = time.perf_counter()
+    do_work()
+    return time.perf_counter() - t0
+
+
+def good_persisted_stamp_age(msg):
+    # Cross-process age: the enqueue stamp was written by another host, so
+    # wall clocks are the only shared timebase (the broker's TTL math).
+    return time.time() - msg.enqueued_at
+
+
+def good_parameter_deadline(deadline_ts):
+    return deadline_ts - time.time()
+
+
+def good_wall_stamp_not_duration():
+    # A single sample used as a timestamp, not a duration.
+    return {"timestamp": time.time()}
+
+
+def good_scope_is_per_function():
+    # Taint does not leak across functions: `outer_start` is a module-ish
+    # name here, not a local time.time() sample.
+    return time.time() - outer_start
+
+
+def suppressed():
+    start = time.time()
+    do_work()
+    return time.time() - start  # llmq: ignore[wallclock-duration]
+
+
+def do_work():
+    pass
+
+
+def still_running():
+    return False
+
+
+def beat():
+    pass
+
+
+outer_start = 0.0

@@ -157,3 +157,63 @@ class TestChaosSoak:
             finally:
                 worker.request_shutdown()
                 await asyncio.wait_for(task, timeout=30.0)
+
+
+class TestChaosTrace:
+    async def test_trace_survives_redelivery(self, mem_ns):
+        """A job whose first processing attempt fails is redelivered; its
+        result must still carry the lifecycle trace, with ``redeliveries``
+        counting the failed attempt and NO duplicated lifecycle events —
+        the redelivered message re-reads the original payload, so the
+        failed attempt's events never stack."""
+        from llmq_tpu.obs import trace_from_payload
+
+        plain_cfg = Config(
+            broker_url=f"memory://{mem_ns}", max_redeliveries=1000
+        )
+
+        class FlakyWorker(DummyWorker):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self.attempts = 0
+
+            async def _process_job(self, job):
+                self.attempts += 1
+                if self.attempts == 1:
+                    raise RuntimeError("injected first-attempt failure")
+                return await super()._process_job(job)
+
+        async with BrokerManager(plain_cfg) as mgr:
+            await mgr.setup_queue_infrastructure("trq")
+            await mgr.publish_job("trq", Job(id="t0", prompt="hello"))
+            worker = FlakyWorker("trq", delay=0, config=plain_cfg)
+            task = asyncio.ensure_future(worker.run())
+            try:
+                payload = None
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while payload is None:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "result never arrived after redelivery"
+                    )
+                    msg = await mgr.broker.get("trq.results")
+                    if msg is None:
+                        await asyncio.sleep(0.02)
+                        continue
+                    payload = json.loads(msg.body)
+                    await msg.ack()
+            finally:
+                worker.request_shutdown()
+                await asyncio.wait_for(task, timeout=30.0)
+
+        assert worker.attempts == 2
+        trace = trace_from_payload(payload)
+        assert trace is not None, "result lost its trace across redelivery"
+        assert trace["redeliveries"] == 1
+        names = [e["name"] for e in trace["events"]]
+        # Exactly one of each lifecycle event: the failed first attempt's
+        # claim was stamped on a copy that died with the requeue.
+        assert names == ["submitted", "claimed", "finished"]
+        claimed = next(e for e in trace["events"] if e["name"] == "claimed")
+        assert claimed["delivery_count"] == 1
+        walls = [e["t_wall"] for e in trace["events"]]
+        assert walls == sorted(walls)

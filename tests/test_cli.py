@@ -173,3 +173,162 @@ def test_submit_progress_tty_rendering(monkeypatch):
     with _SubmitProgress(stream=False, total=None) as p:
         assert p._rich is None
         p.submitted(7)  # plain \r counter path
+
+
+async def test_requeue_errors_reports_remaining(mem_url, monkeypatch, capsys):
+    """`errors --requeue --limit N` reports how many jobs are STILL
+    dead-lettered after a bounded requeue, so the operator knows to raise
+    the limit instead of assuming the DLQ drained."""
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli.monitor import requeue_errors
+    from llmq_tpu.core.config import Config
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("rq")
+        for i in range(3):
+            await mgr.broker.publish(
+                "rq.failed",
+                json.dumps({"id": f"f{i}", "prompt": "p"}).encode(),
+                message_id=f"f{i}",
+            )
+        await requeue_errors("rq", limit=1)
+    out = capsys.readouterr().out
+    assert "Requeued 1 failed job(s)" in out
+    assert "2 still dead-lettered" in out
+    assert "--limit" in out
+
+
+async def test_pipeline_status_classification(
+    mem_url, monkeypatch, tmp_path, capsys
+):
+    """Pipeline status classifies stages: jobs waiting with no consumers
+    -> NO WORKERS; a deep ready backlog behind a live consumer ->
+    BACKLOG."""
+    import asyncio
+
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli import monitor as monitor_mod
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.core.pipeline import load_pipeline_config
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    monkeypatch.setattr(monitor_mod, "BACKLOG_WARN_THRESHOLD", 2)
+    yaml_path = tmp_path / "pipe.yaml"
+    yaml_path.write_text(
+        "name: clipipe\n"
+        "stages:\n"
+        "  - name: first\n"
+        "    worker: dummy\n"
+        "  - name: second\n"
+        "    worker: dummy\n"
+    )
+    pipeline = load_pipeline_config(str(yaml_path))
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_pipeline_infrastructure(pipeline)
+        q1 = pipeline.get_stage_queue_name("first")
+        q2 = pipeline.get_stage_queue_name("second")
+        for i in range(3):
+            await mgr.broker.publish(q1, b"{}", message_id=f"a{i}")
+        for i in range(5):
+            await mgr.broker.publish(q2, b"{}", message_id=f"b{i}")
+
+        async def hold(msg):  # a consumer that never settles anything
+            await asyncio.Event().wait()
+
+        tag = await mgr.broker.consume(q2, hold, prefetch=1)
+        await asyncio.sleep(0.05)  # let the consumer claim one message
+        await monitor_mod.show_pipeline_status(str(yaml_path))
+        await mgr.broker.cancel(tag)
+    out = capsys.readouterr().out
+    assert "NO WORKERS" in out
+    assert "BACKLOG" in out
+    assert "no workers" in out  # the per-stage warning line
+    assert "flow:" in out
+
+
+async def test_trace_command_renders_timeline(mem_url, monkeypatch, capsys):
+    """`llmq-tpu trace <job_id>` finds the result on the results queue and
+    renders the lifecycle timeline; results without a trace get the
+    explanatory fallback instead of a crash."""
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli.monitor import trace_job
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.obs import TRACE_FIELD, new_trace, trace_event
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    trace = new_trace("tj1")
+    trace_event(trace, "submitted", queue="tq")
+    trace_event(trace, "claimed", worker_id="w1")
+    trace_event(trace, "finished", duration_ms=12.5)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("tq")
+        await mgr.broker.publish(
+            "tq.results",
+            json.dumps(
+                {"id": "tj1", "result": "out", TRACE_FIELD: trace}
+            ).encode(),
+            message_id="tj1",
+        )
+        await mgr.broker.publish(
+            "tq.results",
+            json.dumps({"id": "traceless", "result": "out"}).encode(),
+            message_id="traceless",
+        )
+        await trace_job("tq", "tj1")
+        await trace_job("tq", "traceless")
+        await trace_job("tq", "missing")
+    out = capsys.readouterr().out
+    assert "Trace: tj1" in out
+    for name in ("submitted", "claimed", "finished"):
+        assert name in out
+    assert "total" in out and "3 events" in out
+    assert "carries no trace record" in out
+    assert "No result for job 'missing'" in out
+
+
+async def test_monitor_top_once_renders_fleet(mem_url, monkeypatch, capsys):
+    """`llmq-tpu monitor top --once` renders one dashboard frame: fleet
+    summary from fresh heartbeats plus per-worker TTFT/ITL percentiles."""
+    from llmq_tpu.broker.manager import BrokerManager
+    from llmq_tpu.cli.monitor import monitor_top
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.core.models import WorkerHealth, utcnow
+    from llmq_tpu.workers.base import HEALTH_SUFFIX
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("mq")
+        await mgr.broker.declare_queue(
+            "mq" + HEALTH_SUFFIX, max_redeliveries=10**9
+        )
+        health = WorkerHealth(
+            worker_id="w-top",
+            status="running",
+            last_seen=utcnow(),
+            jobs_processed=9,
+            queue="mq",
+            reconnects=1,
+            engine_stats={
+                "tokens_per_sec": 123.4,
+                "batch_occupancy": 0.5,
+                "ttft_p50_ms": 40.0,
+                "ttft_p95_ms": 90.0,
+                "itl_p50_ms": 3.0,
+                "itl_p95_ms": 7.0,
+            },
+        )
+        await mgr.broker.publish(
+            "mq" + HEALTH_SUFFIX, health.model_dump_json().encode("utf-8")
+        )
+        await monitor_top("mq", iterations=1)
+    out = capsys.readouterr().out
+    assert "w-top" in out
+    assert "123.4" in out
+    assert "40/90" in out
+    assert "3/7" in out
+    assert "fleet" in out and "fresh worker(s)" in out

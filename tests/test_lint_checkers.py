@@ -35,6 +35,7 @@ CASES = [
     ("cancellation_cases.py", {"cancelled-swallow"}),
     ("jax_cases.py", {"jax-host-sync", "jax-donate"}),
     ("collective_axis_cases.py", {"collective-axis"}),
+    ("wallclock_cases.py", {"wallclock-duration"}),
 ]
 
 

@@ -1,0 +1,247 @@
+"""Unit tests for the observability plane: metrics, traces, exporter.
+
+Everything here is host-side and dependency-free, so the whole module is
+fast-tier. The exporter tests bind ephemeral ports (port 0) to stay safe
+under parallel test runs.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llmq_tpu.obs.exporter import (
+    MetricsExporter,
+    maybe_start_exporter,
+    stop_exporter,
+)
+from llmq_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    to_ms,
+)
+from llmq_tpu.obs.trace import (
+    TRACE_FIELD,
+    emit_trace_event,
+    new_trace,
+    timeline,
+    trace_event,
+    trace_event_at,
+    trace_from_payload,
+)
+
+pytestmark = pytest.mark.unit
+
+
+# --- metrics ----------------------------------------------------------------
+
+def test_counter_and_gauge():
+    c = Counter("jobs_total", "jobs")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = Gauge("depth", "queue depth")
+    g.set(7.5)
+    assert g.current() == 7.5
+
+
+def test_gauge_callback_and_exception_safety():
+    g = Gauge("live", "live value", fn=lambda: 42.0)
+    assert g.current() == 42.0
+
+    def boom():
+        raise RuntimeError("sensor gone")
+
+    g2 = Gauge("broken", "raises", fn=boom)
+    assert g2.current() == 0.0  # never propagates into a scrape
+
+
+def test_histogram_percentiles():
+    h = Histogram("lat", "latency", buckets=(0.1, 0.2, 0.4, 0.8))
+    for v in [0.05] * 50 + [0.15] * 45 + [0.7] * 5:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    # p50 lands in the first bucket, p99 in the 0.4–0.8 one.
+    assert snap["p50"] <= 0.1
+    assert 0.4 <= snap["p99"] <= 0.8
+
+
+def test_histogram_empty_snapshot():
+    h = Histogram("lat", "latency")
+    snap = h.snapshot()
+    assert snap["count"] == 0
+    assert snap["p50"] is None
+
+
+def test_to_ms():
+    assert to_ms(None) is None
+    assert to_ms(0.0015) == 1.5
+    assert to_ms(2) == 2000
+
+
+def test_registry_get_or_create_vs_replace():
+    reg = MetricsRegistry()
+    a = reg.counter("c", "help")
+    b = reg.counter("c", "help")
+    assert a is b  # get-or-create: process-wide singleton
+    h1 = Histogram("h", "help")
+    h2 = Histogram("h", "help")
+    reg.register(h1)
+    reg.register(h2)  # replace semantics for per-engine metrics
+    assert reg.render_prometheus().count("# TYPE h histogram") == 1
+
+
+def test_render_prometheus_format():
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "requests", labels={"queue": "q1"}).inc(3)
+    reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0)).observe(0.05)
+    text = reg.render_prometheus()
+    assert '# HELP requests_total requests' in text
+    assert '# TYPE requests_total counter' in text
+    assert 'requests_total{queue="q1"} 3' in text
+    # Histogram renders cumulative buckets, +Inf, _sum and _count.
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_count 1" in text
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            float(line.rpartition(" ")[2])  # every sample line parses
+
+
+def test_summary_scales_to_ms():
+    reg = MetricsRegistry()
+    reg.histogram("ttft_seconds", "ttft").observe(0.5)
+    summary = reg.summary()
+    assert "ttft_seconds_ms" in summary
+    assert summary["ttft_seconds_ms"]["count"] == 1
+    assert summary["ttft_seconds_ms"]["p50"] == pytest.approx(500.0, rel=0.5)
+
+
+# --- exporter ---------------------------------------------------------------
+
+def test_exporter_serves_metrics_and_404():
+    reg = MetricsRegistry()
+    reg.counter("up", "probe").inc()
+    exp = MetricsExporter(reg, port=0, host="127.0.0.1")
+    exp.start()
+    try:
+        url = f"http://127.0.0.1:{exp.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert b"up 1" in resp.read()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/nope", timeout=5
+            )
+        assert exc.value.code == 404
+    finally:
+        exp.stop()
+
+
+def test_maybe_start_exporter_env_gate(monkeypatch):
+    monkeypatch.delenv("LLMQ_METRICS_PORT", raising=False)
+    assert maybe_start_exporter() is None  # off by default
+    monkeypatch.setenv("LLMQ_METRICS_PORT", "not-a-port")
+    assert maybe_start_exporter() is None  # invalid value: warn, not crash
+    monkeypatch.setenv("LLMQ_METRICS_PORT", "0")
+    exp = maybe_start_exporter()
+    try:
+        assert exp is not None
+        assert exp.port > 0
+        assert maybe_start_exporter() is exp  # idempotent singleton
+    finally:
+        stop_exporter()
+
+
+# --- trace ------------------------------------------------------------------
+
+def test_new_trace_and_events():
+    tr = new_trace("job-1")
+    assert tr["job_id"] == "job-1"
+    assert tr["redeliveries"] == 0
+    trace_event(tr, "submitted", queue="q")
+    trace_event(tr, "claimed", worker_id="w1")
+    names = [e["name"] for e in tr["events"]]
+    assert names == ["submitted", "claimed"]
+    for e in tr["events"]:
+        assert e["t_wall"] > 0 and e["t_mono"] > 0 and e["host"]
+    assert tr["events"][0]["queue"] == "q"
+
+
+def test_trace_event_at_backfills_recorded_stamp():
+    tr = new_trace("job-2")
+    t0 = time.monotonic()
+    time.sleep(0.01)
+    trace_event_at(tr, "prefill_start", t0)
+    trace_event(tr, "finished")
+    rows = timeline(tr)
+    assert [r["name"] for r in rows] == ["prefill_start", "finished"]
+    assert rows[0]["t_wall"] < rows[1]["t_wall"]
+    # Zero/None engine stamps (request never reached that phase) are
+    # skipped rather than recorded at the epoch.
+    trace_event_at(tr, "ghost", 0.0)
+    trace_event_at(tr, "ghost2", None)
+    assert len(tr["events"]) == 2
+
+
+def test_trace_from_payload_validation():
+    assert trace_from_payload({}) is None
+    assert trace_from_payload({TRACE_FIELD: "bogus"}) is None
+    assert trace_from_payload({TRACE_FIELD: {"no_events": True}}) is None
+    tr = new_trace("j")
+    payload = {TRACE_FIELD: tr}
+    assert trace_from_payload(payload) is tr
+
+
+def test_timeline_deltas_use_monotonic_within_host():
+    tr = new_trace("j")
+    trace_event(tr, "a")
+    time.sleep(0.02)
+    trace_event(tr, "b")
+    rows = timeline(tr)
+    assert rows[0]["delta_s"] is None  # first event has no predecessor
+    assert rows[1]["delta_s"] == pytest.approx(0.02, abs=0.02)
+
+
+def test_jsonl_sink(tmp_path, monkeypatch):
+    log = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("LLMQ_TRACE_LOG", str(log))
+    emit_trace_event("job-9", "claimed", worker_id="w1")
+    emit_trace_event("job-9", "finished")
+    lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert [ln["event"] for ln in lines] == ["claimed", "finished"]
+    assert lines[0]["job_id"] == "job-9"
+    assert lines[0]["worker_id"] == "w1"
+
+
+def test_jsonl_sink_disabled_and_safe(monkeypatch):
+    monkeypatch.delenv("LLMQ_TRACE_LOG", raising=False)
+    emit_trace_event("job-x", "claimed")  # no sink: no-op
+    monkeypatch.setenv("LLMQ_TRACE_LOG", "/nonexistent-dir/trace.jsonl")
+    emit_trace_event("job-x", "claimed")  # unwritable sink: swallowed
+
+
+def test_trace_sink_concurrent_writes(tmp_path, monkeypatch):
+    log = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("LLMQ_TRACE_LOG", str(log))
+
+    def writer(i):
+        for j in range(20):
+            emit_trace_event(f"job-{i}", "decode", step=j)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = log.read_text().splitlines()
+    assert len(lines) == 80
+    for ln in lines:
+        json.loads(ln)  # no interleaved/torn writes

@@ -171,10 +171,11 @@ def test_ladders_parse():
     """Both runbooks yield their full command ladders (a parser that
     silently matches nothing would make every other test vacuous)."""
     names = [name for name, _, _ in all_steps()]
-    assert sum(n.startswith("hardware_session") for n in names) >= 7
-    assert sum(n.startswith("chip_watch") for n in names) >= 14
+    assert sum(n.startswith("hardware_session") for n in names) >= 8
+    assert sum(n.startswith("chip_watch") for n in names) >= 15
     joined = " ".join(names)
     assert "kernel_v123" in joined and "queue_drain_tpu" in joined
+    assert "metrics_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -259,6 +260,22 @@ def test_bench_tiny_mixed_step_runs():
     assert payload["mixed_step"] == "on"
     assert payload["mixed_steps"] > 0
     assert payload["mixed_prefill_tokens"] > 0
+
+
+def test_metrics_probe_runs():
+    """The observability rung runs end to end on CPU: the probe builds a
+    tiny engine, starts the exporter on an ephemeral port, scrapes its
+    own /metrics (validating the Prometheus text format and the core
+    series), and round-trips a traced job through a memory broker."""
+    proc = _run(
+        {**TINY_ENV, "LLMQ_METRICS_PORT": "0"},
+        ["python", "tools/metrics_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:metrics_probe", proc)
+    assert "scrape leg ok" in proc.stdout
+    assert "trace leg ok" in proc.stdout
+    assert "metric: obs_probe_ok" in proc.stdout
 
 
 def test_bench_tiny_int4_runs():

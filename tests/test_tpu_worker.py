@@ -147,3 +147,43 @@ def test_worker_exports_autotuned_kernel(monkeypatch):
     monkeypatch.setattr(ka, "autotune_decode_kernel", lambda **kw: None)
     worker._autotune_kernel()
     assert "LLMQ_DECODE_KERNEL" not in os.environ
+
+
+async def test_tpu_worker_result_carries_engine_trace(mem_url):
+    """The result's lifecycle trace includes the engine-phase events
+    (tokenized/prefill_start/first_token/decode) backfilled from the
+    engine's per-sequence stamps, in monotone wall-clock order."""
+    from llmq_tpu.obs import timeline, trace_from_payload
+
+    jobs = [
+        Job(
+            id="traced-1",
+            prompt="hello trace",
+            temperature=0.0,
+            max_tokens=4,
+            ignore_eos=True,
+        )
+    ]
+    worker = make_worker(mem_url, queue="trace-q")
+    results = await submit_and_collect(mem_url, "trace-q", jobs, worker)
+    payload = results[0].model_dump()
+    trace = trace_from_payload(payload)
+    assert trace is not None
+    assert trace["redeliveries"] == 0
+    names = [e["name"] for e in trace["events"]]
+    for needed in (
+        "submitted",
+        "claimed",
+        "tokenized",
+        "prefill_start",
+        "first_token",
+        "decode",
+        "finished",
+    ):
+        assert needed in names, f"missing '{needed}' in {names}"
+    assert names.count("claimed") == 1 and names.count("finished") == 1
+    rows = timeline(trace)
+    walls = [r["t_wall"] for r in rows]
+    assert walls == sorted(walls), f"timeline not monotone: {names}"
+    decode = next(e for e in trace["events"] if e["name"] == "decode")
+    assert decode["tokens"] == 4

@@ -34,6 +34,9 @@ run 600 ab_s192 python -m llmq_tpu.engine.kernel_autotune 16 2 128 36 192 128
 #     multi-chip slice (exits with a note on one chip), cheap enough to
 #     keep early in case the window closes.
 run 300 collectives python tools/profile_collectives.py
+# 1c. Observability plane on the real device: /metrics scrape + trace
+#     round trip (host-side only; ephemeral port avoids collisions).
+run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

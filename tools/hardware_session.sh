@@ -37,6 +37,9 @@ run 300 int8_fusion   python tools/profile_int8_matmul.py
 # ICI microbench: decides whether the tp-overlap ring matmuls pay on
 # this slice (single-chip sessions exit immediately with a note).
 run 300 collectives   python tools/profile_collectives.py
+# Observability plane: /metrics scrape + trace round trip on the real
+# device (host-side only, so cheap; ephemeral port avoids collisions).
+run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 # NB: `VAR=x run ...` would leak past the function call in bash — use
 # `env` so each override dies with its step.
 run 1800 bench_bf16   python bench.py
