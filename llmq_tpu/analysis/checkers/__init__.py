@@ -4,6 +4,7 @@ from llmq_tpu.analysis.checkers.blocking import BlockingCallChecker
 from llmq_tpu.analysis.checkers.cancellation import CancelledSwallowChecker
 from llmq_tpu.analysis.checkers.collective_axis import CollectiveAxisChecker
 from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
+from llmq_tpu.analysis.checkers.pickles import PickleSnapshotChecker
 from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
 from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
 from llmq_tpu.analysis.checkers.wallclock import WallclockDurationChecker
@@ -16,6 +17,7 @@ ALL_CHECKERS = (
     JaxHostSyncChecker,
     CollectiveAxisChecker,
     WallclockDurationChecker,
+    PickleSnapshotChecker,
 )
 
 #: rule id -> Rule, across every registered checker.

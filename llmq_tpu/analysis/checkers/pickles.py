@@ -1,0 +1,103 @@
+"""pickle-snapshot: raw pickle on snapshot/broker payloads.
+
+Request snapshots cross process and machine boundaries through the broker
+(swap-to-host preemption blobs stay local, but drain-with-handoff
+republishes them to the job queue for ANY peer to consume). Unpickling is
+arbitrary code execution, so a ``pickle.loads`` on a broker-delivered
+payload hands remote peers an RCE primitive; snapshots must round-trip
+through the versioned, integrity-hashed codec in
+``llmq_tpu/engine/snapshot.py`` instead.
+
+The rule flags two shapes, for pickle and its drop-in cousins
+(cPickle/_pickle, dill, cloudpickle):
+
+- **any** deserialization (``load``/``loads``/``Unpickler``) — there is no
+  trusted-input pickle in this codebase; every deserialized payload
+  either came from the broker or could have,
+- serialization (``dump``/``dumps``/``Pickler``) whose arguments mention a
+  snapshot (a name or attribute containing ``snap``) — pickling a
+  snapshot bakes in a load-bearing ``loads`` on the consuming side and
+  silently drops the codec's version/digest guarantees.
+
+Suppress a deliberate, local-only use with ``# llmq: ignore[pickle-snapshot]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+)
+
+PICKLE_SNAPSHOT = Rule(
+    "pickle-snapshot",
+    "error",
+    "raw pickle on snapshot/broker payloads; use the versioned snapshot codec",
+)
+
+#: Modules whose (de)serialization surface is pickle-shaped.
+PICKLE_MODULES = frozenset(
+    {"pickle", "cPickle", "_pickle", "dill", "cloudpickle"}
+)
+LOAD_NAMES = frozenset({"load", "loads", "Unpickler"})
+DUMP_NAMES = frozenset({"dump", "dumps", "Pickler"})
+
+
+def _mentions_snapshot(call: ast.Call) -> bool:
+    """Any argument name/attribute that looks like a snapshot payload."""
+    for arg in [*call.args, *(kw.value for kw in call.keywords)]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and "snap" in node.id.lower():
+                return True
+            if isinstance(node, ast.Attribute) and "snap" in node.attr.lower():
+                return True
+    return False
+
+
+class PickleSnapshotChecker(Checker):
+    rules = (PICKLE_SNAPSHOT,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = imports.resolve(node.func)
+            if full is None:
+                continue
+            module, _, attr = full.rpartition(".")
+            if module not in PICKLE_MODULES:
+                continue
+            if attr in LOAD_NAMES:
+                yield Violation(
+                    rule=PICKLE_SNAPSHOT,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"{full} executes arbitrary code from its input; "
+                        "broker-delivered payloads (snapshots included) "
+                        "must use the versioned snapshot codec "
+                        "(engine/snapshot.py)"
+                    ),
+                )
+            elif attr in DUMP_NAMES and _mentions_snapshot(node):
+                yield Violation(
+                    rule=PICKLE_SNAPSHOT,
+                    path=source.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"snapshot serialized with {full}: the consumer "
+                        "must then unpickle (RCE on broker bytes) and the "
+                        "codec's version/digest checks are lost; use "
+                        "RequestSnapshot.to_bytes()"
+                    ),
+                )

@@ -283,7 +283,11 @@ class AmqpBroker(Broker):
         self._consumers[tag] = q
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
+        # AMQP basic.cancel always leaves unacked deliveries settleable
+        # (requeue=False semantics); with requeue=True the broker returns
+        # them when the channel closes, so the requeue is deferred, not
+        # dropped.
         q = self._consumers.pop(consumer_tag, None)
         if q is not None:
             await q.cancel(consumer_tag)

@@ -191,7 +191,12 @@ class Broker(abc.ABC):
         """Start consuming; returns a consumer tag for ``cancel``."""
 
     @abc.abstractmethod
-    async def cancel(self, consumer_tag: str) -> None: ...
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
+        """Stop the consumer. ``requeue=True`` (default) returns its
+        unacked deliveries to ready, like a consumer disconnect.
+        ``requeue=False`` is basic.cancel semantics — deliveries stop but
+        in-flight messages stay settleable, for drain-with-handoff where
+        the worker acks each one after finishing or republishing it."""
 
     @abc.abstractmethod
     async def get(self, queue: str) -> Optional[DeliveredMessage]:

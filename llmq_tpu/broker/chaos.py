@@ -41,6 +41,62 @@ from llmq_tpu.core.models import QueueStats
 logger = logging.getLogger(__name__)
 
 
+#: Engine dispatch kinds (as reported to ``EngineCore.on_dispatch``) that
+#: count toward each kill phase. "prefill" includes piggyback mixed
+#: dispatches — a mixed step IS the victims' prefill.
+PHASE_KINDS = {
+    "prefill": ("prefill", "mixed"),
+    "decode": ("decode_block",),
+    "verify": ("verify",),
+}
+
+
+class WorkerKillSwitch:
+    """Seeded worker-kill trigger for the crash-resume chaos legs.
+
+    Install as ``engine.on_dispatch`` (the hook fires once per device
+    dispatch, with the dispatch kind). After a seeded-random number of
+    dispatches matching ``phase`` — mid-prefill, mid-decode-block, or
+    mid-spec-verify — it invokes ``on_kill`` exactly once, typically
+    ``worker.request_shutdown`` (graceful SIGTERM semantics: the drain
+    publishes snapshots) or a harsher teardown. Deterministic for a given
+    (phase, seed, after_range): runs replay identically.
+    """
+
+    def __init__(
+        self,
+        phase: str,
+        on_kill,
+        *,
+        seed: int = 0,
+        after_range=(1, 5),
+    ) -> None:
+        if phase not in PHASE_KINDS:
+            raise ValueError(
+                f"unknown kill phase {phase!r}; one of {sorted(PHASE_KINDS)}"
+            )
+        self.phase = phase
+        self.kinds = PHASE_KINDS[phase]
+        self.on_kill = on_kill
+        self.after = random.Random(seed).randint(*after_range)
+        self.matched = 0
+        self.fired = False
+
+    def __call__(self, kind: str) -> None:
+        if self.fired or kind not in self.kinds:
+            return
+        self.matched += 1
+        if self.matched >= self.after:
+            self.fired = True
+            logger.info(
+                "chaos: worker kill on %s dispatch #%d (phase=%s)",
+                kind,
+                self.matched,
+                self.phase,
+            )
+            self.on_kill()
+
+
 class ChaosBroker(Broker):
     """Fault-injecting decorator over the transport named after ``chaos+``."""
 
@@ -181,9 +237,9 @@ class ChaosBroker(Broker):
 
         return await self.inner.consume(queue, chaotic, prefetch=prefetch)
 
-    async def cancel(self, consumer_tag: str) -> None:
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
         self._check_alive()
-        await self.inner.cancel(consumer_tag)
+        await self.inner.cancel(consumer_tag, requeue=requeue)
 
     async def get(self, queue: str) -> Optional[DeliveredMessage]:
         await self._chaos_op("get")

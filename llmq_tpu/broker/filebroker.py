@@ -298,7 +298,10 @@ class FileBroker(Broker):
         self._consumers[tag] = asyncio.ensure_future(loop())
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
+        # requeue is moot here: the file broker's claims carry a lease, so
+        # anything unsettled when the loop stops is re-claimed on expiry
+        # either way.
         await reap(
             self._consumers.pop(consumer_tag, None), label="file consume loop"
         )

@@ -225,8 +225,8 @@ class BrokerManager:
             await self.setup_queue_infrastructure(queue)
         return await self.broker.consume(qname, handler, prefetch=prefetch)
 
-    async def cancel(self, consumer_tag: str) -> None:
-        await self.broker.cancel(consumer_tag)
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
+        await self.broker.cancel(consumer_tag, requeue=requeue)
 
     # --- ops --------------------------------------------------------------
     async def get_queue_stats(self, queue: str) -> QueueStats:

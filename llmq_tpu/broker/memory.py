@@ -83,6 +83,15 @@ class BrokerCore:
 
     def __init__(self) -> None:
         self.queues: Dict[str, QueueCore] = {}
+        # Exponential redelivery backoff (LLMQ_REDELIVERY_BACKOFF_S /
+        # _MAX_S): a rejected message waits base * 2^(attempt-1) seconds
+        # before going back to ready, so a crash-looping job stops
+        # hammering workers at full rate. 0 = immediate (the default).
+        from llmq_tpu.core.config import get_config
+
+        _cfg = get_config()
+        self.redelivery_backoff_s = max(0.0, _cfg.redelivery_backoff_s)
+        self.redelivery_backoff_max_s = max(0.0, _cfg.redelivery_backoff_max_s)
         self._dispatch_scheduled: set[str] = set()
         # Strong refs to in-flight handler tasks (the event loop holds only
         # weak ones); tasks remove themselves on completion via spawn().
@@ -206,12 +215,40 @@ class BrokerCore:
                 msg.delivery_count += 1
                 if msg.delivery_count > q.max_redeliveries:
                     self._dead_letter(queue, msg)
-                elif self.on_redeliver is not None:
-                    self.on_redeliver(queue, msg)
-                    q.ready.appendleft(msg)
                 else:
-                    q.ready.appendleft(msg)  # redelivery keeps rough ordering
+                    if self.on_redeliver is not None:
+                        self.on_redeliver(queue, msg)
+                    self._requeue(queue, msg)
         self._schedule_dispatch(queue)
+
+    def _requeue(self, queue: str, msg: StoredMessage) -> None:
+        """Return a rejected message to the ready FIFO — immediately, or
+        after its exponential-backoff delay when redelivery backoff is
+        configured (base * 2^(attempt-1), capped)."""
+        q = self._queue(queue)
+        delay = 0.0
+        if self.redelivery_backoff_s > 0:
+            delay = min(
+                self.redelivery_backoff_s * 2 ** (msg.delivery_count - 1),
+                self.redelivery_backoff_max_s,
+            )
+        if delay <= 0:
+            q.ready.appendleft(msg)  # redelivery keeps rough ordering
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # no loop (teardown): don't lose the message
+            q.ready.appendleft(msg)
+            return
+
+        def _release() -> None:
+            held = self.queues.get(queue)
+            if held is None:
+                return
+            held.ready.appendleft(msg)
+            self._schedule_dispatch(queue)
+
+        loop.call_later(delay, _release)
 
     def _dead_letter(self, queue: str, msg: StoredMessage) -> None:
         headers = dict(msg.headers)
@@ -261,7 +298,7 @@ class BrokerCore:
                     else:
                         if self.on_redeliver is not None:
                             self.on_redeliver(q.name, msg)
-                        q.ready.appendleft(msg)
+                        self._requeue(q.name, msg)
                 if stale:
                     self._schedule_dispatch(q.name)
 
@@ -396,10 +433,15 @@ class MemoryBroker(Broker):
         self._tags.append(tag)
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:
-        self.core.remove_consumer(consumer_tag)
-        if consumer_tag in self._tags:
-            self._tags.remove(consumer_tag)
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
+        # requeue=False is basic.cancel semantics: deliveries stop but
+        # already-delivered unacked messages stay settleable — a draining
+        # worker acks them after finishing (or after republishing a
+        # resume snapshot), and requeueing them here would double-deliver
+        # every in-flight job. The tag stays registered so close()
+        # requeues whatever is STILL unacked when the connection goes
+        # away.
+        self.core.remove_consumer(consumer_tag, requeue_in_flight=requeue)
 
     async def get(self, queue: str) -> Optional[DeliveredMessage]:
         # Track gets under a per-connection tag so close() requeues any

@@ -427,12 +427,12 @@ class ResilientBroker(Broker):
                 self._connection_lost(exc)
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
         rec = self._consumers.pop(consumer_tag, None)
         if rec is None or rec.inner_tag is None or not self._connected.is_set():
             return
         try:
-            await self.inner.cancel(rec.inner_tag)
+            await self.inner.cancel(rec.inner_tag, requeue=requeue)
         except RECONNECT_EXCEPTIONS as exc:
             self._connection_lost(exc)
 

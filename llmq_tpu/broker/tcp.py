@@ -356,9 +356,14 @@ class BrokerServer:
             await send(reply(tag=tag))
         elif op == "cancel":
             tag = req["tag"]
-            self.core.remove_consumer(tag)
-            if tag in conn_tags:
-                conn_tags.remove(tag)
+            # requeue=False is basic.cancel: deliveries stop, but this
+            # connection's unacked messages stay settleable (drain-with-
+            # handoff acks them after republishing). The tag stays in
+            # conn_tags so the disconnect cleanup requeues whatever is
+            # still unacked at close.
+            self.core.remove_consumer(
+                tag, requeue_in_flight=bool(req.get("requeue", True))
+            )
             await send(reply())
         elif op == "settle":
             key = (req["tag"], req["message_id"])
@@ -634,9 +639,11 @@ class TcpBroker(Broker):
             )
         return tag
 
-    async def cancel(self, consumer_tag: str) -> None:
+    async def cancel(self, consumer_tag: str, *, requeue: bool = True) -> None:
         self._handlers.pop(consumer_tag, None)
-        await self._request({"op": "cancel", "tag": consumer_tag})
+        await self._request(
+            {"op": "cancel", "tag": consumer_tag, "requeue": requeue}
+        )
 
     async def get(self, queue: str) -> Optional[DeliveredMessage]:
         reply = await self._request({"op": "get", "queue": queue})

@@ -17,7 +17,11 @@ from typing import Dict, List, Optional
 from rich.console import Console
 from rich.table import Table
 
-from llmq_tpu.broker.manager import BrokerManager, results_queue_name
+from llmq_tpu.broker.manager import (
+    FAILED_SUFFIX,
+    BrokerManager,
+    results_queue_name,
+)
 from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import load_pipeline_config
@@ -416,12 +420,43 @@ async def trace_job(queue: str, job_id: str) -> None:
                 break
         for msg in peeked:
             await msg.reject(requeue=True)
+        # No result: the job may have exhausted its retry budget and
+        # dead-lettered. The DLQ holds the ORIGINAL job payload (with any
+        # submit-time trace events) plus x-death headers recording where
+        # and after how many deliveries it died — enough to explain WHY
+        # there is no result.
+        dead_headers = None
+        if record is None:
+            peeked = []
+            while True:
+                msg = await mgr.broker.get(queue + FAILED_SUFFIX)
+                if msg is None:
+                    break
+                peeked.append(msg)
+                try:
+                    payload = json.loads(msg.body)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                if payload.get("id") == job_id:
+                    record = payload
+                    dead_headers = dict(msg.headers or {})
+                    break
+            for msg in peeked:
+                await msg.reject(requeue=True)
         if record is None:
             console.print(
                 f"[red]✗ No result for job '{job_id}' in "
-                f"'{results_queue_name(queue)}'[/red]"
+                f"'{results_queue_name(queue)}' (and no dead-letter in "
+                f"'{queue + FAILED_SUFFIX}')[/red]"
             )
             return
+        if dead_headers is not None:
+            console.print(
+                f"[red]Job '{job_id}' was dead-lettered from "
+                f"'{dead_headers.get('x-death-queue', queue)}' after "
+                f"{dead_headers.get('x-delivery-count', '?')} deliveries "
+                f"(retry budget exhausted)[/red]"
+            )
         trace = trace_from_payload(record)
         if trace is None:
             console.print(
@@ -430,6 +465,22 @@ async def trace_job(queue: str, job_id: str) -> None:
             )
             return
         rows = timeline(trace)
+        if dead_headers is not None:
+            # The dying attempt's trace never shipped (redelivery re-reads
+            # the original payload); synthesize the terminal event from
+            # the DLQ headers so the timeline ends where the job did.
+            rows.append(
+                {
+                    "name": "retry_exhausted",
+                    "t_wall": None,
+                    "delta_s": None,
+                    "extras": {
+                        "redeliveries": dead_headers.get(
+                            "x-delivery-count", "?"
+                        )
+                    },
+                }
+            )
         redeliveries = trace.get("redeliveries", 0)
         table = Table(
             title=f"Trace: {job_id}"

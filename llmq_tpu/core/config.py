@@ -196,6 +196,22 @@ class Config(BaseModel):
         description="Redeliveries before a job is dead-lettered to <q>.failed.",
     )
 
+    redelivery_backoff_s: float = Field(
+        default_factory=lambda: _env_float(
+            "LLMQ_REDELIVERY_BACKOFF_S", default=0.0
+        ),
+        description="Base delay before a rejected job is redelivered; "
+        "doubles per attempt (exponential backoff). 0 redelivers "
+        "immediately (the pre-backoff behavior).",
+    )
+
+    redelivery_backoff_max_s: float = Field(
+        default_factory=lambda: _env_float(
+            "LLMQ_REDELIVERY_BACKOFF_MAX_S", default=30.0
+        ),
+        description="Ceiling on the exponential redelivery backoff.",
+    )
+
     job_timeout_s: Optional[float] = Field(
         default_factory=lambda: _env_float("LLMQ_JOB_TIMEOUT_S"),
         description="Per-job processing timeout: a job running past it is "

@@ -76,6 +76,12 @@ except ImportError:  # jax 0.4.x: same pair, pre-rename names
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.engine import sampling as sampling_mod
+from llmq_tpu.engine import snapshot as snapshot_mod
+from llmq_tpu.engine.snapshot import (
+    KVRestore,
+    RequestSnapshot,
+    SnapshotCompatError,
+)
 from llmq_tpu.engine.sampling import (
     SamplingParams,
     make_base_key,
@@ -224,6 +230,15 @@ class EngineConfig:
     # unchanged; the chunk rides as an extra row). Requires
     # prefill_chunk_size. LLMQ_MIXED_STEP pins over this.
     mixed_step: str = "off"
+    # Pool-exhaustion preemption policy. "recompute" (default) drops the
+    # victim's KV and re-prefills prompt+output on re-admission — cheap
+    # bookkeeping, expensive re-compute. "swap" gathers the victim's KV
+    # pages to host RAM as the deferred-release watermark passes and
+    # scatters them back on re-admission, paying two PCIe copies instead
+    # of a re-prefill. Greedy outputs are bit-identical either way (the
+    # restored pages are the exact bytes the uninterrupted run would have
+    # read). LLMQ_PREEMPT_MODE pins over this.
+    preempt_mode: str = "recompute"
 
     def __post_init__(self):
         self.decode_block = int(self.decode_block)
@@ -250,6 +265,11 @@ class EngineConfig:
         if self.mixed_step not in ("off", "on"):
             raise ValueError(
                 f"mixed_step={self.mixed_step!r} (want off|on)"
+            )
+        self.preempt_mode = str(self.preempt_mode).lower()
+        if self.preempt_mode not in ("recompute", "swap"):
+            raise ValueError(
+                f"preempt_mode={self.preempt_mode!r} (want recompute|swap)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -356,6 +376,7 @@ class EngineCore:
             enable_prefix_caching=self.cfg.enable_prefix_caching,
         )
         self.scheduler = Scheduler(sched_cfg)
+        self.scheduler.on_preempt = self._on_scheduler_preempt
         self._pages_per_seq = sched_cfg.pages_per_seq
 
         self._kv_sharding = NamedSharding(
@@ -463,6 +484,11 @@ class EngineCore:
             self.mixed_step = mixed
         else:
             self.mixed_step = self.cfg.mixed_step
+        preempt = os.environ.get("LLMQ_PREEMPT_MODE", "").lower()
+        if preempt in ("recompute", "swap"):
+            self.preempt_mode = preempt
+        else:
+            self.preempt_mode = self.cfg.preempt_mode
         if self.mixed_step == "on" and not self.cfg.prefill_chunk_size:
             raise ValueError(
                 "mixed_step=on requires prefill_chunk_size: the fused "
@@ -508,11 +534,20 @@ class EngineCore:
         self._pending_decodes = 0  # decode entries within _pending
         self._defer_since: Optional[float] = None  # admission-deferral start
         self._deferred_pages: List[Tuple[int, List[int], int]] = []
+        # Swap-to-host captures awaiting their deferred-release watermark:
+        # (dispatch_idx, seq, pages, kv_valid, epoch-at-preemption). Each
+        # rides the same watermark as its _deferred_pages entry and is
+        # gathered to host BEFORE those pages return to the allocator.
+        self._pending_swaps: List[Tuple[int, Sequence, List[int], int, int]] = []
         self._dispatch_idx = 0
         self._processed_idx = 0
         self._dirty = True
         self._mode = "greedy"
         self._dev_state: Optional[tuple] = None
+        # Chaos/test hook: called with the dispatch kind ("prefill",
+        # "mixed", "decode_block", "verify") after every device dispatch
+        # is recorded. Runs on the engine thread; must be cheap.
+        self.on_dispatch: Optional[Any] = None
 
         # Counters for stats/heartbeats.
         self.total_prompt_tokens = 0
@@ -524,6 +559,10 @@ class EngineCore:
         self.prefills = 0
         self.mixed_steps = 0  # fused decode+prefill dispatches
         self.mixed_prefill_tokens = 0  # prompt positions piggybacked
+        self.swap_preempts = 0  # preemptions whose KV was swapped to host
+        self.kv_restores = 0  # admissions restored from host KV pages
+        self.snapshots_extracted = 0
+        self.snapshots_inserted = 0
         self._started_at = time.monotonic()
 
         # Observability: host-side only — a histogram record is a bucket
@@ -1066,6 +1105,19 @@ class EngineCore:
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
+        # Snapshot plane: whole-page KV scatter for insert_request /
+        # swap-to-host restore. Same donation-and-format discipline as the
+        # decode steps — the pool buffer is reused in place and the
+        # result keeps the pool's pinned layout+sharding, so restores
+        # compose with run-ahead dispatch. Retraces per distinct page
+        # count; restores are rare (preemption under pressure, handoff),
+        # so the retrace cost is noise.
+        self._kv_insert_jit = jax.jit(
+            _dispatch.insert_kv_pages,
+            in_shardings=(kv, repl, repl),
+            out_shardings=kv,
+            donate_argnums=(0,),
+        )
         # Piggyback scheduling: built only when resolved on — an "off"
         # engine carries literally the pre-existing executables. Token
         # output is a [K, S] block like fused decode.
@@ -1306,12 +1358,21 @@ class EngineCore:
         self._defer_since = None
         admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
         todo = []
+        restored = []
         for seq in admitted:
             if seq.params.max_tokens <= 0:
                 self.scheduler.finish(seq, "length")
                 finished.append(self._output_for(seq))
                 continue
-            todo.append(seq)
+            if seq.restore is not None:
+                restored.append(seq)
+            else:
+                todo.append(seq)
+        # Restores first: they mark the device state dirty, and the
+        # prefill below (or the next decode dispatch) resyncs once for
+        # the whole admission wave.
+        if restored:
+            self._restore_batch(restored)
         if todo:
             self._prefill_batch(todo, finished)
         return bool(admitted)
@@ -1406,12 +1467,76 @@ class EngineCore:
         self._processed_idx = idx
 
     def _flush_deferred(self) -> None:
+        # Swap-to-host captures first: a swap entry shares its watermark
+        # with the _deferred_pages entry appended by the same preemption,
+        # and its pages must be gathered to host BEFORE they return to
+        # the allocator (a reallocated page gets overwritten by the next
+        # prefill). At the watermark every in-flight write to these pages
+        # has executed — _process_oldest blocked on that step's outputs.
+        while (
+            self._pending_swaps
+            and self._pending_swaps[0][0] <= self._processed_idx
+        ):
+            _, seq, pages, valid, epoch = self._pending_swaps.pop(0)
+            self._capture_swap(seq, pages, valid, epoch)
         while (
             self._deferred_pages
             and self._deferred_pages[0][0] <= self._processed_idx
         ):
             _, pages, cacheable = self._deferred_pages.pop(0)
             self.scheduler.release_pages(pages, cacheable)
+
+    def _capture_swap(
+        self, seq: Sequence, pages: List[int], valid: int, epoch: int
+    ) -> None:
+        """Gather a swap-preempted sequence's KV pages to host RAM, so
+        re-admission scatters them back instead of re-prefilling. Skipped
+        (falling back to recompute, which is always correct) when the
+        sequence moved on while the capture waited for its watermark:
+        re-admitted, finished/aborted, preempted again, or already
+        carrying a restore."""
+        if (
+            seq.epoch != epoch
+            or seq.finish_reason is not None
+            or seq.rid in self.scheduler.running
+            or seq.restore is not None
+        ):
+            return
+        n = snapshot_mod.pages_for(valid, self.cfg.page_size)
+        if n == 0 or n > len(pages):
+            return
+        idx = jnp.asarray(pages[:n], jnp.int32)
+        # np.asarray blocks until the gather lands, so the fresh host
+        # buffers are safe against the pools' later donation.
+        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
+        self.swap_preempts += 1
+
+    def _on_scheduler_preempt(self, seq: Sequence, deferred: bool) -> None:
+        """Scheduler ``on_preempt`` hook. Deferred self-preemptions queue
+        their own watermark capture in ``_self_preempt_deferred``; the
+        immediate path (scheduler-picked victim under pool exhaustion,
+        only reachable with the pipeline drained) gathers the victim's KV
+        here, while it still owns its pages."""
+        if (
+            deferred
+            or self.preempt_mode != "swap"
+            or not seq.prefilled
+            or not seq.pages
+            or seq.restore is not None
+        ):
+            return
+        assert not self._pending, "immediate preempt with in-flight steps"
+        valid = seq.num_tokens - 1
+        n = snapshot_mod.pages_for(valid, self.cfg.page_size)
+        if n == 0 or n > len(seq.pages):
+            return
+        idx = jnp.asarray(seq.pages[:n], jnp.int32)
+        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
+        self.swap_preempts += 1
 
     def _push_pending(
         self, kind: str, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
@@ -1985,6 +2110,8 @@ class EngineCore:
             get_registry().register(hist)
         ring.append(seconds)
         self._dispatch_hists[kind].observe(seconds)
+        if self.on_dispatch is not None:
+            self.on_dispatch(kind)
 
     def _dispatch_decode(self, finished: List[RequestOutput]) -> None:
         if not self._ensure_decode_pages(finished):
@@ -2017,8 +2144,24 @@ class EngineCore:
         that may write them has been processed. Generated tokens are
         kept; re-admission re-prefills prompt+output. The epoch bump in
         ``Scheduler.preempt`` keeps stale in-flight results (snapshotted
-        before the preemption) from being appended after re-admission."""
+        before the preemption) from being appended after re-admission.
+
+        In swap mode (``LLMQ_PREEMPT_MODE=swap`` / ``preempt_mode``) the
+        victim's KV pages are queued for a host gather at the same
+        deferred-release watermark, and re-admission scatters them back
+        (bit-identical) instead of re-prefilling."""
+        swap = (
+            self.preempt_mode == "swap" and seq.prefilled and bool(seq.pages)
+        )
+        pages_copy = list(seq.pages) if swap else None
+        kv_valid = seq.num_tokens - 1
         pages, cacheable = self.scheduler.preempt(seq, defer_pages=True)
+        if swap:
+            # Epoch AFTER the bump: the capture must only fire for this
+            # exact preemption, not a later one of the same sequence.
+            self._pending_swaps.append(
+                (self._dispatch_idx, seq, pages_copy, kv_valid, seq.epoch)
+            )
         if pages:
             self._deferred_pages.append(
                 (self._dispatch_idx, pages, cacheable)
@@ -2219,6 +2362,224 @@ class EngineCore:
             timing=timing,
         )
 
+    # --- snapshot plane ---------------------------------------------------
+    def _model_sig(self) -> Dict[str, Any]:
+        """The shape contract a snapshot's KV pages must match. Weights are
+        deliberately NOT part of the signature — the handoff plane assumes
+        peers serve the same checkpoint (same queue, same model), which is
+        also what the prefix cache and greedy bit-exactness already rely
+        on."""
+        return {
+            "num_layers": int(self.model_config.num_layers),
+            "num_kv_heads": int(self.model_config.num_kv_heads),
+            "head_dim": int(self.model_config.head_dim_),
+            "kv_dtype": str(jnp.dtype(self.cfg.kv_dtype)),
+        }
+
+    def _snapshot_seq(self, seq: Sequence) -> RequestSnapshot:
+        """Host-serializable state of one unfinished sequence. KV pages
+        come from the sequence's pending host restore (swap-preempted),
+        or a device gather (prefilled and running), or not at all
+        (waiting/mid-prefill — re-insertion re-prefills, which is the
+        same recovery recompute preemption already performs)."""
+        p = seq.params
+        kv_k = kv_v = None
+        kv_valid = 0
+        if seq.restore is not None:
+            r = seq.restore
+            kv_k, kv_v, kv_valid = r.k, r.v, r.valid
+        elif seq.prefilled and seq.rid in self.scheduler.running and seq.pages:
+            kv_valid = seq.num_tokens - 1
+            n = snapshot_mod.pages_for(kv_valid, self.cfg.page_size)
+            if 0 < n <= len(seq.pages):
+                idx = jnp.asarray(seq.pages[:n], jnp.int32)
+                kv_k = np.asarray(
+                    _dispatch.gather_kv_pages(self.k_pages, idx)
+                )
+                kv_v = np.asarray(
+                    _dispatch.gather_kv_pages(self.v_pages, idx)
+                )
+            else:
+                kv_valid = 0
+        return RequestSnapshot(
+            rid=seq.rid,
+            model_sig=self._model_sig(),
+            page_size=self.cfg.page_size,
+            prompt_ids=list(seq.prompt_ids),
+            output_ids=list(seq.output_ids),
+            params=dataclasses.replace(p),
+            key_data=np.asarray(
+                make_base_key(p.seed, request_tag(seq.rid)), np.uint32
+            ),
+            epoch=seq.epoch,
+            preempt_count=seq.preempt_count,
+            detok_len=seq.detok_len,
+            detok_text=seq.detok_text,
+            kv_valid=kv_valid,
+            kv_k=kv_k,
+            kv_v=kv_v,
+        )
+
+    def _remove_extracted(self, seq: Sequence) -> None:
+        if seq.rid in self.scheduler.running:
+            was_prefilled = seq.prefilled
+            # Pipeline is drained (extract paths drain first), so pages
+            # release immediately — no watermark needed.
+            self.scheduler.finish(seq, "extracted")
+            if was_prefilled:
+                self._dirty = True
+        else:
+            try:
+                self.scheduler.waiting.remove(seq)
+            except ValueError:
+                pass
+        seq.restore = None
+
+    def extract_request(
+        self,
+        rid: str,
+        *,
+        finished: Optional[List[RequestOutput]] = None,
+    ) -> RequestSnapshot:
+        """Pull one in-flight request out of the engine as a
+        :class:`RequestSnapshot` and remove it. Drains the run-ahead
+        pipeline first so scheduler truth is current; outputs observed
+        during that drain are appended to ``finished`` (pass a list to
+        keep them — a request that finishes during the drain raises
+        KeyError here but surfaces there). Greedy continuation after
+        :meth:`insert_request` is bit-identical to never extracting."""
+        out = finished if finished is not None else []
+        self._drain(out)
+        seq = self.scheduler.running.get(rid)
+        if seq is None:
+            seq = next(
+                (s for s in self.scheduler.waiting if s.rid == rid), None
+            )
+        if seq is None or seq.finish_reason is not None:
+            raise KeyError(f"no in-flight request {rid!r} to extract")
+        snap = self._snapshot_seq(seq)
+        self._remove_extracted(seq)
+        self.snapshots_extracted += 1
+        return snap
+
+    def extract_all(
+        self, *, finished: Optional[List[RequestOutput]] = None
+    ) -> List[RequestSnapshot]:
+        """Extract every unfinished request (drain-with-handoff). See
+        :meth:`extract_request`."""
+        out = finished if finished is not None else []
+        self._drain(out)
+        snaps: List[RequestSnapshot] = []
+        for seq in list(self.scheduler.running.values()) + list(
+            self.scheduler.waiting
+        ):
+            if seq.finish_reason is not None:
+                continue
+            snaps.append(self._snapshot_seq(seq))
+            self._remove_extracted(seq)
+            self.snapshots_extracted += 1
+        return snaps
+
+    def insert_request(self, snap: RequestSnapshot) -> Sequence:
+        """Re-insert an extracted request, here or on a different engine.
+        KV pages are remapped to whatever physical pages admission hands
+        out (repacked host-side if the page size differs); the sampling
+        key chain is re-derived from (seed, rid) and verified against the
+        snapshot bit-for-bit. A snapshot without KV re-prefills
+        prompt+output instead — same math, same tokens."""
+        sig, mine = dict(snap.model_sig), self._model_sig()
+        if sig != mine:
+            raise SnapshotCompatError(
+                f"snapshot model signature {sig} does not match engine "
+                f"{mine}"
+            )
+        if snap.rid in self.scheduler.running or any(
+            s.rid == snap.rid for s in self.scheduler.waiting
+        ):
+            raise ValueError(
+                f"request {snap.rid!r} is already in flight on this engine"
+            )
+        params = dataclasses.replace(snap.params)
+        expect = np.asarray(
+            make_base_key(params.seed, request_tag(snap.rid)), np.uint32
+        )
+        got = np.asarray(snap.key_data, np.uint32)
+        if got.shape != expect.shape or not np.array_equal(got, expect):
+            raise SnapshotCompatError(
+                "sampling-key chain mismatch: the snapshot's base key does "
+                "not re-derive from (seed, rid) on this engine"
+            )
+        need = len(
+            set(params.stop_token_ids)
+            | (set() if params.ignore_eos else self._eos_ids)
+        )
+        if need > self._stop_capacity:
+            self._grow_stop_capacity(need)
+        seq = Sequence(
+            rid=snap.rid,
+            prompt_ids=[int(t) for t in snap.prompt_ids],
+            params=params,
+            output_ids=[int(t) for t in snap.output_ids],
+            # Fresh epoch lineage on this engine; +1 mirrors what a
+            # preemption would have done to any stale in-flight rows.
+            epoch=snap.epoch + 1,
+            preempt_count=snap.preempt_count,
+            detok_len=snap.detok_len,
+            detok_text=snap.detok_text,
+        )
+        if (
+            snap.kv_k is not None
+            and snap.kv_v is not None
+            and snap.kv_valid > 0
+        ):
+            if snap.kv_valid != seq.num_tokens - 1:
+                raise SnapshotCompatError(
+                    f"snapshot KV covers {snap.kv_valid} positions but "
+                    f"{seq.num_tokens - 1} are needed to continue decode"
+                )
+            k, v = snap.kv_k, snap.kv_v
+            if snap.page_size != self.cfg.page_size:
+                n_dst = snapshot_mod.pages_for(
+                    snap.kv_valid, self.cfg.page_size
+                )
+                k = snapshot_mod.repack_pages(
+                    k, snap.kv_valid, self.cfg.page_size, n_dst
+                )
+                v = snapshot_mod.repack_pages(
+                    v, snap.kv_valid, self.cfg.page_size, n_dst
+                )
+            seq.restore = KVRestore(k=k, v=v, valid=snap.kv_valid)
+        self.total_prompt_tokens += len(seq.prompt_ids)
+        self.scheduler.add_restored(seq)
+        self.snapshots_inserted += 1
+        return seq
+
+    def _restore_batch(self, seqs: List[Sequence]) -> None:
+        """Scatter admitted sequences' host KV pages back into the pools
+        and mark them prefilled. The decode-state rows join via the dirty
+        resync on the next dispatch — resync rebuilds all 13 leaves from
+        scheduler truth, which now includes these rows."""
+        for seq in seqs:
+            r = seq.restore
+            seq.restore = None
+            n = r.k.shape[1]
+            # admit() allocated pages for num_tokens+1 positions, which
+            # always covers the ceil(valid/page) pages of data.
+            assert n <= len(seq.pages), (n, len(seq.pages))
+            idx = np.asarray(seq.pages[:n], np.int32)
+            self.k_pages = self._kv_insert_jit(
+                self.k_pages, idx, np.ascontiguousarray(r.k)
+            )
+            self.v_pages = self._kv_insert_jit(
+                self.v_pages, idx, np.ascontiguousarray(r.v)
+            )
+            seq.prefilled = True
+            if seq.t_prefill_start == 0.0:
+                seq.t_prefill_start = time.monotonic()
+            self.scheduler.register_prefix(seq)
+            self.kv_restores += 1
+        self._dirty = True
+
     def abort_all(self, note: str = "aborted") -> None:
         """Drop every running/waiting sequence and release their pages —
         recovery hook after a failed step, so the loop doesn't re-step a
@@ -2231,6 +2592,9 @@ class EngineCore:
             self._processed_idx = self._pending[-1][0]
             self._pending.clear()
             self._pending_decodes = 0
+        # Swap captures reference the pool being torn down; their
+        # sequences are gone with the abort anyway.
+        self._pending_swaps.clear()
         self._flush_deferred()
         # The prefix cache must not survive an abort: the KV buffers may
         # be rebuilt (zeroed) below, and a cached hash pointing at a page
@@ -2296,6 +2660,14 @@ class EngineCore:
             mixed_step=self.mixed_step,
             mixed_steps=self.mixed_steps,
             mixed_prefill_tokens=self.mixed_prefill_tokens,
+            # Snapshot plane: swap-to-host preemption and extract/insert
+            # traffic. kv_restores counts admissions that scattered host
+            # KV back instead of re-prefilling.
+            preempt_mode=self.preempt_mode,
+            swap_preempts=self.swap_preempts,
+            kv_restores=self.kv_restores,
+            snapshots_extracted=self.snapshots_extracted,
+            snapshots_inserted=self.snapshots_inserted,
             tokens_per_sec=self.total_generated_tokens / elapsed,
             devices=int(np.prod(list(self.mesh.shape.values()))),
             # What this engine actually runs — the autotuned kernel and
@@ -2341,6 +2713,19 @@ class EngineCore:
         return s
 
 
+@dataclasses.dataclass
+class HandoffOutput:
+    """What :meth:`AsyncEngine.handoff` resolves an in-flight request
+    with instead of a :class:`RequestOutput`: the request's snapshot (or
+    None when it never entered the engine — no partial state to carry)
+    and the count of tokens already generated (the resume offset for
+    result-side dedup)."""
+
+    rid: str
+    snapshot: Optional[RequestSnapshot]
+    emitted: int = 0
+
+
 class AsyncEngine:
     """Async facade: step loop on a dedicated thread, asyncio-awaitable
     results (the surface the reference consumed from AsyncLLMEngine)."""
@@ -2351,6 +2736,10 @@ class AsyncEngine:
         self._futures: Dict[str, Future] = {}
         self._wake = threading.Event()
         self._stop = False
+        self._draining = False
+        self._handoff_requested = False
+        self._handoff_event: Optional[threading.Event] = None
+        self._handoff_results: List[HandoffOutput] = []
         self._thread = threading.Thread(
             target=self._run, name="llmq-engine", daemon=True
         )
@@ -2368,9 +2757,30 @@ class AsyncEngine:
     ) -> RequestOutput:
         import asyncio
 
+        if self._draining:
+            raise RuntimeError("engine is draining for handoff")
         fut: Future = Future()
         self._futures[rid] = fut
-        self._intake.put((rid, prompt, messages, prompt_ids, params))
+        self._intake.put((rid, prompt, messages, prompt_ids, params, None))
+        self._wake.set()
+        try:
+            return await asyncio.wrap_future(fut)
+        finally:
+            self._futures.pop(rid, None)
+
+    async def resume(
+        self, *, rid: str, snapshot: RequestSnapshot
+    ) -> RequestOutput:
+        """Continue a request from a :class:`RequestSnapshot` (published
+        by a peer's drain-with-handoff). Completes exactly like generate();
+        may itself resolve with a HandoffOutput if THIS engine drains."""
+        import asyncio
+
+        if self._draining:
+            raise RuntimeError("engine is draining for handoff")
+        fut: Future = Future()
+        self._futures[rid] = fut
+        self._intake.put((rid, None, None, None, None, snapshot))
         self._wake.set()
         try:
             return await asyncio.wrap_future(fut)
@@ -2387,6 +2797,7 @@ class AsyncEngine:
                 kwargs.get("messages"),
                 kwargs.get("prompt_ids"),
                 kwargs.get("params"),
+                kwargs.get("snapshot"),
             )
         )
         self._wake.set()
@@ -2394,6 +2805,25 @@ class AsyncEngine:
             return fut.result()
         finally:
             self._futures.pop(rid, None)
+
+    def handoff(self, timeout: float = 120.0) -> List[HandoffOutput]:
+        """Drain-with-handoff (thread-safe, called from any thread): let
+        in-flight device steps land, extract every unfinished request as
+        a snapshot, and resolve its pending future with a
+        :class:`HandoffOutput` instead of a RequestOutput. New
+        generate()/resume() calls fail fast afterwards. Returns the
+        handoffs; requests that finish during the drain resolve with
+        their normal RequestOutput and are not in the list."""
+        self._draining = True  # refuse new intake even before the drain
+        if not self._thread.is_alive():
+            return []
+        self._handoff_results = []
+        self._handoff_event = threading.Event()
+        self._handoff_requested = True
+        self._wake.set()
+        if not self._handoff_event.wait(timeout=timeout):
+            logger.warning("engine handoff timed out after %.1fs", timeout)
+        return self._handoff_results
 
     def stats(self) -> Dict[str, Any]:
         return self.core.stats()
@@ -2404,8 +2834,59 @@ class AsyncEngine:
         self._thread.join(timeout=30)
 
     # --- engine thread ----------------------------------------------------
+    def _run_handoff(self) -> None:
+        """On the engine thread: drain, extract, resolve. Outputs that
+        finish during the drain resolve normally; everything unfinished
+        resolves with a HandoffOutput carrying its snapshot. Intake-queue
+        stragglers (accepted before _draining flipped) resolve with a
+        snapshot-less HandoffOutput — the worker requeues those whole."""
+        self._handoff_requested = False
+        results: List[HandoffOutput] = []
+        try:
+            outs: List[RequestOutput] = []
+            snaps = self.core.extract_all(finished=outs)
+            for out in outs:
+                fut = self._futures.get(out.rid)
+                if fut is not None and not fut.done():
+                    fut.set_result(out)
+            for snap in snaps:
+                ho = HandoffOutput(
+                    rid=snap.rid,
+                    snapshot=snap,
+                    emitted=len(snap.output_ids),
+                )
+                results.append(ho)
+                fut = self._futures.get(snap.rid)
+                if fut is not None and not fut.done():
+                    fut.set_result(ho)
+            while True:
+                try:
+                    item = self._intake.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                ho = HandoffOutput(rid=item[0], snapshot=None, emitted=0)
+                results.append(ho)
+                fut = self._futures.get(item[0])
+                if fut is not None and not fut.done():
+                    fut.set_result(ho)
+        except Exception:  # noqa: BLE001 — handoff must never wedge shutdown
+            logger.exception("engine handoff failed; aborting batch")
+            self.core.abort_all("handoff_failed")
+            for fut in list(self._futures.values()):
+                if not fut.done():
+                    fut.set_exception(RuntimeError("engine handoff failed"))
+        finally:
+            self._handoff_results = results
+            ev = self._handoff_event
+            if ev is not None:
+                ev.set()
+
     def _run(self) -> None:
         while not self._stop:
+            if self._handoff_requested:
+                self._run_handoff()
             drained = False
             while True:
                 try:
@@ -2414,15 +2895,18 @@ class AsyncEngine:
                     break
                 if item is None:
                     continue
-                rid, prompt, messages, prompt_ids, params = item
+                rid, prompt, messages, prompt_ids, params, snapshot = item
                 try:
-                    self.core.add_request(
-                        rid,
-                        prompt=prompt,
-                        messages=messages,
-                        prompt_ids=prompt_ids,
-                        params=params,
-                    )
+                    if snapshot is not None:
+                        self.core.insert_request(snapshot)
+                    else:
+                        self.core.add_request(
+                            rid,
+                            prompt=prompt,
+                            messages=messages,
+                            prompt_ids=prompt_ids,
+                            params=params,
+                        )
                     drained = True
                 except Exception as exc:  # tokenization/validation error
                     fut = self._futures.get(rid)

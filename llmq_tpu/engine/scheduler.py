@@ -25,7 +25,7 @@ import dataclasses
 import hashlib
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from llmq_tpu.engine.sampling import SamplingParams
 from llmq_tpu.obs.metrics import Histogram
@@ -192,6 +192,11 @@ class Sequence:
     # truncated past detok_len.
     detok_len: int = 0
     detok_text: str = ""
+    # Host-held KV pages awaiting re-insertion (a snapshot.KVRestore).
+    # Set by swap-to-host preemption and by insert_request; consumed at
+    # admission — the engine scatters the pages back instead of
+    # re-prefilling. None = re-prefill from prompt+output as usual.
+    restore: Optional[Any] = None
     # Host-side lifecycle stamps (time.monotonic(); 0.0 = not yet).
     # These feed the queue-wait / TTFT / ITL histograms and the
     # per-request trace record; they never influence scheduling.
@@ -244,6 +249,9 @@ class Scheduler:
         self._prefix_rev: Dict[int, List[bytes]] = {}
         self.prefix_hits = 0  # pages reused via the cache (stats)
         self.preemptions = 0  # recompute preemptions (stats)
+        # Called as on_preempt(seq, defer_pages) at the top of preempt(),
+        # before the epoch bump and page release (engine swap-to-host).
+        self.on_preempt = None
         self.allocator.on_evict = self._drop_page_hashes
         # Per-scheduler latency histograms (the owning engine registers
         # them into the process-wide registry for /metrics export).
@@ -348,6 +356,36 @@ class Scheduler:
                 f"prompt of {seq.num_tokens} tokens needs "
                 f"{self._pages_needed(seq.num_tokens)} KV pages; pool has "
                 f"{self.config.num_pages - 1}"
+            )
+        if seq.t_enqueue == 0.0:
+            seq.t_enqueue = time.monotonic()
+        self.waiting.append(seq)
+
+    def add_restored(self, seq: Sequence) -> None:
+        """Enqueue a snapshot-restored sequence.
+
+        Unlike :meth:`add`, the prompt is never truncated — the snapshot's
+        KV and key chain cover exactly these positions, so silently
+        shortening them would desynchronize state — and the generation cap
+        is re-derived from the PROMPT length alone. Running the restored
+        sequence through add()'s cap (which counts ``num_tokens``, i.e.
+        prompt PLUS already-generated output) would tighten ``max_tokens``
+        below what the source engine granted and could instantly
+        length-finish a request that still had budget.
+        """
+        if seq.num_tokens >= self.config.max_model_len:
+            raise ValueError(
+                f"restored request {seq.rid!r} holds {seq.num_tokens} "
+                f"tokens; this engine's window is {self.config.max_model_len}"
+            )
+        window = self.config.max_model_len - len(seq.prompt_ids)
+        if seq.params.max_tokens > window:
+            seq.params.max_tokens = window
+        if self._pages_needed(seq.num_tokens) > self.config.num_pages - 1:
+            raise ValueError(
+                f"restored request {seq.rid!r} of {seq.num_tokens} tokens "
+                f"needs {self._pages_needed(seq.num_tokens)} KV pages; pool "
+                f"has {self.config.num_pages - 1}"
             )
         if seq.t_enqueue == 0.0:
             seq.t_enqueue = time.monotonic()
@@ -489,6 +527,12 @@ class Scheduler:
         ``defer_pages`` (self-preemption while steps are in flight) the
         pages are detached and returned like ``finish(defer_pages=True)``
         instead of freed — the engine releases them at the watermark."""
+        # Engine hook (swap-to-host preemption): fires while the victim
+        # still holds its pages and its prefilled flag — for an immediate
+        # (non-deferred, pipeline-drained) preemption the engine gathers
+        # the KV to host right here, before the pages hit the free list.
+        if self.on_preempt is not None:
+            self.on_preempt(seq, defer_pages)
         seq.epoch += 1  # stale in-flight results must not resurface
         pages, cacheable = [], 0
         if defer_pages:

@@ -1,0 +1,271 @@
+"""Host-serializable per-request engine state: the snapshot plane.
+
+A :class:`RequestSnapshot` captures everything the engine needs to continue
+a request exactly where it stopped: the prompt and generated tokens, the
+sampling parameters and base key of the sampling-key chain, the preemption
+epoch, the detokenizer cache, and — for prefilled requests — the raw KV
+pages in their stored dtype (fp8/int KV serializes as-is, no dequantize
+round trip). Snapshots are what ``EngineCore.extract_request`` returns and
+``EngineCore.insert_request`` consumes, on the same engine (swap-to-host
+preemption, crash-resume) or a different one (worker handoff, and later
+prefill/decode disaggregation).
+
+The wire form is versioned and integrity-hashed with a fixed binary
+layout — a JSON header plus raw array buffers — deliberately NOT pickle:
+snapshots cross process and machine boundaries via the broker, and
+unpickling broker-delivered bytes would hand remote peers code execution.
+
+Layout::
+
+    MAGIC "LLMQSNAP" | u16 LE version | 16-byte blake2b digest |
+    u32 LE header length | JSON header {meta, array directory} |
+    concatenated raw array buffers
+
+The digest covers everything after itself (version included via the
+hashed prefix), so truncation, bit rot, and version tampering all surface
+as :class:`SnapshotIntegrityError` before any field is trusted.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import dataclasses
+import hashlib
+import json
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from llmq_tpu.engine.sampling import SamplingParams
+
+MAGIC = b"LLMQSNAP"
+SNAPSHOT_VERSION = 1
+DIGEST_SIZE = 16
+_VER_STRUCT = struct.Struct("<H")
+_LEN_STRUCT = struct.Struct("<I")
+
+
+class SnapshotError(ValueError):
+    """Base: the blob is not a usable request snapshot."""
+
+
+class SnapshotIntegrityError(SnapshotError):
+    """The blob is truncated or its digest does not match its contents."""
+
+
+class SnapshotVersionError(SnapshotError):
+    """The blob's codec version is newer than this build understands."""
+
+
+class SnapshotCompatError(SnapshotError):
+    """The snapshot is valid but cannot be inserted into THIS engine
+    (model signature, KV dtype, or sampling-key chain mismatch)."""
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Resolve a serialized dtype name. ``np.dtype("bfloat16")`` raises —
+    the extended-precision names only resolve through ml_dtypes (which
+    ships with jax)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        except (ImportError, AttributeError, TypeError):
+            raise SnapshotError(
+                f"snapshot references unknown dtype {name!r}"
+            ) from None
+
+
+@dataclasses.dataclass
+class KVRestore:
+    """Host-side KV pages awaiting scatter back into a device pool.
+
+    ``k``/``v`` are ``[num_layers, n_pages, page_size, num_kv_heads,
+    head_dim]`` in the pool's stored dtype; positions ``0..valid-1`` are
+    meaningful, the page tail past ``valid`` is don't-care (decode
+    overwrites it append-only before attention ever reads it)."""
+
+    k: np.ndarray
+    v: np.ndarray
+    valid: int
+
+
+@dataclasses.dataclass
+class RequestSnapshot:
+    """Complete host-side state of one in-flight request."""
+
+    rid: str
+    model_sig: Dict[str, Any]
+    page_size: int
+    prompt_ids: List[int]
+    output_ids: List[int]
+    params: SamplingParams
+    key_data: np.ndarray  # uint32 base key of the sampling-key chain
+    epoch: int
+    preempt_count: int
+    detok_len: int
+    detok_text: str
+    kv_valid: int = 0
+    kv_k: Optional[np.ndarray] = None  # [L, n, page, H, D], stored dtype
+    kv_v: Optional[np.ndarray] = None
+    version: int = SNAPSHOT_VERSION
+
+    def to_bytes(self) -> bytes:
+        meta = {
+            "rid": self.rid,
+            "model_sig": self.model_sig,
+            "page_size": int(self.page_size),
+            "params": dataclasses.asdict(self.params),
+            "epoch": int(self.epoch),
+            "preempt_count": int(self.preempt_count),
+            "detok_len": int(self.detok_len),
+            "detok_text": self.detok_text,
+            "kv_valid": int(self.kv_valid),
+        }
+        arrays: List[Tuple[str, np.ndarray]] = [
+            ("prompt_ids", np.asarray(self.prompt_ids, np.int32)),
+            ("output_ids", np.asarray(self.output_ids, np.int32)),
+            ("key_data", np.asarray(self.key_data, np.uint32)),
+        ]
+        if self.kv_k is not None and self.kv_v is not None:
+            arrays.append(("kv_k", self.kv_k))
+            arrays.append(("kv_v", self.kv_v))
+        directory = []
+        chunks = []
+        for key, arr in arrays:
+            arr = np.ascontiguousarray(arr)
+            buf = arr.tobytes()
+            directory.append(
+                {
+                    "key": key,
+                    "dtype": arr.dtype.name,
+                    "shape": list(arr.shape),
+                    "nbytes": len(buf),
+                }
+            )
+            chunks.append(buf)
+        body = b"".join(chunks)
+        header = json.dumps(
+            {"meta": meta, "arrays": directory}, separators=(",", ":")
+        ).encode("utf-8")
+        ver = _VER_STRUCT.pack(SNAPSHOT_VERSION)
+        hlen = _LEN_STRUCT.pack(len(header))
+        digest = hashlib.blake2b(
+            ver + hlen + header + body, digest_size=DIGEST_SIZE
+        ).digest()
+        return MAGIC + ver + digest + hlen + header + body
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "RequestSnapshot":
+        prefix = len(MAGIC) + _VER_STRUCT.size + DIGEST_SIZE + _LEN_STRUCT.size
+        if len(data) < prefix:
+            raise SnapshotIntegrityError(
+                f"snapshot truncated: {len(data)} bytes"
+            )
+        if data[: len(MAGIC)] != MAGIC:
+            raise SnapshotError("not a request snapshot (bad magic)")
+        off = len(MAGIC)
+        (version,) = _VER_STRUCT.unpack_from(data, off)
+        ver_bytes = data[off : off + _VER_STRUCT.size]
+        off += _VER_STRUCT.size
+        digest = data[off : off + DIGEST_SIZE]
+        off += DIGEST_SIZE
+        if version > SNAPSHOT_VERSION:
+            raise SnapshotVersionError(
+                f"snapshot version {version} is newer than supported "
+                f"{SNAPSHOT_VERSION}"
+            )
+        rest = data[off:]
+        want = hashlib.blake2b(
+            ver_bytes + rest, digest_size=DIGEST_SIZE
+        ).digest()
+        if digest != want:
+            raise SnapshotIntegrityError("snapshot digest mismatch")
+        (hlen,) = _LEN_STRUCT.unpack_from(data, off)
+        off += _LEN_STRUCT.size
+        if off + hlen > len(data):
+            raise SnapshotIntegrityError("snapshot header overruns blob")
+        try:
+            header = json.loads(data[off : off + hlen].decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            raise SnapshotIntegrityError(
+                f"snapshot header unparseable: {exc}"
+            ) from None
+        off += hlen
+        arrays: Dict[str, np.ndarray] = {}
+        for entry in header.get("arrays", ()):
+            dtype = _dtype_from_name(entry["dtype"])
+            nbytes = int(entry["nbytes"])
+            if off + nbytes > len(data):
+                raise SnapshotIntegrityError(
+                    f"snapshot array {entry['key']!r} overruns blob"
+                )
+            arr = np.frombuffer(data, dtype=dtype, count=nbytes // dtype.itemsize, offset=off)
+            arrays[entry["key"]] = arr.reshape(entry["shape"]).copy()
+            off += nbytes
+        meta = header["meta"]
+        pd = dict(meta["params"])
+        pd["stop"] = tuple(pd.get("stop") or ())
+        pd["stop_token_ids"] = tuple(pd.get("stop_token_ids") or ())
+        known = {f.name for f in dataclasses.fields(SamplingParams)}
+        params = SamplingParams(**{k: v for k, v in pd.items() if k in known})
+        return cls(
+            rid=meta["rid"],
+            model_sig=dict(meta["model_sig"]),
+            page_size=int(meta["page_size"]),
+            prompt_ids=[int(t) for t in arrays["prompt_ids"]],
+            output_ids=[int(t) for t in arrays["output_ids"]],
+            params=params,
+            key_data=arrays["key_data"],
+            epoch=int(meta["epoch"]),
+            preempt_count=int(meta["preempt_count"]),
+            detok_len=int(meta["detok_len"]),
+            detok_text=meta["detok_text"],
+            kv_valid=int(meta["kv_valid"]),
+            kv_k=arrays.get("kv_k"),
+            kv_v=arrays.get("kv_v"),
+            version=version,
+        )
+
+
+def snapshot_to_b64(snap: RequestSnapshot) -> str:
+    return base64.b64encode(snap.to_bytes()).decode("ascii")
+
+
+def snapshot_from_b64(data: str) -> RequestSnapshot:
+    try:
+        raw = base64.b64decode(data.encode("ascii"), validate=True)
+    except (binascii.Error, ValueError) as exc:
+        raise SnapshotError(f"snapshot base64 undecodable: {exc}") from None
+    return RequestSnapshot.from_bytes(raw)
+
+
+def repack_pages(
+    kv: np.ndarray, valid: int, dst_page_size: int, dst_pages: int
+) -> np.ndarray:
+    """Re-tile ``[L, n_src, src_page, H, D]`` KV pages for a pool with a
+    different page size. Only positions ``0..valid-1`` carry data; the
+    destination tail is zero-filled don't-care (append-only decode writes
+    overwrite it before attention reads it)."""
+    layers, _, _, heads, dim = kv.shape
+    if valid > dst_pages * dst_page_size:
+        raise SnapshotCompatError(
+            f"{valid} KV positions do not fit {dst_pages} pages of "
+            f"{dst_page_size}"
+        )
+    flat = np.ascontiguousarray(kv).reshape(layers, -1, heads, dim)[:, :valid]
+    out = np.zeros(
+        (layers, dst_pages * dst_page_size, heads, dim), dtype=kv.dtype
+    )
+    out[:, :valid] = flat
+    return out.reshape(layers, dst_pages, dst_page_size, heads, dim)
+
+
+def pages_for(valid: int, page_size: int) -> int:
+    """Pages required to hold ``valid`` KV positions."""
+    return -(-valid // page_size) if valid > 0 else 0

@@ -450,3 +450,27 @@ def decode_attention(
             out_specs=P(None, TP_AXIS, None),
         )
     return call(q, k_pages, v_pages, block_tables, context_lens, window, li)
+
+
+# --- snapshot plane: whole-page KV movement ---------------------------------
+#
+# The snapshot codepaths (extract_request / insert_request / swap-to-host
+# preemption) move request state page-at-a-time between the stacked device
+# pools [L, Pg, page, n_kv, d] and host buffers. Pages are opaque here —
+# fp8/int-quantized KV moves in its stored dtype, never dequantized.
+
+
+def gather_kv_pages(pool: jnp.ndarray, page_idx: jnp.ndarray) -> jnp.ndarray:
+    """Gather whole pages ``[L, n, page, n_kv, d]`` from a stacked pool by
+    page index. Produces a fresh buffer, so the pool can be donated to a
+    later dispatch while the host copy is still in flight."""
+    return jnp.take(pool, page_idx, axis=1)
+
+
+def insert_kv_pages(
+    pool: jnp.ndarray, page_idx: jnp.ndarray, pages: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter whole pages back into a stacked pool at ``page_idx``. The
+    caller jits this with the pool donated and the pool's layout/sharding
+    pinned on the output, mirroring the decode-step KV plumbing."""
+    return pool.at[:, page_idx].set(pages.astype(pool.dtype))

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
+import json
 import logging
 import signal
 import time
@@ -50,6 +51,12 @@ from llmq_tpu.obs import (
     trace_from_payload,
 )
 from llmq_tpu.utils.logging import ContextLogAdapter
+from llmq_tpu.workers.resume import (
+    RESUME_FIELD,
+    JobHandoff,
+    ResultDeduper,
+    resume_offset,
+)
 
 HEALTH_SUFFIX = ".health"
 HEALTH_TTL_MS = 120_000
@@ -92,6 +99,10 @@ class BaseWorker(abc.ABC):
         # TPU worker) can attach engine lifecycle events to the record
         # that rides back in the Result.
         self._job_traces: dict = {}
+        # Exactly-one-result guard: (job_id, resume offset) pairs this
+        # worker already published for. Redelivered or resumed jobs that
+        # land on this worker twice publish once.
+        self._dedup = ResultDeduper()
 
     # --- abstract surface (reference base.py:57-75) -----------------------
     @abc.abstractmethod
@@ -170,10 +181,21 @@ class BaseWorker(abc.ABC):
     async def shutdown(self) -> None:
         if self._consumer_tag is not None and self.broker.connected:
             try:
-                await self.broker.cancel(self._consumer_tag)
+                # requeue=False: in-flight jobs either finish (and ack)
+                # during the drain below or are republished as resume
+                # snapshots; requeueing them here would double-deliver.
+                await self.broker.cancel(self._consumer_tag, requeue=False)
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
             self._consumer_tag = None
+        # Drain-with-handoff: let the processor hand unfinished requests
+        # back (the TPU worker extracts engine snapshots here). In-flight
+        # _process_message coroutines then settle their messages as
+        # resumable republishes instead of waiting out full generations.
+        try:
+            await self._handoff_in_flight()
+        except Exception:  # noqa: BLE001 — fall back to the plain drain
+            self.logger.warning("In-flight handoff failed", exc_info=True)
         try:
             await asyncio.wait_for(
                 self._drained.wait(), timeout=self.config.drain_timeout_s
@@ -189,6 +211,12 @@ class BaseWorker(abc.ABC):
             self.jobs_processed,
             self.jobs_failed,
         )
+
+    async def _handoff_in_flight(self) -> None:
+        """Hook: hand in-flight requests back to the broker as resumable
+        jobs during shutdown. Base workers have no partial state worth
+        carrying — the plain drain (or redelivery) covers them."""
+        return None
 
     # --- the hot loop (reference base.py:137-245) -------------------------
     async def _process_message(self, message: DeliveredMessage) -> None:
@@ -231,7 +259,23 @@ class BaseWorker(abc.ABC):
                 duration_ms=round(duration_ms, 3),
             )
             result = self._build_result(job, output, duration_ms, trace=trace)
-            await self._publish_result(result)
+            offset = resume_offset(job.extras())
+            if self._dedup.seen(job.id, offset):
+                # Redelivered after a successful publish (e.g. the ack was
+                # lost): the result is already out — publishing again
+                # would double-count downstream. Settle silently.
+                self.logger.info(
+                    "Suppressing duplicate result for job %s (offset %d)",
+                    job.id,
+                    offset,
+                    extra={"job_id": job.id},
+                )
+                emit_trace_event(
+                    job.id, "duplicate_suppressed", worker_id=self.worker_id
+                )
+            else:
+                await self._publish_result(result)
+                self._dedup.record(job.id, offset)
             await message.ack()
             self.jobs_processed += 1
             self.total_duration_ms += duration_ms
@@ -241,6 +285,13 @@ class BaseWorker(abc.ABC):
                     self.jobs_processed,
                     self.total_duration_ms / self.jobs_processed,
                 )
+        except JobHandoff as exc:
+            # Drain-with-handoff: the engine resolved this request with a
+            # snapshot of its partial progress instead of a completion.
+            # Republish the job carrying that snapshot so a peer (or this
+            # worker after restart) resumes mid-stream. Must be caught
+            # before the generic ladders: a handoff is not a failure.
+            await self._republish_for_resume(job, message, trace, exc)
         except (asyncio.TimeoutError, TimeoutError) as exc:
             # Hung engine step / stuck backend: the job slot must come
             # back. Requeue; the broker dead-letters past the redelivery
@@ -255,6 +306,9 @@ class BaseWorker(abc.ABC):
             self.jobs_timed_out += 1
             emit_trace_event(
                 job.id, "requeued", worker_id=self.worker_id, reason="timeout"
+            )
+            self._note_retry_exhausted(
+                job, message.delivery_count, trace, reason="timeout"
             )
             await message.reject(requeue=True)
         except ValueError as exc:
@@ -283,10 +337,102 @@ class BaseWorker(abc.ABC):
             emit_trace_event(
                 job.id, "requeued", worker_id=self.worker_id, reason=str(exc)
             )
+            self._note_retry_exhausted(
+                job, message.delivery_count, trace, reason=str(exc)
+            )
             await message.reject(requeue=True)
         finally:
             self._job_traces.pop(job.id, None)
             self._settle_in_flight()
+
+    def _note_retry_exhausted(
+        self, job: Job, delivery_count: int, trace: dict, *, reason: str
+    ) -> None:
+        """Flag a requeue that the broker will dead-letter (this attempt
+        pushed the job past the redelivery cap). The trace record itself
+        never ships on a requeue — redelivery re-reads the original
+        payload — so `llmq-tpu trace` recovers this moment from the DLQ
+        headers; the event here feeds the live metrics plane."""
+        if delivery_count + 1 > self.config.max_redeliveries:
+            trace_event(
+                trace,
+                "retry_exhausted",
+                worker_id=self.worker_id,
+                redeliveries=delivery_count,
+                reason=reason,
+            )
+            emit_trace_event(
+                job.id,
+                "retry_exhausted",
+                worker_id=self.worker_id,
+                redeliveries=delivery_count,
+            )
+
+    async def _republish_for_resume(
+        self,
+        job: Job,
+        message: DeliveredMessage,
+        trace: dict,
+        exc: JobHandoff,
+    ) -> None:
+        """Publish a draining request back to the job queue with its
+        engine snapshot riding under ``RESUME_FIELD``, then ack the
+        original delivery — at-least-once safe: until the ack lands the
+        original message survives, and the result deduper suppresses the
+        double-publish if both copies eventually complete. A snapshot-less
+        handoff (the request never entered the engine) requeues the
+        original message untouched."""
+        if exc.snapshot_b64 is None:
+            emit_trace_event(
+                job.id, "requeued", worker_id=self.worker_id, reason="shutdown"
+            )
+            await message.reject(requeue=True)
+            return
+        try:
+            payload = json.loads(message.body)
+        except Exception:  # noqa: BLE001 — parsed once already; paranoia
+            await message.reject(requeue=True)
+            return
+        trace_event(
+            trace,
+            "handoff",
+            worker_id=self.worker_id,
+            emitted=exc.emitted,
+        )
+        payload[RESUME_FIELD] = {
+            "snapshot": exc.snapshot_b64,
+            "offset": exc.emitted,
+        }
+        # The republished copy carries the accumulated trace so the
+        # resuming worker's record keeps the full lifecycle (submitted →
+        # claimed → handoff → claimed → finished).
+        payload[TRACE_FIELD] = trace
+        emit_trace_event(
+            job.id, "handoff", worker_id=self.worker_id, emitted=exc.emitted
+        )
+        try:
+            await self.broker.broker.publish(
+                self.queue,
+                json.dumps(payload).encode("utf-8"),
+                message_id=job.id,
+            )
+        except Exception:  # noqa: BLE001 — transport down mid-shutdown
+            # Couldn't ship the snapshot: fall back to plain redelivery
+            # (recompute-from-scratch, still exactly-one-result).
+            self.logger.warning(
+                "Resume republish failed for job %s; requeueing plain",
+                job.id,
+                exc_info=True,
+            )
+            await message.reject(requeue=True)
+            return
+        self.logger.info(
+            "Job %s handed off with %d tokens generated",
+            job.id,
+            exc.emitted,
+            extra={"job_id": job.id},
+        )
+        await message.ack()
 
     async def _run_with_timeout(self, job: Job) -> str:
         timeout = self.config.job_timeout_s
@@ -349,6 +495,11 @@ class BaseWorker(abc.ABC):
             job.get_formatted_prompt() if job.prompt is not None else ""
         )
         payload = dict(job.extras())
+        # The resume blob must not ride into the result (it is large and
+        # spent); keep only the offset the resumed run started from.
+        resume = payload.pop(RESUME_FIELD, None)
+        if isinstance(resume, dict):
+            payload["resume_offset"] = resume_offset({RESUME_FIELD: resume})
         reserved = {
             "id": job.id,
             "prompt": prompt_repr,

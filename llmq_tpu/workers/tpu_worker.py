@@ -23,8 +23,9 @@ from pathlib import Path
 from typing import Optional
 
 from llmq_tpu.core.models import Job
-from llmq_tpu.obs import trace_event_at
+from llmq_tpu.obs import trace_event, trace_event_at
 from llmq_tpu.workers.base import BaseWorker
+from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff
 
 PRESET_SCHEMES = ("preset://", "dummy://", "random://")
 
@@ -334,6 +335,22 @@ class TPUWorker(BaseWorker):
         )
         return AsyncEngine(core)
 
+    async def _handoff_in_flight(self) -> None:
+        """SIGTERM drain-with-handoff: extract every unfinished request
+        from the engine as a snapshot. Their pending generate()/resume()
+        awaits resolve with HandoffOutputs, which _process_job turns into
+        JobHandoff republishes — partial progress goes back to the broker
+        instead of being recomputed from scratch elsewhere."""
+        if self.engine is None:
+            return
+        loop = asyncio.get_running_loop()
+        handoffs = await loop.run_in_executor(None, self.engine.handoff)
+        if handoffs:
+            self.logger.info(
+                "Drained %d in-flight request(s) as resumable snapshots",
+                len(handoffs),
+            )
+
     async def _cleanup_processor(self) -> None:
         if self.engine is not None:
             loop = asyncio.get_running_loop()
@@ -365,20 +382,77 @@ class TPUWorker(BaseWorker):
                 params.stop = tuple(opts.stop)
         return params
 
+    def _resume_snapshot(self, job: Job):
+        """Deserialize the resume snapshot a handed-off job carries, or
+        None to process from scratch — on any codec/compat problem the
+        prompt is still in the payload, so re-running from token zero is
+        always available and always correct."""
+        from llmq_tpu.engine.snapshot import SnapshotError, snapshot_from_b64
+
+        resume = job.extras().get(RESUME_FIELD)
+        if not isinstance(resume, dict) or not resume.get("snapshot"):
+            return None
+        try:
+            return snapshot_from_b64(resume["snapshot"])
+        except SnapshotError as exc:
+            self.logger.warning(
+                "Job %s resume snapshot unusable (%s); re-running from "
+                "scratch",
+                job.id,
+                exc,
+                extra={"job_id": job.id},
+            )
+            return None
+
     async def _process_job(self, job: Job) -> str:
+        from llmq_tpu.engine.engine import HandoffOutput
+        from llmq_tpu.engine.snapshot import SnapshotError, snapshot_to_b64
+
         params = self._sampling_for(job)
-        if job.messages is not None:
-            out = await self.engine.generate(
-                rid=job.id, messages=job.messages, params=params
-            )
-        elif job.chat_mode:
-            messages = [{"role": "user", "content": job.get_formatted_prompt()}]
-            out = await self.engine.generate(
-                rid=job.id, messages=messages, params=params
-            )
-        else:
-            out = await self.engine.generate(
-                rid=job.id, prompt=job.get_formatted_prompt(), params=params
+        out = None
+        snapshot = self._resume_snapshot(job)
+        if snapshot is not None:
+            trace = self._job_traces.get(job.id)
+            if trace is not None:
+                trace_event(
+                    trace, "resumed", offset=len(snapshot.output_ids)
+                )
+            try:
+                out = await self.engine.resume(rid=job.id, snapshot=snapshot)
+            except SnapshotError as exc:
+                # Valid blob, wrong engine (model signature / KV dtype
+                # mismatch) — recompute from the prompt instead.
+                self.logger.warning(
+                    "Job %s snapshot not insertable (%s); re-running from "
+                    "scratch",
+                    job.id,
+                    exc,
+                    extra={"job_id": job.id},
+                )
+        if out is None:
+            if job.messages is not None:
+                out = await self.engine.generate(
+                    rid=job.id, messages=job.messages, params=params
+                )
+            elif job.chat_mode:
+                messages = [
+                    {"role": "user", "content": job.get_formatted_prompt()}
+                ]
+                out = await self.engine.generate(
+                    rid=job.id, messages=messages, params=params
+                )
+            else:
+                out = await self.engine.generate(
+                    rid=job.id, prompt=job.get_formatted_prompt(), params=params
+                )
+        if isinstance(out, HandoffOutput):
+            # This worker is draining: surface the partial progress to the
+            # base loop, which republishes the job as resumable.
+            raise JobHandoff(
+                snapshot_to_b64(out.snapshot)
+                if out.snapshot is not None
+                else None,
+                out.emitted,
             )
         self._usage[job.id] = {
             "prompt_tokens": out.prompt_tokens,

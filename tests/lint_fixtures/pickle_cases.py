@@ -1,0 +1,50 @@
+"""pickle-snapshot: raw pickle on snapshot/broker payloads."""
+
+import json
+import pickle
+
+import cloudpickle
+from pickle import loads as unpickle
+
+
+def bad_loads_broker_bytes(message):
+    return pickle.loads(message.body)  # EXPECT[pickle-snapshot]
+
+
+def bad_load_file(fh):
+    return pickle.load(fh)  # EXPECT[pickle-snapshot]
+
+
+def bad_from_import_alias(body):
+    return unpickle(body)  # EXPECT[pickle-snapshot]
+
+
+def bad_cloudpickle_loads(body):
+    return cloudpickle.loads(body)  # EXPECT[pickle-snapshot]
+
+
+def bad_dumps_snapshot(snapshot):
+    return pickle.dumps(snapshot)  # EXPECT[pickle-snapshot]
+
+
+def bad_dumps_snapshot_attr(request):
+    return pickle.dumps(request.snap_state)  # EXPECT[pickle-snapshot]
+
+
+def ok_dumps_local_cache(table):
+    # Serializing non-snapshot state is outside this rule's blast radius
+    # (still unpicklable elsewhere, but that load would be flagged).
+    return pickle.dumps(table)
+
+
+def ok_json_roundtrip(snapshot_meta):
+    return json.loads(json.dumps(snapshot_meta))
+
+
+def ok_unrelated_loads_method(codec, body):
+    # Not the pickle module: a codec object with a loads() method.
+    return codec.loads(body)
+
+
+def suppressed_local_only(fh):
+    return pickle.load(fh)  # llmq: ignore[pickle-snapshot]

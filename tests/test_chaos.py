@@ -13,11 +13,12 @@ import json
 
 import pytest
 
-from llmq_tpu.broker.chaos import ChaosBroker
+from llmq_tpu.broker.chaos import ChaosBroker, WorkerKillSwitch
 from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import Config
 from llmq_tpu.core.models import Job
 from llmq_tpu.workers.dummy import DummyWorker
+from llmq_tpu.workers.tpu_worker import TPUWorker
 
 pytestmark = pytest.mark.chaos
 
@@ -157,6 +158,241 @@ class TestChaosSoak:
             finally:
                 worker.request_shutdown()
                 await asyncio.wait_for(task, timeout=30.0)
+
+
+def _tpu_worker(ns: str, queue: str, **engine_kw) -> TPUWorker:
+    cfg = Config(broker_url=f"memory://{ns}", max_redeliveries=1000)
+    kw = dict(
+        model="preset://tiny",
+        tensor_parallel=1,
+        max_model_len=96,
+        num_pages=64,
+        page_size=8,
+        dtype="float32",
+        max_num_seqs=4,
+    )
+    kw.update(engine_kw)
+    return TPUWorker(queue, config=cfg, concurrency=8, **kw)
+
+
+def _kill_jobs(n=6, max_tokens=24):
+    """Greedy, ignore_eos jobs with staggered prompt lengths so prefill
+    needs multiple dispatches and page use differs per row."""
+    return [
+        Job(
+            id=f"k{i}",
+            prompt="resume test " + "ab " * (i + 1),
+            temperature=0.0,
+            max_tokens=max_tokens,
+            ignore_eos=True,
+        )
+        for i in range(n)
+    ]
+
+
+async def _collect_all_payloads(mgr, queue, want_ids, timeout=180.0, grace=1.0):
+    """Collect EVERY result payload (no dedup): the exactly-one-result
+    invariant needs duplicates to be visible, so after all expected ids
+    arrive we keep draining for a grace window to catch stragglers."""
+    payloads = []
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    grace_end = None
+    while True:
+        msg = await mgr.broker.get(queue)
+        if msg is not None:
+            payloads.append(json.loads(msg.body))
+            await msg.ack()
+            grace_end = None  # new arrival: restart the quiet window
+            continue
+        got = {p["id"] for p in payloads}
+        if want_ids <= got:
+            if grace_end is None:
+                grace_end = loop.time() + grace
+            elif loop.time() >= grace_end:
+                return payloads
+        else:
+            assert loop.time() < deadline, (
+                f"missing results for {sorted(want_ids - got)}"
+            )
+        await asyncio.sleep(0.05)
+
+
+#: id -> greedy text from a kill-free run, keyed by engine config. Shared
+#: across the parametrized kill legs (prefill and decode use the same
+#: engine; one baseline build serves both).
+_BASELINES: dict = {}
+
+
+async def _baseline_texts(ns: str, jobs, engine_kw) -> dict:
+    key = tuple(sorted(engine_kw.items())) + (len(jobs), jobs[0].max_tokens)
+    if key not in _BASELINES:
+        try:
+            async with BrokerManager(
+                Config(broker_url=f"memory://{ns}", max_redeliveries=1000)
+            ) as mgr:
+                await mgr.setup_queue_infrastructure("bq")
+                for j in jobs:
+                    await mgr.publish_job("bq", j)
+                worker = _tpu_worker(ns, "bq", **engine_kw)
+                task = asyncio.ensure_future(worker.run())
+                try:
+                    payloads = await _collect_all_payloads(
+                        mgr, "bq.results", {j.id for j in jobs}, grace=0.2
+                    )
+                finally:
+                    worker.request_shutdown()
+                    await asyncio.wait_for(task, timeout=60.0)
+            _BASELINES[key] = {p["id"]: p["result"] for p in payloads}
+        finally:
+            import llmq_tpu.broker.memory as memory_broker
+
+            memory_broker.reset_namespace(ns)
+    return _BASELINES[key]
+
+
+class TestChaosKillResume:
+    """Seeded worker kills mid-phase; the fleet invariant is that every
+    submitted job yields exactly one result whose greedy tokens are
+    identical to a kill-free run.
+
+    The kill is SIGTERM semantics (``request_shutdown`` fired from the
+    engine's dispatch hook): the dying worker drains with handoff,
+    publishing snapshots of unfinished requests back to the queue; a
+    second worker resumes them mid-stream. Requests the snapshot plane
+    cannot carry fall back to plain redelivery — recompute from scratch,
+    still exactly one result."""
+
+    # (phase, seed, engine overrides). The decode leg runs with spec off
+    # so decode_block dispatches exist; the verify leg needs speculation
+    # on for spec-verify dispatches to exist at all.
+    LEGS = [
+        ("prefill", 11, {}),
+        ("decode", 12, {}),
+        ("verify", 13, {"spec_tokens": 2}),
+    ]
+
+    @pytest.mark.parametrize(
+        "phase, seed, engine_kw", LEGS, ids=[leg[0] for leg in LEGS]
+    )
+    async def test_seeded_kill_exactly_one_identical_result(
+        self, mem_ns, phase, seed, engine_kw
+    ):
+        jobs = _kill_jobs()
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, engine_kw)
+        assert set(baseline) == want_ids
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("kq")
+            for j in jobs:
+                await mgr.publish_job("kq", j)
+
+            w1 = _tpu_worker(mem_ns, "kq", **engine_kw)
+            switch = WorkerKillSwitch(
+                phase, w1.request_shutdown, seed=seed, after_range=(1, 2)
+            )
+            # Wrap engine construction so the switch is installed before
+            # the first dispatch — hooking after run() starts would race
+            # the consumer.
+            orig_build = w1._build_engine
+
+            def build_with_switch():
+                engine = orig_build()
+                engine.core.on_dispatch = switch
+                return engine
+
+            w1._build_engine = build_with_switch
+            t1 = asyncio.ensure_future(w1.run())
+            # The switch fires request_shutdown mid-run; the worker then
+            # drains with handoff and exits on its own.
+            await asyncio.wait_for(t1, timeout=180.0)
+            assert switch.fired, f"no {phase} dispatch before completion"
+
+            w2 = _tpu_worker(mem_ns, "kq", **engine_kw)
+            t2 = asyncio.ensure_future(w2.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "kq.results", want_ids
+                )
+            finally:
+                w2.request_shutdown()
+                await asyncio.wait_for(t2, timeout=60.0)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged from kill-free run"
+            )
+
+    async def test_drain_handoff_resumes_mid_stream(self, mem_ns):
+        """Deterministic handoff: shut a worker down while long greedy
+        generations are mid-decode. The republished jobs must carry
+        resume snapshots, and the resuming worker's results must be
+        token-identical with a nonzero resume offset — proof the second
+        worker continued mid-stream instead of re-prefilling."""
+        from llmq_tpu.obs import trace_from_payload
+
+        engine_kw = {"max_model_len": 160, "num_pages": 96}
+        jobs = _kill_jobs(n=4, max_tokens=120)
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, engine_kw)
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("hq")
+            for j in jobs:
+                await mgr.publish_job("hq", j)
+
+            # Drive worker 1 manually (initialize + consume, no run()
+            # loop) so shutdown starts the moment requests are observed
+            # running — no 1 s poll lag for generations to slip through.
+            w1 = _tpu_worker(mem_ns, "hq", **engine_kw)
+            await w1.initialize()
+            w1.running = True
+            w1._consumer_tag = await w1.broker.consume_jobs(
+                "hq", w1._process_message, prefetch=w1.concurrency
+            )
+            deadline = asyncio.get_running_loop().time() + 60.0
+            while not w1.engine.core.scheduler.running:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "no request ever started running"
+                )
+                await asyncio.sleep(0.01)
+            w1.running = False
+            await w1.shutdown()
+
+            w2 = _tpu_worker(mem_ns, "hq", **engine_kw)
+            t2 = asyncio.ensure_future(w2.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "hq.results", want_ids
+                )
+            finally:
+                w2.request_shutdown()
+                await asyncio.wait_for(t2, timeout=60.0)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        resumed = [p for p in payloads if p.get("resume_offset", 0) > 0]
+        assert resumed, "no job resumed from a snapshot (all re-prefilled?)"
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged after handoff"
+            )
+        # The resumed results' traces carry the full lifecycle across
+        # both workers: handoff stamped by the dying worker, resumed by
+        # the successor.
+        for p in resumed:
+            trace = trace_from_payload(p)
+            assert trace is not None
+            names = [e["name"] for e in trace["events"]]
+            assert "handoff" in names and "resumed" in names, names
+            assert names.count("claimed") == 2, names
 
 
 class TestChaosTrace:

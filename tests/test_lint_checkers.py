@@ -36,6 +36,7 @@ CASES = [
     ("jax_cases.py", {"jax-host-sync", "jax-donate"}),
     ("collective_axis_cases.py", {"collective-axis"}),
     ("wallclock_cases.py", {"wallclock-duration"}),
+    ("pickle_cases.py", {"pickle-snapshot"}),
 ]
 
 

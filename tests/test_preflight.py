@@ -278,6 +278,23 @@ def test_metrics_probe_runs():
     assert "metric: obs_probe_ok" in proc.stdout
 
 
+def test_snapshot_probe_runs():
+    """The durable-state rung runs end to end on CPU: snapshot
+    extract→b64→insert with a bit-identical continuation, swap-preempt
+    parity with recompute under a tight pool, and a seeded kill-resume
+    mini-chaos on the memory broker with exactly one result per job."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/snapshot_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:snapshot_probe", proc)
+    assert "roundtrip leg ok" in proc.stdout
+    assert "swap leg ok" in proc.stdout
+    assert "kill-resume leg ok" in proc.stdout
+    assert "metric: snapshot_probe_ok" in proc.stdout
+
+
 def test_bench_tiny_int4_runs():
     """One representative bench command runs end to end on CPU with the
     int4 group-quantized weight ladder, emitting the metric line with

@@ -1,0 +1,521 @@
+"""Snapshot plane: codec round trips, integrity failure modes, and
+engine-level extract→serialize→insert parity.
+
+The load-bearing property everywhere is BIT-exactness: a request pulled
+out of an engine mid-decode and pushed back in (same engine, a different
+engine, or after a host round trip through the broker) must continue with
+exactly the tokens the uninterrupted run would have produced. KV pages
+serialize in their stored dtype — fp8/bf16 pools round-trip their raw
+bits, never a dequantize→requantize pass — so the property holds for
+quantized caches too.
+"""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmq_tpu.engine.engine import (
+    AsyncEngine,
+    EngineConfig,
+    EngineCore,
+    HandoffOutput,
+)
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.snapshot import (
+    MAGIC,
+    RequestSnapshot,
+    SnapshotCompatError,
+    SnapshotError,
+    SnapshotIntegrityError,
+    SnapshotVersionError,
+    pages_for,
+    repack_pages,
+    snapshot_from_b64,
+    snapshot_to_b64,
+)
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.unit
+
+CFG = ModelConfig.tiny(vocab_size=304)
+PARAMS_F32 = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def make_core(params=None, tp=1, **overrides) -> EngineCore:
+    defaults = dict(
+        max_num_seqs=4,
+        max_model_len=64,
+        page_size=8,
+        num_pages=40,
+        kv_dtype=jnp.float32,
+        min_prefill_bucket=16,
+    )
+    defaults.update(overrides)
+    return EngineCore(
+        CFG,
+        PARAMS_F32 if params is None else params,
+        ByteTokenizer(),
+        mesh=make_mesh(tensor_parallel=tp),
+        engine_config=EngineConfig(**defaults),
+    )
+
+
+def greedy(max_tokens=16, **kw):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True, **kw
+    )
+
+
+def run_to_completion(core, requests):
+    for rid, prompt, params in requests:
+        core.add_request(rid, prompt=prompt, params=params)
+    return drain(core, len(requests))
+
+
+def drain(core, expect):
+    outs = {}
+    for _ in range(2000):
+        for out in core.step():
+            outs[out.rid] = out
+        if not core.has_work:
+            break
+    assert len(outs) == expect, f"engine stalled: {len(outs)}/{expect}"
+    return outs
+
+
+def step_until_tokens(core, rid, k):
+    """Step until ``rid`` has at least ``k`` generated tokens (and is
+    still running)."""
+    for _ in range(2000):
+        core.step()
+        seq = core.scheduler.running.get(rid)
+        if seq is not None and len(seq.output_ids) >= k:
+            return
+    raise AssertionError(f"{rid} never reached {k} tokens")
+
+
+# --------------------------------------------------------------------------
+# Codec: pure host-side round trips and failure modes
+# --------------------------------------------------------------------------
+
+
+def _codec_snapshot(kv_dtype=np.float32) -> RequestSnapshot:
+    rng = np.random.default_rng(7)
+    kv = rng.standard_normal((2, 3, 8, 2, 4), dtype=np.float32)
+    return RequestSnapshot(
+        rid="codec-1",
+        model_sig={"num_layers": 2, "kv_dtype": "float32"},
+        page_size=8,
+        prompt_ids=[5, 6, 7, 8],
+        output_ids=[9, 10, 11],
+        params=SamplingParams(
+            temperature=0.0, max_tokens=32, seed=3, stop=("END",)
+        ),
+        key_data=rng.integers(0, 2**32, size=4, dtype=np.uint32),
+        epoch=2,
+        preempt_count=1,
+        detok_len=3,
+        detok_text="abc",
+        kv_valid=20,
+        kv_k=kv.astype(kv_dtype),
+        kv_v=(-kv).astype(kv_dtype),
+    )
+
+
+class TestCodec:
+    @pytest.mark.parametrize(
+        "kv_dtype",
+        [np.float32, "bfloat16", "float8_e5m2"],
+        ids=["f32", "bf16", "fp8"],
+    )
+    def test_round_trip_bit_exact(self, kv_dtype):
+        import ml_dtypes
+
+        if isinstance(kv_dtype, str):
+            kv_dtype = np.dtype(getattr(ml_dtypes, kv_dtype))
+        snap = _codec_snapshot(kv_dtype)
+        blob = snap.to_bytes()
+        back = RequestSnapshot.from_bytes(blob)
+        assert back.rid == snap.rid
+        assert back.model_sig == snap.model_sig
+        assert back.prompt_ids == snap.prompt_ids
+        assert back.output_ids == snap.output_ids
+        assert dataclasses.asdict(back.params) == dataclasses.asdict(
+            snap.params
+        )
+        assert np.array_equal(back.key_data, snap.key_data)
+        assert (back.epoch, back.preempt_count) == (2, 1)
+        assert (back.detok_len, back.detok_text) == (3, "abc")
+        assert back.kv_k.dtype == kv_dtype and back.kv_v.dtype == kv_dtype
+        # Raw-bit equality, not value equality: quantized dtypes must
+        # ship their stored bits untouched (and NaN payloads survive).
+        assert np.array_equal(
+            back.kv_k.view(np.uint8), snap.kv_k.view(np.uint8)
+        )
+        assert np.array_equal(
+            back.kv_v.view(np.uint8), snap.kv_v.view(np.uint8)
+        )
+        # Re-serialization is byte-identical: the codec is canonical.
+        assert back.to_bytes() == blob
+
+    def test_round_trip_without_kv(self):
+        snap = _codec_snapshot()
+        snap.kv_k = snap.kv_v = None
+        snap.kv_valid = 0
+        back = RequestSnapshot.from_bytes(snap.to_bytes())
+        assert back.kv_k is None and back.kv_v is None
+        assert back.kv_valid == 0
+
+    def test_b64_round_trip(self):
+        snap = _codec_snapshot()
+        assert snapshot_from_b64(snapshot_to_b64(snap)).to_bytes() == (
+            snap.to_bytes()
+        )
+
+    def test_b64_garbage_rejected(self):
+        with pytest.raises(SnapshotError):
+            snapshot_from_b64("not base64 at all!!!")
+        with pytest.raises(SnapshotError):
+            snapshot_from_b64("aGVsbG8=")  # valid b64, not a snapshot
+
+    def test_bad_magic_rejected(self):
+        blob = bytearray(_codec_snapshot().to_bytes())
+        blob[:4] = b"XXXX"
+        with pytest.raises(SnapshotError):
+            RequestSnapshot.from_bytes(bytes(blob))
+
+    def test_tampered_body_fails_integrity(self):
+        blob = bytearray(_codec_snapshot().to_bytes())
+        blob[-1] ^= 0xFF
+        with pytest.raises(SnapshotIntegrityError):
+            RequestSnapshot.from_bytes(bytes(blob))
+
+    def test_tampered_header_fails_integrity(self):
+        blob = bytearray(_codec_snapshot().to_bytes())
+        # Flip a byte inside the JSON header region (past magic+ver+digest
+        # +len): digest must catch metadata tampering too.
+        blob[len(MAGIC) + 2 + 16 + 4 + 5] ^= 0x01
+        with pytest.raises(SnapshotIntegrityError):
+            RequestSnapshot.from_bytes(bytes(blob))
+
+    def test_truncation_fails_integrity(self):
+        blob = _codec_snapshot().to_bytes()
+        with pytest.raises(SnapshotIntegrityError):
+            RequestSnapshot.from_bytes(blob[: len(blob) // 2])
+        with pytest.raises(SnapshotIntegrityError):
+            RequestSnapshot.from_bytes(blob[:10])
+
+    def test_future_version_rejected(self):
+        blob = bytearray(_codec_snapshot().to_bytes())
+        blob[len(MAGIC)] = 0xFF  # version u16 LE low byte → 255
+        with pytest.raises(SnapshotVersionError):
+            RequestSnapshot.from_bytes(bytes(blob))
+
+    def test_pages_for(self):
+        assert pages_for(0, 8) == 0
+        assert pages_for(1, 8) == 1
+        assert pages_for(8, 8) == 1
+        assert pages_for(9, 8) == 2
+
+    def test_repack_pages_preserves_valid_prefix(self):
+        rng = np.random.default_rng(3)
+        kv = rng.standard_normal((2, 3, 8, 2, 4), dtype=np.float32)
+        valid = 20
+        out = repack_pages(kv, valid, 4, 6)
+        assert out.shape == (2, 6, 4, 2, 4)
+        flat_src = kv.reshape(2, -1, 2, 4)[:, :valid]
+        flat_dst = out.reshape(2, -1, 2, 4)
+        assert np.array_equal(flat_dst[:, :valid], flat_src)
+        assert not flat_dst[:, valid:].any()
+        # Round trip back to the original tiling.
+        back = repack_pages(out, valid, 8, 3)
+        assert np.array_equal(
+            back.reshape(2, -1, 2, 4)[:, :valid], flat_src
+        )
+
+    def test_repack_pages_overflow_rejected(self):
+        kv = np.zeros((1, 2, 8, 1, 4), np.float32)
+        with pytest.raises(SnapshotCompatError):
+            repack_pages(kv, 16, 4, 3)
+
+
+# --------------------------------------------------------------------------
+# Engine: extract → (serialize) → insert parity
+# --------------------------------------------------------------------------
+
+PROMPT = "the quick brown snapshot"
+
+
+def _engine_kw_for(kv, weights):
+    if weights == "f32":
+        return {"params": PARAMS_F32, "kv_dtype": kv}
+    # Quantized weights compute in bf16 (models/quant.py), so their KV
+    # pools default to bf16 as well.
+    params = init_params(
+        CFG, jax.random.key(0), dtype=jnp.bfloat16, quantize=weights
+    )
+    return {"params": params, "kv_dtype": kv}
+
+
+class TestEngineRoundTrip:
+    @pytest.mark.parametrize(
+        "kv, weights",
+        [
+            (jnp.float32, "f32"),
+            (jnp.bfloat16, "f32"),
+            (jnp.float8_e5m2, "f32"),
+            (jnp.bfloat16, "int8"),
+            (jnp.float8_e5m2, "int4"),
+        ],
+        ids=["kv-f32", "kv-bf16", "kv-fp8", "int8-kv-bf16", "int4-kv-fp8"],
+    )
+    def test_extract_serialize_insert_bit_identical(self, kv, weights):
+        """Mid-decode extract, full wire round trip, insert into a FRESH
+        engine: greedy continuation is token-identical to never having
+        been interrupted — for every KV/weight dtype combo."""
+        kw = _engine_kw_for(kv, weights)
+        baseline = run_to_completion(
+            make_core(**kw), [("r0", PROMPT, greedy(16))]
+        )["r0"]
+
+        src = make_core(**kw)
+        src.add_request("r0", prompt=PROMPT, params=greedy(16))
+        step_until_tokens(src, "r0", 5)
+        snap = src.extract_request("r0")
+        assert "r0" not in src.scheduler.running
+        assert src.snapshots_extracted == 1
+        assert snap.kv_valid > 0 and snap.kv_k is not None
+        assert snap.kv_k.dtype == np.asarray(jnp.zeros((), kv)).dtype
+
+        wire = snapshot_from_b64(snapshot_to_b64(snap))
+        dst = make_core(**kw)
+        dst.insert_request(wire)
+        out = drain(dst, 1)["r0"]
+        assert out.token_ids == baseline.token_ids
+        assert out.text == baseline.text
+        assert out.finish_reason == baseline.finish_reason
+        assert dst.snapshots_inserted == 1 and dst.kv_restores == 1
+
+    def test_insert_rejects_kv_dtype_mismatch(self):
+        src = make_core(kv_dtype=jnp.float32)
+        src.add_request("r0", prompt=PROMPT, params=greedy(12))
+        step_until_tokens(src, "r0", 3)
+        snap = src.extract_request("r0")
+        dst = make_core(kv_dtype=jnp.bfloat16)
+        with pytest.raises(SnapshotCompatError):
+            dst.insert_request(snap)
+
+    def test_insert_rejects_tampered_key_chain(self):
+        src = make_core()
+        src.add_request("r0", prompt=PROMPT, params=greedy(12))
+        step_until_tokens(src, "r0", 3)
+        snap = src.extract_request("r0")
+        snap.key_data = np.asarray(snap.key_data, np.uint32) ^ np.uint32(1)
+        with pytest.raises(SnapshotCompatError):
+            make_core().insert_request(snap)
+
+    def test_insert_duplicate_rid_rejected(self):
+        src = make_core()
+        src.add_request("r0", prompt=PROMPT, params=greedy(12))
+        step_until_tokens(src, "r0", 3)
+        snap = src.extract_request("r0")
+        dst = make_core()
+        dst.insert_request(snap)
+        with pytest.raises(ValueError):
+            dst.insert_request(snap)
+
+    def test_cross_page_size_insert(self):
+        """A snapshot taken on an 8-token-page engine continues exactly
+        on a 4-token-page engine: repack_pages re-tiles the KV."""
+        baseline = run_to_completion(
+            make_core(page_size=4, num_pages=80),
+            [("r0", PROMPT, greedy(16))],
+        )["r0"]
+        src = make_core(page_size=8, num_pages=40)
+        src.add_request("r0", prompt=PROMPT, params=greedy(16))
+        step_until_tokens(src, "r0", 6)
+        snap = src.extract_request("r0")
+        dst = make_core(page_size=4, num_pages=80)
+        dst.insert_request(snap)
+        out = drain(dst, 1)["r0"]
+        assert out.token_ids == baseline.token_ids
+
+    def test_cross_mesh_migration_tp1_to_tp2(self):
+        """State migration between differently-sharded engines: a
+        snapshot taken on a single-device engine continues bit-identically
+        on a tp=2 mesh (KV gathers to host on extract, scatters onto the
+        sharded pool on insert). MoE models stay pinned to sp=1 meshes —
+        see tests/test_moe_mixed_mesh.py."""
+        baseline = run_to_completion(
+            make_core(tp=2), [("m0", PROMPT, greedy(16))]
+        )["m0"]
+        src = make_core(tp=1)
+        src.add_request("m0", prompt=PROMPT, params=greedy(16))
+        step_until_tokens(src, "m0", 5)
+        wire = snapshot_from_b64(
+            snapshot_to_b64(src.extract_request("m0"))
+        )
+        dst = make_core(tp=2)
+        dst.insert_request(wire)
+        out = drain(dst, 1)["m0"]
+        assert out.token_ids == baseline.token_ids
+
+    def test_waiting_request_snapshot_reprefills(self):
+        """Extracting a request that never prefilled yields a KV-less
+        snapshot; insertion re-prefills — same tokens, no KV carried."""
+        core = make_core()
+        core.add_request("w0", prompt=PROMPT, params=greedy(8))
+        snap = core.extract_request("w0")  # still waiting: no step ran
+        assert snap.kv_valid == 0 and snap.kv_k is None
+        baseline = run_to_completion(
+            make_core(), [("w0", PROMPT, greedy(8))]
+        )["w0"]
+        dst = make_core()
+        dst.insert_request(snap)
+        out = drain(dst, 1)["w0"]
+        assert out.token_ids == baseline.token_ids
+
+    def test_extract_under_pool_pressure(self):
+        """Random-ish pool pressure: a tight pool with several live rows;
+        every request is extracted mid-flight at a different depth, wire
+        round-tripped, and finished on a fresh engine — all parities
+        hold at once."""
+        tight = dict(num_pages=14, max_num_seqs=3, max_model_len=64)
+        # Generous max_tokens headroom: extract_request drains the
+        # run-ahead pipeline, which advances every row a few tokens — a
+        # request too close to its cap would finish during the drain.
+        reqs = [
+            (f"p{i}", f"pressure prompt {i} " + "xy" * i, greedy(24))
+            for i in range(3)
+        ]
+        baseline = run_to_completion(make_core(**tight), list(reqs))
+
+        src = make_core(**tight)
+        for rid, prompt, params in reqs:
+            src.add_request(rid, prompt=prompt, params=params)
+        snaps = {}
+        for depth, (rid, _, _) in zip((2, 4, 6), reqs):
+            step_until_tokens(src, rid, depth)
+            snaps[rid] = snapshot_from_b64(
+                snapshot_to_b64(src.extract_request(rid))
+            )
+        dst = make_core(**tight)
+        for snap in snaps.values():
+            dst.insert_request(snap)
+        outs = drain(dst, len(reqs))
+        for rid, _, _ in reqs:
+            assert outs[rid].token_ids == baseline[rid].token_ids, rid
+
+
+class TestSwapPreemption:
+    TIGHT = dict(
+        num_pages=11, max_num_seqs=3, max_model_len=96, page_size=8
+    )
+    REQS = [
+        (f"s{i}", "hello request %d " % i + "ab" * (4 * i), greedy(30))
+        for i in range(3)
+    ]
+
+    def test_swap_matches_recompute_under_pressure(self):
+        """Pool-exhaustion preemption in swap-to-host mode restores KV
+        from the captured snapshot instead of re-prefilling; greedy
+        tokens must match recompute mode exactly, and the swap path must
+        actually engage (else this test proves nothing)."""
+        rec = make_core(preempt_mode="recompute", **self.TIGHT)
+        rec_outs = run_to_completion(rec, list(self.REQS))
+        assert rec.scheduler.preemptions > 0, (
+            "pool not tight enough to preempt — test config has drifted"
+        )
+        swap = make_core(preempt_mode="swap", **self.TIGHT)
+        swap_outs = run_to_completion(swap, list(self.REQS))
+        assert swap.swap_preempts > 0 and swap.kv_restores > 0
+        for rid, _, _ in self.REQS:
+            assert swap_outs[rid].token_ids == rec_outs[rid].token_ids, rid
+
+    def test_swap_soak_repeated_pressure(self):
+        """Tight-pool soak: several waves through a swap-mode engine keep
+        parity with recompute mode while preemptions keep firing."""
+        waves = [
+            [
+                (f"w{w}-{i}", f"wave {w} req {i} " + "cd" * (3 * i + w),
+                 greedy(24))
+                for i in range(3)
+            ]
+            for w in range(3)
+        ]
+        rec = make_core(preempt_mode="recompute", **self.TIGHT)
+        swap = make_core(preempt_mode="swap", **self.TIGHT)
+        for wave in waves:
+            rec_outs = run_to_completion(rec, list(wave))
+            swap_outs = run_to_completion(swap, list(wave))
+            for rid, _, _ in wave:
+                assert swap_outs[rid].token_ids == rec_outs[rid].token_ids
+        assert rec.scheduler.preemptions > 0
+        assert swap.swap_preempts > 0 and swap.kv_restores > 0
+        assert swap.swap_preempts == swap.kv_restores
+
+
+class TestAsyncHandoff:
+    async def test_handoff_resume_round_trip(self):
+        """AsyncEngine drain-with-handoff: an in-flight generate resolves
+        to a HandoffOutput whose snapshot, resumed on a second engine,
+        produces the exact uninterrupted output."""
+        baseline = run_to_completion(
+            make_core(), [("h0", PROMPT, greedy(24))]
+        )["h0"]
+
+        eng1 = AsyncEngine(make_core())
+        try:
+            task = asyncio.ensure_future(
+                eng1.generate(rid="h0", prompt=PROMPT, params=greedy(24))
+            )
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while "h0" not in eng1.core.scheduler.running:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await asyncio.get_running_loop().run_in_executor(
+                None, eng1.handoff
+            )
+            out = await task
+        finally:
+            eng1.shutdown()
+        assert isinstance(out, HandoffOutput)
+        assert out.snapshot is not None
+        assert out.emitted == len(out.snapshot.output_ids)
+        assert out.emitted < 24, "generation finished before the handoff"
+
+        eng2 = AsyncEngine(make_core())
+        try:
+            resumed = await eng2.resume(rid="h0", snapshot=out.snapshot)
+        finally:
+            eng2.shutdown()
+        assert resumed.token_ids == baseline.token_ids
+        assert resumed.text == baseline.text
+
+    async def test_draining_engine_refuses_new_work(self):
+        eng = AsyncEngine(make_core())
+        try:
+            task = asyncio.ensure_future(
+                eng.generate(rid="d0", prompt=PROMPT, params=greedy(32))
+            )
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while "d0" not in eng.core.scheduler.running:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await asyncio.get_running_loop().run_in_executor(
+                None, eng.handoff
+            )
+            with pytest.raises(RuntimeError, match="draining"):
+                await eng.generate(
+                    rid="d1", prompt="late", params=greedy(4)
+                )
+            await task
+        finally:
+            eng.shutdown()

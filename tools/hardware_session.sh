@@ -42,6 +42,10 @@ run 300 collectives   python tools/profile_collectives.py
 run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 # NB: `VAR=x run ...` would leak past the function call in bash — use
 # `env` so each override dies with its step.
+# Durable-state plane: snapshot round trip, swap-vs-recompute parity,
+# and a seeded kill-resume mini-chaos on the memory broker — proves
+# crash-resume holds with device-resident KV, not just on CPU.
+run 900 snapshot_probe python tools/snapshot_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
