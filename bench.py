@@ -499,6 +499,7 @@ def trim_plan(
     proven_s: float,
     int4_s: float = 0.0,
     mixed_s: float = 0.0,
+    prefix_s: float = 0.0,
 ) -> dict:
     """Budget-aware phase trimming (pure — unit-tested in
     tests/test_bench.py). Given the seconds left on LLMQ_BENCH_DEADLINE
@@ -515,11 +516,16 @@ def trim_plan(
       winning point (``mixed_s`` one extra build+measure),
     - ``tp_overlap``: the collective-matmul ring A/B at the winning
       point (``tp_overlap_s`` one extra build+measure; a no-op rung on
-      single-device meshes).
+      single-device meshes),
+    - ``prefix_rung``: the templated-traffic prefix-cache rung at the
+      winning point (``prefix_s`` one extra build + a cold/warm pair).
 
     The proven bf16 headline (``proven_s``) is the floor and is never
     dropped — a bench that measures *something* always beats a watchdog
-    0.0. Drop order is by speculation: the int4 attempt first (deepest
+    0.0. Drop order is by speculation: the prefix rung first (purely
+    diagnostic — it reports a hit rate and never replaces the headline
+    number, so shedding it loses telemetry, not the measurement), then
+    the int4 attempt (deepest
     quantization, narrowest numerics margin — the rung most likely to
     be vetoed by its parity tier anyway), then the tp-overlap rung (it
     only matters on multi-chip slices and the worker's auto mode can
@@ -533,6 +539,7 @@ def trim_plan(
     """
     # (name, cost) in DROP order: most speculative first.
     phases = (
+        ("prefix_rung", prefix_s),
         ("int4_ladder", int4_s),
         ("tp_overlap", tp_overlap_s),
         ("quant", quant_s),
@@ -721,6 +728,9 @@ def main() -> None:
         # The mixed-step rung is one extra build + measure at the
         # winning point.
         mixed_s=300.0,
+        # The templated-traffic prefix rung is one extra build + a
+        # short cold/warm pair at the winning point.
+        prefix_s=240.0,
         proven_s=300.0,
     )
     if not all(plan.values()):
@@ -984,7 +994,9 @@ def main() -> None:
         os.environ.get("LLMQ_BENCH_PREFILL_CHUNK", 64 if on_cpu else 256)
     )
 
-    def build_core(max_seqs, block, spec=0, tp_overlap="off", mixed="off"):
+    def build_core(
+        max_seqs, block, spec=0, tp_overlap="off", mixed="off", prefix=False
+    ):
         return EngineCore(
             config,
             params,
@@ -1009,9 +1021,15 @@ def main() -> None:
                 # Piggyback scheduling: fuse one prefill chunk into each
                 # decode dispatch (engine/engine.py mixed_step).
                 mixed_step=mixed,
+                # Content-addressed prefix reuse (engine/scheduler.py):
+                # only the templated-traffic rung turns it on — random
+                # headline prompts share no prefixes to cache. Prefix
+                # caching requires chunked prefill (the engine refuses
+                # otherwise), so a prefix build also gets a chunk size.
+                enable_prefix_caching=prefix,
                 prefill_chunk_size=(
                     mixed_chunk
-                    if (mixed == "on" or mixed_env == "on")
+                    if (prefix or mixed == "on" or mixed_env == "on")
                     else None
                 ),
                 # 128-token pages: the decode kernel DMAs one page
@@ -1283,6 +1301,99 @@ def main() -> None:
 
         gc.collect()
 
+    # Templated-traffic prefix rung at the winning (slots, K, spec)
+    # point: real fleets serve prompts that share a long template
+    # (system prompt, few-shot preamble), which the random headline
+    # prompts cannot represent. Build once more with the prefix cache
+    # on, seed the template's pages with a single cold request, then
+    # run a batch whose prompts all share that template — the warm
+    # pass must *reuse* the pages, not recompute them. Purely
+    # diagnostic: synchronized arrivals + one shared template are the
+    # cache's best case, so the warm tok/s never replaces the
+    # headline; the rung's product is the measured hit rate and the
+    # prefill_tokens fraction proving cached positions were skipped.
+    prefix_metrics: dict = {}
+    if plan["prefix_rung"] and os.environ.get(
+        "LLMQ_BENCH_TRY_PREFIX", "1"
+    ).lower() not in ("0", "false"):
+        try:
+            core = build_core(
+                max_seqs, best_block, best_spec,
+                mixed=mixed_resolved, prefix=True,
+            )
+            # Shared template: ~3/4 of the prompt, rounded down to the
+            # page size so whole pages land in the cache; random
+            # per-request tails keep the suffix (and sampling) honest.
+            tmpl_len = max(
+                page_size, (prompt_len * 3 // 4) // page_size * page_size
+            )
+            template_ids = rng.integers(
+                1, config.vocab_size, size=tmpl_len
+            ).tolist()
+
+            def run_templated(n, tag):
+                for i in range(n):
+                    tail = rng.integers(
+                        1, config.vocab_size, size=prompt_len - tmpl_len
+                    ).tolist()
+                    core.add_request(
+                        f"{tag}-{i}",
+                        prompt_ids=template_ids + tail,
+                        params=sp(),
+                    )
+                done = 0
+                start = time.monotonic()
+                while core.has_work:
+                    done += len(core.step())
+                assert done == n, f"{done}/{n} finished"
+                return time.monotonic() - start
+
+            n_prefix = min(n_requests, max(core.cfg.max_prefill_batch, 8))
+            # Cold pass: compiles the chunked-prefill variants AND
+            # registers the template's pages — everything after it is
+            # the steady state a templated fleet lives in.
+            run_templated(1, "prefix-cold")
+            hits0 = core.scheduler.prefix_hits
+            miss0 = core.scheduler.prefix_misses
+            prefill0 = core.prefill_tokens
+            gen_before = core.total_generated_tokens
+            p_elapsed = run_templated(n_prefix, "prefix-warm")
+            p_out = core.total_generated_tokens - gen_before
+            hits = core.scheduler.prefix_hits - hits0
+            seen = hits + (core.scheduler.prefix_misses - miss0)
+            hit_rate = hits / seen if seen else 0.0
+            # Fraction of warm prompt positions actually computed —
+            # (1 - tmpl/prompt) when every template page hit.
+            prefill_frac = (core.prefill_tokens - prefill0) / (
+                n_prefix * prompt_len
+            )
+            print(
+                f"bench: prefix rung ({n_prefix} templated reqs, "
+                f"template {tmpl_len}/{prompt_len} tokens) -> hit rate "
+                f"{hit_rate:.3f}, prefill frac {prefill_frac:.3f}, "
+                f"{p_out / p_elapsed:.1f} tok/s warm",
+                file=sys.stderr,
+            )
+            prefix_metrics = {
+                "prefix_hit_rate": round(float(hit_rate), 4),
+                "prefix_prefill_frac": round(float(prefill_frac), 4),
+                "prefix_warm_tok_s_chip": round(
+                    p_out / p_elapsed / len(devices), 2
+                ),
+            }
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                "bench: prefix rung exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
     tok_s_chip = tok_s / len(devices)
     # MoE presets: throughput scales with ACTIVE params per token (the
     # FLOPs actually spent), not the total parameter count.
@@ -1329,6 +1440,10 @@ def main() -> None:
             "tp": int(mesh.shape[TP_AXIS]),
         },
         "tp_overlap": overlap_resolved,
+        # Templated-traffic prefix rung (absent when trimmed/opted out):
+        # hit rate, computed-prefill fraction, and the best-case warm
+        # throughput — diagnostics, never the headline.
+        **prefix_metrics,
         **(
             {"kv_dtype": kv_env}
             if kv_env not in ("", "auto")

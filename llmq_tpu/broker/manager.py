@@ -9,30 +9,86 @@ Pipeline routing fix (SURVEY.md §3.4): when a stage result hands off to the
 next stage, the *next stage's* prompt/messages template from the pipeline
 YAML is applied, with the previous output available as ``{result}`` alongside
 all passthrough extras. The reference only ever applied stage-1 templates.
+
+Prefix-affinity routing (``Config.prefix_affinity``): workers advertise the
+text-chain digests of their hottest cached prompt prefixes in heartbeats;
+``publish_job`` peeks those heartbeats (non-destructively, cached ~10 s) and
+routes a job whose prompt shares an advertised prefix to that worker's
+private queue ``<queue>.w.<worker_id>`` — the KV pages are already resident
+there, so the prefill restarts mid-prompt instead of from token zero. No
+match, stale heartbeat, or the flag off → the shared queue, unchanged.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
 from llmq_tpu.broker.resilient import ResilientBroker, SessionStats
 from llmq_tpu.core.config import Config, get_config
-from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result
+from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
 from llmq_tpu.core.template import resolve_template_string, resolve_template_value
 from llmq_tpu.obs import TRACE_FIELD, new_trace, trace_event, trace_from_payload
+from llmq_tpu.utils.hashing import text_prefix_chain
 
 logger = logging.getLogger(__name__)
 
 RESULTS_SUFFIX = ".results"
 FAILED_SUFFIX = ".failed"
+HEALTH_SUFFIX = ".health"
+
+# How long a cached affinity map is trusted before re-peeking heartbeats.
+AFFINITY_REFRESH_S = 10.0
+# A heartbeat older than this no longer routes jobs: the worker missed two
+# 30 s beats, so its advertised pages may be gone with it (matches the
+# monitor's staleness window, 2 × HEARTBEAT_INTERVAL_S).
+AFFINITY_FRESH_S = 60.0
 
 
 def results_queue_name(queue: str) -> str:
     return queue if queue.endswith(RESULTS_SUFFIX) else queue + RESULTS_SUFFIX
+
+
+def affinity_queue_name(queue: str, worker_id: str) -> str:
+    """Per-worker job queue prefix-affinity routing targets."""
+    return f"{queue}.w.{worker_id}"
+
+
+def kv_fetch_queue_name(queue: str, worker_id: str) -> str:
+    """Per-worker queue for cross-worker prefix-page fetch requests."""
+    return f"{queue}.kv.{worker_id}"
+
+
+def rendezvous_pick(digest: str, workers: List[str]) -> str:
+    """Deterministic owner among several workers advertising the same
+    digest (highest-random-weight hashing): every submitter picks the
+    same worker without coordination, and losing one advertiser only
+    remaps the chains it owned."""
+    return max(
+        workers,
+        key=lambda w: hashlib.blake2b(
+            (digest + "|" + w).encode("utf-8"), digest_size=8
+        ).digest(),
+    )
+
+
+def job_affinity_text(job: Job) -> str:
+    """The prompt text whose leading chunks identify the job's prefix —
+    the same characters the engine will tokenize, so text-chain digests
+    computed here match the ones workers advertise."""
+    try:
+        if job.prompt is not None:
+            return job.get_formatted_prompt()
+        if job.messages:
+            return "".join(str(m.get("content", "")) for m in job.messages)
+    except Exception:  # noqa: BLE001 — unresolvable template: no affinity
+        return ""
+    return ""
 
 
 class BrokerManager:
@@ -42,6 +98,12 @@ class BrokerManager:
         self.config = config or get_config()
         self.url = url or self.config.broker_url
         self._broker: Optional[Broker] = None
+        # Prefix-affinity routing state: per-queue {digest_hex: [worker_id]}
+        # maps plus the monotonic stamp of their last heartbeat peek.
+        self._affinity_map: Dict[str, Dict[str, List[str]]] = {}
+        self._affinity_at: Dict[str, float] = {}
+        self.affinity_routed = 0
+        self.affinity_fallback = 0
 
     @property
     def broker(self) -> Broker:
@@ -121,8 +183,87 @@ class BrokerManager:
             max_redeliveries=1_000_000_000,
         )
 
+    # --- worker heartbeats ------------------------------------------------
+    async def get_worker_health(self, queue: str) -> Dict[str, WorkerHealth]:
+        """Non-destructive heartbeat peek: the freshest WorkerHealth per
+        worker on ``<queue>.health``. Every message is requeued so the
+        next reader (monitor, another submitter) still sees it."""
+        beats: Dict[str, WorkerHealth] = {}
+        peeked: List[DeliveredMessage] = []
+        try:
+            while True:
+                msg = await self.broker.get(queue + HEALTH_SUFFIX)
+                if msg is None:
+                    break
+                peeked.append(msg)
+                try:
+                    health = WorkerHealth.model_validate_json(msg.body)
+                except Exception as exc:  # noqa: BLE001 — skip malformed
+                    logger.debug("Skipping malformed heartbeat: %s", exc)
+                    continue
+                prev = beats.get(health.worker_id)
+                if prev is None or health.last_seen >= prev.last_seen:
+                    beats[health.worker_id] = health
+        finally:
+            for msg in peeked:
+                await msg.reject(requeue=True)
+        return beats
+
+    async def affinity_targets(self, queue: str) -> Dict[str, List[str]]:
+        """``{text-chain digest hex: [worker_id, ...]}`` built from fresh
+        heartbeats, cached for ``AFFINITY_REFRESH_S`` so high-rate submit
+        loops don't peek the health queue per job."""
+        now = time.monotonic()
+        if now - self._affinity_at.get(queue, float("-inf")) < AFFINITY_REFRESH_S:
+            return self._affinity_map.get(queue, {})
+        mapping: Dict[str, List[str]] = {}
+        try:
+            beats = await self.get_worker_health(queue)
+        except Exception:  # noqa: BLE001 — health queue missing/unreadable
+            beats = {}
+        wall = utcnow()
+        for wid, health in beats.items():
+            if not health.prefix_chains:
+                continue
+            if (wall - health.last_seen).total_seconds() > AFFINITY_FRESH_S:
+                continue  # stale advertisement: pages may be gone with it
+            for digest in health.prefix_chains:
+                mapping.setdefault(digest, []).append(wid)
+        self._affinity_map[queue] = mapping
+        self._affinity_at[queue] = now
+        return mapping
+
+    async def _route_for_affinity(self, queue: str, job: Job) -> str:
+        """The queue this job should land on: the private queue of the
+        worker advertising the job's deepest prefix digest, or the shared
+        queue when nothing fresh matches."""
+        chain = text_prefix_chain(job_affinity_text(job))
+        if not chain:
+            return queue
+        mapping = await self.affinity_targets(queue)
+        if not mapping:
+            return queue
+        # Deepest matching digest wins: it pins the most shared context.
+        for digest in reversed(chain):
+            workers = mapping.get(digest)
+            if workers:
+                wid = rendezvous_pick(digest, workers)
+                return affinity_queue_name(queue, wid)
+        return queue
+
     # --- publish ----------------------------------------------------------
     async def publish_job(self, queue: str, job: Job) -> None:
+        target = queue
+        if self.config.prefix_affinity:
+            try:
+                target = await self._route_for_affinity(queue, job)
+            except Exception:  # noqa: BLE001 — routing is best-effort
+                logger.debug("Affinity routing failed", exc_info=True)
+                target = queue
+            if target != queue:
+                self.affinity_routed += 1
+            else:
+                self.affinity_fallback += 1
         # Stamp the lifecycle trace into the payload itself so it
         # survives broker hops, redeliveries, and pipeline stage handoffs
         # (a stage handoff lands here again, appending a second
@@ -131,9 +272,9 @@ class BrokerManager:
         trace = trace_from_payload(payload)
         if trace is None:
             trace = payload[TRACE_FIELD] = new_trace(job.id)
-        trace_event(trace, "submitted", queue=queue)
+        trace_event(trace, "submitted", queue=target)
         await self.broker.publish(
-            queue,
+            target,
             json.dumps(payload, default=str).encode("utf-8"),
             message_id=job.id,
         )

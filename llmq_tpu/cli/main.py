@@ -315,6 +315,12 @@ def worker() -> None:
 @click.option("--prefix-caching", is_flag=True,
               help="Reuse cached KV for shared prompt prefixes "
                    "(requires --prefill-chunk)")
+@click.option("--prefix-host-gb", type=float, default=None,
+              help="Host-RAM cold tier for the prefix cache: KV pages "
+                   "evicted from the device pool park in host RAM (up to "
+                   "this many GiB, LRU) and restore via scatter instead "
+                   "of re-prefilling. Requires --prefix-caching. "
+                   "Default: LLMQ_PREFIX_HOST_GB or 0 (off)")
 @click.option("--decode-block", type=int, default=None,
               help="Fused multi-step decode: device iterations per host "
                    "dispatch (K tokens per round trip; a finished "
@@ -342,8 +348,9 @@ def worker() -> None:
                    "--prefill-chunk. Default: LLMQ_MIXED_STEP or off")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
-               dtype, kv_dtype, prefill_chunk, prefix_caching, decode_block,
-               spec_tokens, tp_overlap, mixed_step):
+               dtype, kv_dtype, prefill_chunk, prefix_caching,
+               prefix_host_gb, decode_block, spec_tokens, tp_overlap,
+               mixed_step):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -359,6 +366,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         dtype=dtype,
         prefill_chunk_size=prefill_chunk,
         enable_prefix_caching=prefix_caching,
+        prefix_host_gb=prefix_host_gb,
         decode_block=decode_block,
         spec_tokens=spec_tokens,
         tp_overlap=tp_overlap,

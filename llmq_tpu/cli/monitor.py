@@ -26,7 +26,7 @@ from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import load_pipeline_config
 from llmq_tpu.obs import timeline, trace_from_payload
-from llmq_tpu.workers.base import HEALTH_SUFFIX, HEARTBEAT_INTERVAL_S
+from llmq_tpu.workers.base import HEARTBEAT_INTERVAL_S
 
 logger = logging.getLogger(__name__)
 
@@ -115,24 +115,8 @@ async def _collect_heartbeats(
 ) -> Dict[str, WorkerHealth]:
     """Drain available heartbeats non-destructively (TTL-bounded queue,
     newest wins per worker); every peeked message is requeued so the next
-    check still sees it."""
-    beats: Dict[str, WorkerHealth] = {}
-    peeked = []
-    while True:
-        msg = await mgr.broker.get(queue + HEALTH_SUFFIX)
-        if msg is None:
-            break
-        peeked.append(msg)
-        try:
-            health = WorkerHealth.model_validate_json(msg.body)
-            prev = beats.get(health.worker_id)
-            if prev is None or health.last_seen >= prev.last_seen:
-                beats[health.worker_id] = health
-        except Exception as exc:  # noqa: BLE001 — skip malformed beats
-            logger.debug("Skipping malformed heartbeat: %s", exc)
-    for msg in peeked:
-        await msg.reject(requeue=True)
-    return beats
+    check still sees it. Shared with the prefix-affinity router."""
+    return await mgr.get_worker_health(queue)
 
 
 async def check_health(queue: str) -> None:
@@ -347,6 +331,7 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
         "jobs",
         "tok/s",
         "occ",
+        "pfx hit",
         "ttft p50/p95 ms",
         "itl p50/p95 ms",
         "reconnects",
@@ -358,12 +343,16 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
         es = health.engine_stats or {}
         is_stale = (now - health.last_seen).total_seconds() > STALE_AFTER_S
         occ = es.get("batch_occupancy")
+        # Prefix-cache hit rate: prompt pages served from cache (device
+        # reuse + host-tier promotes) over all chain pages seen.
+        hit = es.get("prefix_hit_rate")
         table.add_row(
             wid,
             "[red]stale[/red]" if is_stale else health.status,
             str(health.jobs_processed),
             f"{es['tokens_per_sec']:.1f}" if "tokens_per_sec" in es else "-",
             f"{occ:.0%}" if occ is not None else "-",
+            f"{hit:.0%}" if hit is not None else "-",
             _fmt_pcts(es, "ttft_p50_ms", "ttft_p95_ms"),
             _fmt_pcts(es, "itl_p50_ms", "itl_p95_ms"),
             str(health.reconnects) if health.reconnects is not None else "-",

@@ -26,6 +26,7 @@ def run_tpu_worker(
     kv_dtype: Optional[str] = None,
     prefill_chunk_size: Optional[int] = None,
     enable_prefix_caching: bool = False,
+    prefix_host_gb: Optional[float] = None,
     decode_block: Optional[int] = None,
     spec_tokens: Optional[int] = None,
     tp_overlap: Optional[str] = None,
@@ -52,6 +53,7 @@ def run_tpu_worker(
         kv_dtype=kv_dtype,
         prefill_chunk_size=prefill_chunk_size,
         enable_prefix_caching=enable_prefix_caching,
+        prefix_host_gb=prefix_host_gb,
         decode_block=decode_block,
         spec_tokens=spec_tokens,
         tp_overlap=tp_overlap,

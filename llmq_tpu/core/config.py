@@ -154,6 +154,18 @@ class Config(BaseModel):
         "(requires prefill_chunk_size).",
     )
 
+    prefix_affinity: bool = Field(
+        default_factory=lambda: (_env("LLMQ_PREFIX_AFFINITY") or "").lower()
+        in ("1", "true", "yes"),
+        description="Prefix-affinity routing: workers advertise hot "
+        "prefix-chain digests in heartbeats, and the submit path routes "
+        "jobs sharing an advertised prompt prefix to the per-worker queue "
+        "<queue>.w.<worker_id> of the worker already holding those KV "
+        "pages (falling back to the shared queue on no fresh match). "
+        "Workers also serve cross-worker page-fetch requests on "
+        "<queue>.kv.<worker_id> when this is on.",
+    )
+
     decode_block: int = Field(
         default_factory=lambda: _env_int("LLMQ_DECODE_BLOCK", default=1),
         description="Fused multi-step decode: device iterations per host "

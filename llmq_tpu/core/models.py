@@ -152,6 +152,14 @@ class WorkerHealth(BaseModel):
         "numbers, histograms as ms-scaled percentile dicts); None for "
         "pre-observability workers.",
     )
+    prefix_chains: Optional[List[str]] = Field(
+        None,
+        description="Hot prefix-chain digests (hex, utils/hashing."
+        "text_prefix_chain) this worker holds KV pages for. The submit "
+        "path reads them to route jobs sharing a prompt prefix to the "
+        "worker that already has the pages; None for workers without "
+        "prefix caching (or before their first templated job).",
+    )
 
 
 class ErrorInfo(BaseModel):

@@ -77,6 +77,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.engine import sampling as sampling_mod
 from llmq_tpu.engine import snapshot as snapshot_mod
+from llmq_tpu.engine.prefix_store import PrefixStore
 from llmq_tpu.engine.snapshot import (
     KVRestore,
     RequestSnapshot,
@@ -105,6 +106,7 @@ from llmq_tpu.obs.metrics import (
     get_registry,
     to_ms,
 )
+from llmq_tpu.obs.trace import emit_trace_event
 from llmq_tpu.ops import dispatch as _dispatch
 from llmq_tpu.ops.attention import mixed_query_grid
 from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
@@ -239,6 +241,14 @@ class EngineConfig:
     # restored pages are the exact bytes the uninterrupted run would have
     # read). LLMQ_PREEMPT_MODE pins over this.
     preempt_mode: str = "recompute"
+    # Host-RAM prefix cold tier (GiB of host blobs; 0 = off; requires
+    # enable_prefix_caching): cache-registered pages evicted from the
+    # device pool park in host RAM keyed by their chain digest, and a
+    # later prompt walking the same chain gets them scattered back via
+    # insert_kv_pages instead of re-prefilled. Blobs stay in the KV
+    # pool's stored dtype, so a host-restored greedy continuation is
+    # bit-identical to cold prefill. LLMQ_PREFIX_HOST_GB pins over this.
+    prefix_host_gb: float = 0.0
 
     def __post_init__(self):
         self.decode_block = int(self.decode_block)
@@ -270,6 +280,11 @@ class EngineConfig:
         if self.preempt_mode not in ("recompute", "swap"):
             raise ValueError(
                 f"preempt_mode={self.preempt_mode!r} (want recompute|swap)"
+            )
+        self.prefix_host_gb = float(self.prefix_host_gb)
+        if self.prefix_host_gb < 0:
+            raise ValueError(
+                f"prefix_host_gb={self.prefix_host_gb} (want >= 0)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -489,6 +504,39 @@ class EngineCore:
             self.preempt_mode = preempt
         else:
             self.preempt_mode = self.cfg.preempt_mode
+        # Host-RAM prefix cold tier: env pins over config like the knobs
+        # above. Resolved before hook attachment so the scheduler's
+        # eviction path demotes from the very first request.
+        host_gb = self.cfg.prefix_host_gb
+        env_gb = os.environ.get("LLMQ_PREFIX_HOST_GB", "").strip()
+        if env_gb:
+            try:
+                host_gb = float(env_gb)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_PREFIX_HOST_GB={env_gb!r} is not a number"
+                ) from None
+        self.prefix_host_gb = host_gb
+        self.prefix_store = None
+        if host_gb > 0:
+            if not self.cfg.enable_prefix_caching:
+                raise ValueError(
+                    "prefix_host_gb > 0 requires enable_prefix_caching: "
+                    "the host tier extends the device prefix cache (there "
+                    "is nothing to demote without it)"
+                )
+            self.prefix_store = PrefixStore(
+                int(host_gb * 2**30),
+                page_size=self.cfg.page_size,
+                model_sig=self._model_sig(),
+            )
+            self.scheduler.on_demote = self._demote_page
+            self.scheduler.host_lookup = self._host_prefix_lookup
+            logger.info(
+                "prefix host tier: %.2f GiB budget (%d-token pages)",
+                host_gb,
+                self.cfg.page_size,
+            )
         if self.mixed_step == "on" and not self.cfg.prefill_chunk_size:
             raise ValueError(
                 "mixed_step=on requires prefill_chunk_size: the fused "
@@ -563,6 +611,11 @@ class EngineCore:
         self.kv_restores = 0  # admissions restored from host KV pages
         self.snapshots_extracted = 0
         self.snapshots_inserted = 0
+        self.prefill_tokens = 0  # prompt positions actually computed
+        self.prefix_demotes = 0  # pages parked in the host tier on evict
+        self.prefix_promotes = 0  # pages restored from the host tier
+        self.prefix_chunks_exported = 0  # pages serialized for peers
+        self.prefix_chunks_ingested = 0  # shipped pages accepted
         self._started_at = time.monotonic()
 
         # Observability: host-side only — a histogram record is a bucket
@@ -607,6 +660,49 @@ class EngineCore:
                 "Fraction of decode slots holding a running sequence",
                 fn=lambda: len(self.scheduler.running)
                 / max(1, self.cfg.max_num_seqs),
+            ),
+            Gauge(
+                "llmq_prefix_hit_pages",
+                "KV pages reused via the prefix cache (device + host tier)",
+                fn=lambda: self.scheduler.prefix_hits,
+            ),
+            Gauge(
+                "llmq_prefix_miss_pages",
+                "Full prompt pages that had to prefill (prefix cache miss)",
+                fn=lambda: self.scheduler.prefix_misses,
+            ),
+            Gauge(
+                "llmq_prefix_demote_pages",
+                "Evicted device pages parked in the host prefix tier",
+                fn=lambda: self.prefix_demotes,
+            ),
+            Gauge(
+                "llmq_prefix_promote_pages",
+                "Pages restored from the host prefix tier to device",
+                fn=lambda: self.prefix_promotes,
+            ),
+            Gauge(
+                "llmq_prefix_host_evictions",
+                "Host prefix tier entries dropped by the byte-budget LRU",
+                fn=lambda: (
+                    self.prefix_store.evictions if self.prefix_store else 0
+                ),
+            ),
+            Gauge(
+                "llmq_prefix_host_bytes",
+                "Host prefix tier occupancy in bytes",
+                fn=lambda: (
+                    self.prefix_store.occupancy_bytes
+                    if self.prefix_store
+                    else 0
+                ),
+            ),
+            Gauge(
+                "llmq_prefix_host_entries",
+                "Host prefix tier resident page count",
+                fn=lambda: (
+                    len(self.prefix_store) if self.prefix_store else 0
+                ),
             ),
         ):
             reg.register(metric)
@@ -1357,6 +1453,11 @@ class EngineCore:
             return False
         self._defer_since = None
         admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
+        # Host-tier promotion runs BEFORE anything else touches the wave:
+        # admit() already registered the promoted pages' hashes (so later
+        # admits may share them), which is only sound if their KV lands
+        # on device before any dispatch could read the pages.
+        self._promote_host_pages(admitted)
         todo = []
         restored = []
         for seq in admitted:
@@ -1537,6 +1638,164 @@ class EngineCore:
         v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
+
+    # --- host prefix tier -------------------------------------------------
+    def _demote_page(self, page: int, hashes: List[bytes]) -> None:
+        """Scheduler ``on_demote`` hook: park an evicted cache page's KV
+        in the host tier, keyed by every chain hash that pointed at it.
+        Safe to gather here: a cached page is refcount-0 whose deferred
+        release passed the watermark, so every in-flight write to it has
+        executed, and the gather reads the newest pool reference (the
+        donation chain's live buffer). np.asarray blocks until the copy
+        lands, before the page can be reallocated and overwritten."""
+        if self.prefix_store is None:
+            return
+        idx = jnp.asarray([page], jnp.int32)
+        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        for h in hashes:
+            self.prefix_store.put(h, k, v)
+        self.prefix_demotes += 1
+
+    def _host_prefix_lookup(self, hashes: List[bytes]):
+        """Scheduler ``host_lookup`` hook: the longest contiguous run of
+        host-tier pages extending a device-cache match."""
+        return self.prefix_store.match_chain(hashes)
+
+    def _promote_host_pages(self, admitted: List[Sequence]) -> None:
+        """Insert host-tier KV into the pages admit() reserved for it,
+        before the wave's first dispatch. No ``_dirty`` resync needed:
+        the sequences are still unprefilled (prefill's scatter brings
+        their decode rows up), and ``_kv_insert_jit`` donates the pool
+        like every other KV write. Also emits the per-request
+        ``prefix_hit`` trace event covering device + host reuse."""
+        for seq in admitted:
+            if seq.prefix_len > 0:
+                emit_trace_event(seq.rid, "prefix_hit", tokens=seq.prefix_len)
+            hr = seq.host_restore
+            if not hr:
+                continue
+            seq.host_restore = None
+            idx = np.asarray([page for page, _, _ in hr], np.int32)
+            k = np.concatenate([e.k for _, _, e in hr], axis=1)
+            v = np.concatenate([e.v for _, _, e in hr], axis=1)
+            self.k_pages = self._kv_insert_jit(
+                self.k_pages, idx, np.ascontiguousarray(k)
+            )
+            self.v_pages = self._kv_insert_jit(
+                self.v_pages, idx, np.ascontiguousarray(v)
+            )
+            self.prefix_promotes += len(hr)
+
+    def flush_prefix_to_host(self) -> int:
+        """Demote every evictable (refcount-0) cached page to the host
+        tier now, instead of waiting for pool pressure. Used before a
+        planned teardown — and by the probes to exercise the
+        demote→promote path deterministically. Returns the number of
+        pages dropped from the device cache."""
+        pages = list(self.scheduler.allocator._cached)
+        for page in pages:
+            self.scheduler.allocator.drop_cached(page)  # fires on_evict
+        return len(pages)
+
+    def export_prefix_chunks(self, digests_hex: List[str]) -> List[str]:
+        """Serialize requested prefix pages for a peer (base64 chunk wire
+        form). Each digest resolves against the host tier first, then the
+        device cache (gathering on demand) — misses are skipped, not
+        errors: shipping is best-effort and the requester re-prefills
+        whatever doesn't arrive."""
+        from llmq_tpu.engine import prefix_store as prefix_mod
+
+        out: List[str] = []
+        sig = self._model_sig()
+        for hx in digests_hex:
+            try:
+                key = bytes.fromhex(hx)
+            except ValueError:
+                continue
+            k = v = None
+            if self.prefix_store is not None and key in self.prefix_store:
+                entry = self.prefix_store.get(key)
+                k, v = entry.k, entry.v
+            else:
+                page = self.scheduler._prefix_cache.get(key)
+                if page is not None:
+                    idx = jnp.asarray([page], jnp.int32)
+                    k = np.asarray(
+                        _dispatch.gather_kv_pages(self.k_pages, idx)
+                    )
+                    v = np.asarray(
+                        _dispatch.gather_kv_pages(self.v_pages, idx)
+                    )
+            if k is None:
+                continue
+            blob = prefix_mod.chunk_to_bytes(
+                key, k, v, model_sig=sig, page_size=self.cfg.page_size
+            )
+            out.append(prefix_mod.chunk_to_b64(blob))
+            self.prefix_chunks_exported += 1
+        return out
+
+    def ingest_prefix_chunks(self, chunks_b64: List[str]) -> int:
+        """Accept shipped prefix pages into the host tier (they promote
+        to device on the next matching admission). Returns the number
+        accepted; 0 when the host tier is disabled. Malformed or
+        incompatible chunks raise — a fleet where shapes disagree should
+        fail loudly, not silently recompute forever."""
+        if self.prefix_store is None:
+            return 0
+        from llmq_tpu.engine import prefix_store as prefix_mod
+
+        n = 0
+        sig = self._model_sig()
+        for c in chunks_b64:
+            key, k, v, chunk_sig, page_size = prefix_mod.chunk_from_bytes(
+                prefix_mod.chunk_from_b64(c)
+            )
+            prefix_mod.check_chunk_compat(
+                chunk_sig,
+                page_size,
+                want_sig=sig,
+                want_page_size=self.cfg.page_size,
+            )
+            if self.prefix_store.put(key, k, v):
+                n += 1
+                self.prefix_chunks_ingested += 1
+        return n
+
+    def missing_prefix_digests(self, digests_hex: List[str]) -> List[str]:
+        """Subset of the given chain digests resident in NEITHER the
+        device prefix cache nor the host tier — the want-list a worker
+        sends to an affinity peer before recomputing a prefix. Pure
+        dict/host lookups (no device work, no counter churn)."""
+        missing: List[str] = []
+        for hx in digests_hex:
+            try:
+                key = bytes.fromhex(hx)
+            except ValueError:
+                continue
+            if key in self.scheduler._prefix_cache:
+                continue
+            if self.prefix_store is not None and key in self.prefix_store:
+                continue
+            missing.append(hx)
+        return missing
+
+    def hot_prefix_chains(self, n: int = 8) -> List[str]:
+        """Hex digests of this engine's hottest prefix chains — host-tier
+        entries by hit count, padded with device-cache chain heads. The
+        heartbeat advertises these for affinity routing and shipping."""
+        out: List[str] = []
+        if self.prefix_store is not None:
+            out.extend(self.prefix_store.hot_chains(n))
+        if len(out) < n:
+            for h in self.scheduler._prefix_cache:
+                hx = h.hex()
+                if hx not in out:
+                    out.append(hx)
+                if len(out) >= n:
+                    break
+        return out
 
     def _push_pending(
         self, kind: str, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
@@ -1721,6 +1980,7 @@ class EngineCore:
                     ):
                         continue  # nothing to compute — padding row
                     any_rows = True
+                    self.prefill_tokens += hi - row_start
                     tokens[r, : hi - row_start] = ids0[r][row_start:hi]
                     positions[r, : hi - row_start] = np.arange(row_start, hi)
                     bt[r, : len(seq.pages)] = seq.pages  # live: grow-only
@@ -1892,6 +2152,7 @@ class EngineCore:
                 self._record_dispatch("mixed", time.monotonic() - t0)
                 self.mixed_steps += 1
                 self.mixed_prefill_tokens += sum(t for _, t in segs)
+                self.prefill_tokens += sum(t for _, t in segs)
                 self.decode_steps += K
                 self.decode_dispatches += 1
                 if final_k is not None:
@@ -1985,6 +2246,7 @@ class EngineCore:
         self._record_dispatch("prefill", time.monotonic() - t0)
         for seq in chunk:
             seq.prefilled = True
+            self.prefill_tokens += seq.num_tokens
         self.prefills += len(chunk)
         self._push_pending("prefill", out, list(enumerate(chunk)))
         # The new rows' sampler mode must be honored from the next decode.
@@ -2598,8 +2860,13 @@ class EngineCore:
         self._flush_deferred()
         # The prefix cache must not survive an abort: the KV buffers may
         # be rebuilt (zeroed) below, and a cached hash pointing at a page
-        # of the new pool would hand future requests empty context.
+        # of the new pool would hand future requests empty context. The
+        # host tier goes with it — its blobs were gathered from the same
+        # now-untrusted buffers (invalidate_prefix_cache suppresses
+        # demotion, so nothing re-parks during the teardown either).
         self.scheduler.invalidate_prefix_cache()
+        if self.prefix_store is not None:
+            self.prefix_store.invalidate()
         for seq in list(self.scheduler.running.values()):
             self.scheduler.finish(seq, note)
         self.scheduler.waiting.clear()
@@ -2668,6 +2935,15 @@ class EngineCore:
             kv_restores=self.kv_restores,
             snapshots_extracted=self.snapshots_extracted,
             snapshots_inserted=self.snapshots_inserted,
+            # Prefix reuse plane: prompt positions actually computed vs
+            # reused, host-tier traffic, and shipping counters. A
+            # templated batch with working reuse shows prefill_tokens
+            # well below prompt_tokens.
+            prefill_tokens=self.prefill_tokens,
+            prefix_demotes=self.prefix_demotes,
+            prefix_promotes=self.prefix_promotes,
+            prefix_chunks_exported=self.prefix_chunks_exported,
+            prefix_chunks_ingested=self.prefix_chunks_ingested,
             tokens_per_sec=self.total_generated_tokens / elapsed,
             devices=int(np.prod(list(self.mesh.shape.values()))),
             # What this engine actually runs — the autotuned kernel and
@@ -2710,6 +2986,8 @@ class EngineCore:
                 self.model_config.num_kv_heads,
                 mesh=self.mesh,
             )[0]
+        if self.prefix_store is not None:
+            s.update(self.prefix_store.stats())
         return s
 
 
@@ -2740,6 +3018,9 @@ class AsyncEngine:
         self._handoff_requested = False
         self._handoff_event: Optional[threading.Event] = None
         self._handoff_results: List[HandoffOutput] = []
+        # Closures marshalled onto the engine thread (prefix-tier export/
+        # ingest touch the device pools, which the step loop donates).
+        self._calls: "queue.Queue[Tuple[Any, Future]]" = queue.Queue()
         self._thread = threading.Thread(
             target=self._run, name="llmq-engine", daemon=True
         )
@@ -2828,6 +3109,51 @@ class AsyncEngine:
     def stats(self) -> Dict[str, Any]:
         return self.core.stats()
 
+    def call_on_engine(self, fn, timeout: float = 30.0):
+        """Run ``fn()`` on the engine thread and return its result.
+        Device-pool access (gathers, inserts) races the step loop's
+        buffer donation from any other thread — everything that touches
+        ``core.k_pages``/``v_pages`` outside the loop goes through here."""
+        if not self._thread.is_alive():
+            return fn()  # thread gone: no donation race left to lose
+        fut: Future = Future()
+        self._calls.put((fn, fut))
+        self._wake.set()
+        return fut.result(timeout=timeout)
+
+    def export_prefix_chunks(self, digests_hex: List[str]) -> List[str]:
+        """Thread-safe :meth:`EngineCore.export_prefix_chunks`."""
+        return self.call_on_engine(
+            lambda: self.core.export_prefix_chunks(digests_hex)
+        )
+
+    def ingest_prefix_chunks(self, chunks_b64: List[str]) -> int:
+        """Thread-safe :meth:`EngineCore.ingest_prefix_chunks`."""
+        return self.call_on_engine(
+            lambda: self.core.ingest_prefix_chunks(chunks_b64)
+        )
+
+    def hot_prefix_chains(self, n: int = 8) -> List[str]:
+        """Heartbeat helper; reads host-side maps only, but runs on the
+        engine thread anyway so the dicts aren't mutated mid-iteration."""
+        try:
+            return self.call_on_engine(
+                lambda: self.core.hot_prefix_chains(n), timeout=5.0
+            )
+        except Exception:  # noqa: BLE001 — advertisement is best-effort
+            return []
+
+    def missing_prefix_digests(self, digests_hex: List[str]) -> List[str]:
+        """Thread-safe want-list check; [] on any failure (the fetch
+        path treats "nothing missing" as "nothing to fetch")."""
+        try:
+            return self.call_on_engine(
+                lambda: self.core.missing_prefix_digests(digests_hex),
+                timeout=5.0,
+            )
+        except Exception:  # noqa: BLE001 — fetch is best-effort
+            return []
+
     def shutdown(self) -> None:
         self._stop = True
         self._wake.set()
@@ -2887,6 +3213,15 @@ class AsyncEngine:
         while not self._stop:
             if self._handoff_requested:
                 self._run_handoff()
+            while True:  # marshalled calls (prefix export/ingest)
+                try:
+                    fn, call_fut = self._calls.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    call_fut.set_result(fn())
+                except Exception as exc:  # noqa: BLE001 — caller's error
+                    call_fut.set_exception(exc)
             drained = False
             while True:
                 try:

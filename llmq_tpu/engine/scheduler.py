@@ -22,13 +22,13 @@ Invariants (property-tested in tests/test_scheduler.py):
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from llmq_tpu.engine.sampling import SamplingParams
 from llmq_tpu.obs.metrics import Histogram
+from llmq_tpu.utils.hashing import token_prefix_chain
 
 
 class OutOfPages(Exception):
@@ -197,6 +197,12 @@ class Sequence:
     # admission — the engine scatters the pages back instead of
     # re-prefilling. None = re-prefill from prompt+output as usual.
     restore: Optional[Any] = None
+    # Host-tier prefix promotion: [(page, chain_hash, PrefixEntry), ...]
+    # assigned at admission when the host prefix store extends the
+    # device-cache match. The engine inserts the entries' KV into the
+    # listed pages before this sequence's first dispatch and clears the
+    # field; prefix_len already counts these positions.
+    host_restore: Optional[List[Any]] = None
     # Host-side lifecycle stamps (time.monotonic(); 0.0 = not yet).
     # These feed the queue-wait / TTFT / ITL histograms and the
     # per-request trace record; they never influence scheduling.
@@ -248,10 +254,22 @@ class Scheduler:
         self._prefix_cache: Dict[bytes, int] = {}
         self._prefix_rev: Dict[int, List[bytes]] = {}
         self.prefix_hits = 0  # pages reused via the cache (stats)
+        self.prefix_misses = 0  # full prompt pages that had to prefill
         self.preemptions = 0  # recompute preemptions (stats)
         # Called as on_preempt(seq, defer_pages) at the top of preempt(),
         # before the epoch bump and page release (engine swap-to-host).
         self.on_preempt = None
+        # Host prefix tier hooks (engine-owned; both optional):
+        #   on_demote(page, hashes) — fires when a cache-registered page
+        #     is evicted, while its device content is still intact, with
+        #     the chain hashes that pointed at it (park the KV in host
+        #     RAM instead of losing it);
+        #   host_lookup(hashes) — returns the longest contiguous
+        #     [(hash, entry), ...] run the host tier holds for a chain
+        #     tail the device cache missed.
+        self.on_demote = None
+        self.host_lookup = None
+        self._suppress_demote = False  # invalidation must not demote
         self.allocator.on_evict = self._drop_page_hashes
         # Per-scheduler latency histograms (the owning engine registers
         # them into the process-wide registry for /metrics export).
@@ -266,32 +284,18 @@ class Scheduler:
 
     # --- prefix caching ---------------------------------------------------
     def _prefix_hashes(self, prompt_ids: List[int]) -> List[bytes]:
-        """Chain digests of the prompt's leading FULL pages. Capped so at
-        least the final prompt position is always recomputed (its logits
-        seed generation, and decode's +1 headroom stays private).
-        blake2b, not Python ``hash()``: a constructible tuple-hash
-        collision would silently substitute another request's KV (wrong
-        output + cross-request content leak)."""
-        ps = self.config.page_size
-        n_full = (len(prompt_ids) - 1) // ps
-        hashes: List[bytes] = []
-        h = b""
-        for i in range(n_full):
-            dig = hashlib.blake2b(h, digest_size=16)
-            dig.update(
-                b"".join(
-                    int(t).to_bytes(8, "little", signed=True)
-                    for t in prompt_ids[i * ps : (i + 1) * ps]
-                )
-            )
-            h = dig.digest()
-            hashes.append(h)
-        return hashes
+        """Chain digests of the prompt's leading FULL pages
+        (utils/hashing.py: the fleet-wide KV page identity — the host
+        prefix store and cross-worker shipping key on the same bytes)."""
+        return token_prefix_chain(prompt_ids, self.config.page_size)
 
     def _match_prefix(self, prompt_ids: List[int]) -> List[int]:
         """Longest run of cached pages matching the prompt's hash chain."""
+        return self._match_prefix_hashes(self._prefix_hashes(prompt_ids))
+
+    def _match_prefix_hashes(self, hashes: List[bytes]) -> List[int]:
         matched: List[int] = []
-        for h in self._prefix_hashes(prompt_ids):
+        for h in hashes:
             page = self._prefix_cache.get(h)
             if page is None:
                 break
@@ -321,17 +325,33 @@ class Scheduler:
         seq.cacheable_pages = cacheable
 
     def _drop_page_hashes(self, page: int) -> None:
-        for h in self._prefix_rev.pop(page, []):
-            if self._prefix_cache.get(h) == page:
-                del self._prefix_cache[h]
+        hashes = [
+            h
+            for h in self._prefix_rev.pop(page, [])
+            if self._prefix_cache.get(h) == page
+        ]
+        for h in hashes:
+            del self._prefix_cache[h]
+        # Demote to the host tier while the page's device content is
+        # still intact (on_evict fires before the page hits the free
+        # list) — unless invalidation is in flight, in which case the
+        # content is exactly what must NOT survive.
+        if hashes and self.on_demote is not None and not self._suppress_demote:
+            self.on_demote(page, hashes)
 
     def invalidate_prefix_cache(self) -> None:
         """Forget every cached prefix and return the parked pages to the
         free list — required when the engine rebuilds the KV buffers
         (after a failed step): the page ids would otherwise still match
-        hash chains while pointing at zeroed content."""
-        for page in list(self.allocator._cached):
-            self.allocator.drop_cached(page)
+        hash chains while pointing at zeroed content. Demotion is
+        suppressed throughout — parking a page from an aborted/zeroed
+        buffer would re-serve poisoned KV from host RAM later."""
+        self._suppress_demote = True
+        try:
+            for page in list(self.allocator._cached):
+                self.allocator.drop_cached(page)
+        finally:
+            self._suppress_demote = False
         self._prefix_cache.clear()
         self._prefix_rev.clear()
         for seq in list(self.running.values()) + list(self.waiting):
@@ -418,13 +438,20 @@ class Scheduler:
                 break
             seq = self.waiting[0]
             matched: List[int] = []
+            host: List[Any] = []
+            hashes: List[bytes] = []
             if self.config.enable_prefix_caching:
-                matched = self._match_prefix(seq.prompt_ids)
+                hashes = self._prefix_hashes(seq.prompt_ids)
+                matched = self._match_prefix_hashes(hashes)
                 # Share FIRST: matched refcount-0 pages leave the
                 # evictable pool, so the fresh alloc below cannot evict
                 # them out from under us.
                 for page in matched:
                     self.allocator.share(page)
+                # Extend the device match from the host tier (snapshot
+                # restores bring their own KV — don't double-restore).
+                if self.host_lookup is not None and seq.restore is None:
+                    host = self.host_lookup(hashes[len(matched) :])
             need = self._pages_needed(seq.num_tokens) - len(matched)
             try:
                 fresh = self.allocator.alloc(need) if need > 0 else []
@@ -433,12 +460,28 @@ class Scheduler:
                     self.allocator.free([page], cacheable=True)
                 break
             seq.pages = matched + fresh
-            seq.prefix_len = len(matched) * self.config.page_size
+            if host:
+                # Promoted pages come out of the fresh allocation (the
+                # chain always has at least one more page than its full
+                # prefix pages, so fresh covers them). Register their
+                # hashes NOW: the engine inserts the host KV before this
+                # sequence's first dispatch, so later admits may share.
+                promoted = fresh[: len(host)]
+                seq.host_restore = [
+                    (page, h, entry)
+                    for page, (h, entry) in zip(promoted, host)
+                ]
+                for page, h, _ in seq.host_restore:
+                    self._prefix_cache[h] = page
+                    self._prefix_rev.setdefault(page, []).append(h)
+            n_reused = len(matched) + len(host)
+            seq.prefix_len = n_reused * self.config.page_size
             # Matched pages are cache-registered by construction; they
             # must park back in the evictable pool on release even if
             # this sequence never re-registers (e.g. finishes early).
-            seq.cacheable_pages = len(matched)
-            self.prefix_hits += len(matched)
+            seq.cacheable_pages = n_reused
+            self.prefix_hits += n_reused
+            self.prefix_misses += len(hashes) - n_reused
             self.waiting.popleft()
             seq.slot = free_slots.pop(0)
             seq.admitted_at = self._tick
@@ -603,6 +646,11 @@ class Scheduler:
         out["preemption_delay_p50_ms"] = _ms(pd.percentile(0.50))
         if self.config.enable_prefix_caching:
             out["prefix_cache_hit_pages"] = self.prefix_hits
+            out["prefix_cache_miss_pages"] = self.prefix_misses
+            seen = self.prefix_hits + self.prefix_misses
+            out["prefix_hit_rate"] = (
+                self.prefix_hits / seen if seen else 0.0
+            )
         return out
 
     def check_invariants(self) -> None:

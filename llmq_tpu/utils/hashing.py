@@ -1,0 +1,94 @@
+"""Process-stable content hashing shared across the stack.
+
+Every content-addressed structure in llmq-tpu — the scheduler's prefix
+cache, the host-RAM prefix store, cross-worker page shipping, and the
+dedup worker's n-gram embedding — keys on blake2b digests from this
+module. Python's builtin ``hash()`` is salted per process
+(PYTHONHASHSEED), so two workers sharing a queue would disagree on every
+key; blake2b is keyless, process-stable, and collision-resistant (a
+constructible collision in the prefix chain would silently substitute
+another request's KV — wrong output plus a cross-request content leak).
+
+The token chain digests here are THE wire identity of a KV prefix page:
+``token_prefix_chain`` must stay byte-identical across versions, or
+every host-tier blob and shipped page in a mixed fleet silently stops
+matching.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+CHAIN_DIGEST_SIZE = 16
+
+
+def stable_bucket(text: str, dim: int) -> int:
+    """Map ``text`` to a bucket in ``[0, dim)``, stable across processes
+    and PYTHONHASHSEED values (dedup n-gram embedding)."""
+    digest = hashlib.blake2b(text.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(digest, "little") % dim
+
+
+def chain_hash(
+    prev: bytes, token_ids: Sequence[int], *, digest_size: int = CHAIN_DIGEST_SIZE
+) -> bytes:
+    """One link of a token-page hash chain: digest(prev_digest || tokens).
+
+    Chaining (rather than hashing each page independently) makes a
+    page's digest identify the page's content AND its whole left
+    context, so position-dependent KV (RoPE'd keys) can only ever match
+    a prefix computed at the same positions over the same tokens."""
+    dig = hashlib.blake2b(prev, digest_size=digest_size)
+    dig.update(
+        b"".join(
+            int(t).to_bytes(8, "little", signed=True) for t in token_ids
+        )
+    )
+    return dig.digest()
+
+
+def token_prefix_chain(
+    token_ids: Sequence[int], page_size: int
+) -> List[bytes]:
+    """Chain digests of a prompt's leading FULL pages.
+
+    Capped at ``(len - 1) // page_size`` pages so at least the final
+    prompt position is always recomputed: its logits seed generation,
+    and decode's +1 headroom position stays private to the request.
+    This is the canonical identity of a cached KV page fleet-wide —
+    the scheduler's device cache, the host store, and cross-worker
+    shipping all key on exactly these bytes."""
+    n_full = (len(token_ids) - 1) // page_size
+    hashes: List[bytes] = []
+    h = b""
+    for i in range(n_full):
+        h = chain_hash(h, token_ids[i * page_size : (i + 1) * page_size])
+        hashes.append(h)
+    return hashes
+
+
+def text_prefix_chain(
+    text: str, *, chunk_chars: int = 256, max_chunks: int = 4
+) -> List[str]:
+    """Chain digests (hex) of a prompt's leading text chunks.
+
+    The submit path has no tokenizer, so prefix-affinity routing keys on
+    character chunks instead of token pages: jobs sharing a templated
+    system prompt share their leading text chunks, which is exactly the
+    traffic worth co-locating. Workers advertise the same digests from
+    the raw job text, so both sides agree without tokenizing. Only FULL
+    chunks hash (a partial tail chunk would make "abc" a prefix-match of
+    nothing), capped at ``max_chunks`` — routing needs the shared head,
+    not the whole prompt."""
+    n_full = min(len(text) // chunk_chars, max_chunks)
+    chains: List[str] = []
+    h = b""
+    for i in range(n_full):
+        dig = hashlib.blake2b(h, digest_size=CHAIN_DIGEST_SIZE)
+        dig.update(
+            text[i * chunk_chars : (i + 1) * chunk_chars].encode("utf-8")
+        )
+        h = dig.digest()
+        chains.append(h.hex())
+    return chains

@@ -37,7 +37,12 @@ import time
 from typing import Optional
 
 from llmq_tpu.broker.base import DeliveredMessage
-from llmq_tpu.broker.manager import FAILED_SUFFIX, BrokerManager
+from llmq_tpu.broker.manager import (
+    FAILED_SUFFIX,
+    HEALTH_SUFFIX,
+    BrokerManager,
+    affinity_queue_name,
+)
 from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
@@ -58,7 +63,6 @@ from llmq_tpu.workers.resume import (
     resume_offset,
 )
 
-HEALTH_SUFFIX = ".health"
 HEALTH_TTL_MS = 120_000
 HEARTBEAT_INTERVAL_S = 30.0
 
@@ -92,6 +96,9 @@ class BaseWorker(abc.ABC):
         self.jobs_timed_out = 0
         self.total_duration_ms = 0.0
         self._consumer_tag: Optional[str] = None
+        # Prefix-affinity: this worker's private job queue (consumed
+        # alongside the shared one when Config.prefix_affinity is on).
+        self._affinity_consumer_tag: Optional[str] = None
         self._in_flight = 0
         self._drained = asyncio.Event()
         self._drained.set()
@@ -136,6 +143,16 @@ class BaseWorker(abc.ABC):
             ttl_ms=HEALTH_TTL_MS,
             max_redeliveries=1_000_000_000,
         )
+        if self.config.prefix_affinity:
+            # Private affinity queue: the submit path routes jobs sharing
+            # an advertised prefix here. Same TTL/redelivery policy as the
+            # shared queue, so a job stranded by this worker dying either
+            # expires or dead-letters instead of waiting forever.
+            await self.broker.broker.declare_queue(
+                affinity_queue_name(self.queue, self.worker_id),
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
 
     async def run(self) -> None:
         """Main entry: initialize, consume until stopped, then clean up."""
@@ -151,6 +168,13 @@ class BaseWorker(abc.ABC):
             self._consumer_tag = await self.broker.consume_jobs(
                 self.queue, self._process_message, prefetch=self.concurrency
             )
+            if self.config.prefix_affinity:
+                self._affinity_consumer_tag = await self.broker.consume_jobs(
+                    affinity_queue_name(self.queue, self.worker_id),
+                    self._process_message,
+                    prefetch=self.concurrency,
+                )
+            await self._start_extra_consumers()
             self.logger.info(
                 "Worker %s starting to consume from '%s' (prefetch=%d)",
                 self.worker_id,
@@ -179,15 +203,17 @@ class BaseWorker(abc.ABC):
         self.running = False
 
     async def shutdown(self) -> None:
-        if self._consumer_tag is not None and self.broker.connected:
-            try:
-                # requeue=False: in-flight jobs either finish (and ack)
-                # during the drain below or are republished as resume
-                # snapshots; requeueing them here would double-deliver.
-                await self.broker.cancel(self._consumer_tag, requeue=False)
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
-            self._consumer_tag = None
+        for attr in ("_consumer_tag", "_affinity_consumer_tag", "_kv_consumer_tag"):
+            tag = getattr(self, attr, None)
+            if tag is not None and self.broker.connected:
+                try:
+                    # requeue=False: in-flight jobs either finish (and ack)
+                    # during the drain below or are republished as resume
+                    # snapshots; requeueing them here would double-deliver.
+                    await self.broker.cancel(tag, requeue=False)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+                setattr(self, attr, None)
         # Drain-with-handoff: let the processor hand unfinished requests
         # back (the TPU worker extracts engine snapshots here). In-flight
         # _process_message coroutines then settle their messages as
@@ -216,6 +242,12 @@ class BaseWorker(abc.ABC):
         """Hook: hand in-flight requests back to the broker as resumable
         jobs during shutdown. Base workers have no partial state worth
         carrying — the plain drain (or redelivery) covers them."""
+        return None
+
+    async def _start_extra_consumers(self) -> None:
+        """Hook: attach additional consumers after the main job consumer
+        is live (the TPU worker serves prefix-page fetch requests here).
+        Base workers have none."""
         return None
 
     # --- the hot loop (reference base.py:137-245) -------------------------
@@ -542,6 +574,7 @@ class BaseWorker(abc.ABC):
             engine_stats=self._engine_stats(),
             reconnects=stats.reconnects if stats is not None else None,
             metrics=get_registry().summary() or None,
+            prefix_chains=self._prefix_chains(),
         )
         try:
             await self.broker.broker.publish(
@@ -553,4 +586,9 @@ class BaseWorker(abc.ABC):
 
     def _engine_stats(self) -> Optional[dict]:
         """Subclasses may surface engine metrics (batch occupancy etc.)."""
+        return None
+
+    def _prefix_chains(self) -> Optional[list]:
+        """Subclasses may advertise hot prefix-chain digests (hex) for
+        prefix-affinity routing; None omits the field entirely."""
         return None

@@ -30,7 +30,6 @@ prefetch the batch could never fill.
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import uuid
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -39,6 +38,7 @@ import numpy as np
 
 from llmq_tpu.core.models import Job
 from llmq_tpu.utils.aio import reap
+from llmq_tpu.utils.hashing import stable_bucket
 from llmq_tpu.workers.base import BaseWorker
 
 DROPPED_MARKER = "DEDUP_DROPPED"
@@ -69,9 +69,9 @@ def _ngram_bucket(gram: str, dim: int) -> int:
     """Stable n-gram → bucket hash. Python's builtin ``hash()`` on str is
     salted per process (PYTHONHASHSEED), so two workers sharing a queue
     would embed the same text into DIFFERENT vectors and disagree on
-    which jobs are duplicates. blake2b is keyless and process-stable."""
-    digest = hashlib.blake2b(gram.encode("utf-8"), digest_size=8).digest()
-    return int.from_bytes(digest, "little") % dim
+    which jobs are duplicates. Delegates to the shared blake2b helper
+    (utils/hashing.py) so dedup and the prefix caches hash one way."""
+    return stable_bucket(gram, dim)
 
 
 def embed(texts: List[str], dim: int = _DIM, n: int = _NGRAM) -> np.ndarray:

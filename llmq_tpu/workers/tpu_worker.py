@@ -17,17 +17,33 @@ this worker builds the native engine on the local TPU slice:
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import socket
+from collections import OrderedDict
 from pathlib import Path
-from typing import Optional
+from typing import List, Optional
 
+from llmq_tpu.broker.manager import (
+    job_affinity_text,
+    kv_fetch_queue_name,
+    rendezvous_pick,
+)
 from llmq_tpu.core.models import Job
 from llmq_tpu.obs import trace_event, trace_event_at
+from llmq_tpu.utils.hashing import text_prefix_chain, token_prefix_chain
 from llmq_tpu.workers.base import BaseWorker
 from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff
 
 PRESET_SCHEMES = ("preset://", "dummy://", "random://")
+
+# Prefix-affinity plumbing: how many text-chain digests this worker tracks
+# (LRU of per-chunk hit counters), how many it advertises per heartbeat,
+# and how long a cross-worker page fetch may stall a job before the worker
+# gives up and recomputes the prefix locally.
+CHAIN_TRACK_CAP = 512
+CHAIN_ADVERTISE_N = 8
+PREFIX_FETCH_TIMEOUT_S = 2.0
 
 
 class TPUWorker(BaseWorker):
@@ -47,6 +63,7 @@ class TPUWorker(BaseWorker):
         num_pages: Optional[int] = None,
         prefill_chunk_size: Optional[int] = None,
         enable_prefix_caching: bool = False,
+        prefix_host_gb: Optional[float] = None,
         decode_block: Optional[int] = None,
         spec_tokens: Optional[int] = None,
         tp_overlap: Optional[str] = None,
@@ -65,12 +82,23 @@ class TPUWorker(BaseWorker):
         self._num_pages = num_pages
         self._prefill_chunk_size = prefill_chunk_size
         self._enable_prefix_caching = enable_prefix_caching
+        self._prefix_host_gb = prefix_host_gb
         self._decode_block = decode_block
         self._spec_tokens = spec_tokens
         self._tp_overlap = tp_overlap
         self._mixed_step = mixed_step
         self.engine = None
         self._usage: dict = {}
+        # Prefix-affinity state: text-chain digest → times a processed job
+        # walked that chunk (capped LRU; the top advertises in heartbeats),
+        # the kv-fetch consumer tag, ship counters, and a lock serializing
+        # peer fetches (one shared reply queue per worker).
+        self._chain_hits: "OrderedDict[str, int]" = OrderedDict()
+        self._kv_consumer_tag: Optional[str] = None
+        self._fetch_lock = asyncio.Lock()
+        self.prefix_chunks_served = 0
+        self.prefix_chunks_fetched = 0
+        self.prefix_fetch_timeouts = 0
         super().__init__(queue, **kwargs)
         # Prefetch must exceed the continuous batch's slot count or the
         # engine starves: with slots=192 and the default prefetch=100,
@@ -89,6 +117,13 @@ class TPUWorker(BaseWorker):
                 "--prefix-caching requires --prefill-chunk (or "
                 "LLMQ_PREFILL_CHUNK): only chunked prefill can start "
                 "mid-prompt"
+            )
+        if self._prefix_host_gb and not (
+            self._enable_prefix_caching or self.config.enable_prefix_caching
+        ):
+            raise ValueError(
+                "--prefix-host-gb requires --prefix-caching: the host "
+                "tier parks pages the device prefix cache evicts"
             )
         if (self._mixed_step or self.config.mixed_step or "off").lower() == "on" and not (
             self._prefill_chunk_size or self.config.prefill_chunk_size
@@ -294,6 +329,10 @@ class TPUWorker(BaseWorker):
             overrides["prefill_chunk_size"] = chunk
         if self._enable_prefix_caching or self.config.enable_prefix_caching:
             overrides["enable_prefix_caching"] = True
+        # Host-RAM cold tier for evicted prefix pages: per-worker flag >
+        # LLMQ_PREFIX_HOST_GB env (the engine resolves the env pin).
+        if self._prefix_host_gb:
+            overrides["prefix_host_gb"] = self._prefix_host_gb
         # Fused decode blocks: per-worker flag > LLMQ_DECODE_BLOCK env >
         # default 1 (per-token dispatch).
         block = self._decode_block or self.config.decode_block
@@ -357,6 +396,184 @@ class TPUWorker(BaseWorker):
             await loop.run_in_executor(None, self.engine.shutdown)
             self.engine = None
 
+    # --- prefix affinity: advertise / serve / fetch -----------------------
+    def _prefix_enabled(self) -> bool:
+        """Cross-worker prefix plumbing is live only when routing is on
+        AND this engine can actually hold shipped pages (host tier up)."""
+        return (
+            self.config.prefix_affinity
+            and self.engine is not None
+            and self.engine.core.cfg.enable_prefix_caching
+        )
+
+    def _note_prefix_chain(self, text: str) -> None:
+        """Count the text-chain chunks this job walked; the hottest
+        digests ride the next heartbeat as this worker's advertisement."""
+        for digest in text_prefix_chain(text):
+            self._chain_hits[digest] = self._chain_hits.get(digest, 0) + 1
+            self._chain_hits.move_to_end(digest)
+        while len(self._chain_hits) > CHAIN_TRACK_CAP:
+            self._chain_hits.popitem(last=False)
+
+    def _prefix_chains(self) -> Optional[List[str]]:
+        if not self.config.prefix_affinity or not self._chain_hits:
+            return None
+        ranked = sorted(
+            self._chain_hits.items(), key=lambda kv: kv[1], reverse=True
+        )
+        return [digest for digest, _ in ranked[:CHAIN_ADVERTISE_N]]
+
+    async def _start_extra_consumers(self) -> None:
+        """Attach the prefix-page fetch server: peers ask for chunks on
+        ``<queue>.kv.<worker_id>`` and get chunk blobs on their reply
+        queue. Requests are ephemeral (short TTL, single delivery) — a
+        requester that timed out has already recomputed."""
+        if not self._prefix_enabled():
+            return
+        kv_q = kv_fetch_queue_name(self.queue, self.worker_id)
+        await self.broker.broker.declare_queue(
+            kv_q, ttl_ms=30_000, max_redeliveries=1
+        )
+        await self.broker.broker.declare_queue(
+            kv_q + ".r", ttl_ms=30_000, max_redeliveries=1
+        )
+        self._kv_consumer_tag = await self.broker.broker.consume(
+            kv_q, self._serve_kv_fetch, prefetch=4
+        )
+
+    async def _serve_kv_fetch(self, message) -> None:
+        """One fetch request: ``{"want": [hex], "reply_to": q, "req": id}``
+        → export whatever of the want-list is resident (host tier or
+        device cache) and publish the chunks back. Always acks: a failed
+        export just means the requester recomputes."""
+        try:
+            req = json.loads(message.body)
+            want = [str(d) for d in (req.get("want") or [])][:64]
+            reply_to = req.get("reply_to")
+            chunks: List[str] = []
+            if want and self.engine is not None:
+                loop = asyncio.get_running_loop()
+                chunks = await loop.run_in_executor(
+                    None, lambda: self.engine.export_prefix_chunks(want)
+                )
+            if reply_to:
+                await self.broker.broker.publish(
+                    reply_to,
+                    json.dumps(
+                        {"req": req.get("req"), "chunks": chunks}
+                    ).encode("utf-8"),
+                )
+            self.prefix_chunks_served += len(chunks)
+        except Exception:  # noqa: BLE001 — serving is best-effort
+            self.logger.debug("KV fetch request failed", exc_info=True)
+        finally:
+            try:
+                await message.ack()
+            except Exception:  # noqa: BLE001 — already settled / transport gone
+                pass
+
+    async def _maybe_fetch_prefix(self, job: Job, text: str) -> None:
+        """Cache miss with a remote hit: ship the missing prefix pages
+        from the affinity peer instead of recomputing them. Strictly
+        best-effort — no peer, no reply within the timeout, or an
+        incompatible chunk all fall back to a plain local prefill."""
+        if self.engine is None or not text:
+            return
+        core = self.engine.core
+        if core.prefix_store is None:
+            return  # nowhere to land shipped pages
+        if self._fetch_lock.locked():
+            return  # one in-flight fetch at a time (shared reply queue)
+        tchain = text_prefix_chain(text)
+        if not tchain:
+            return
+        mapping = await self.broker.affinity_targets(self.queue)
+        peer = None
+        for digest in reversed(tchain):
+            candidates = [
+                w for w in mapping.get(digest, []) if w != self.worker_id
+            ]
+            if candidates:
+                peer = rendezvous_pick(digest, candidates)
+                break
+        if peer is None:
+            return
+        try:
+            token_ids = core.tokenizer.encode(text)
+        except Exception:  # noqa: BLE001 — tokenizer hiccup: just prefill
+            return
+        digests = [
+            h.hex() for h in token_prefix_chain(token_ids, core.cfg.page_size)
+        ]
+        if not digests:
+            return
+        loop = asyncio.get_running_loop()
+        want = await loop.run_in_executor(
+            None, lambda: self.engine.missing_prefix_digests(digests)
+        )
+        if not want:
+            return
+        async with self._fetch_lock:
+            await self._fetch_from_peer(peer, want, job.id)
+
+    async def _fetch_from_peer(
+        self, peer: str, want: List[str], req_id: str
+    ) -> None:
+        from llmq_tpu.engine.snapshot import SnapshotError
+
+        reply_q = kv_fetch_queue_name(self.queue, self.worker_id) + ".r"
+        try:
+            # Idempotent (normally done at startup): the reply must have
+            # a landing place before the request goes out.
+            await self.broker.broker.declare_queue(
+                reply_q, ttl_ms=30_000, max_redeliveries=1
+            )
+            await self.broker.broker.publish(
+                kv_fetch_queue_name(self.queue, peer),
+                json.dumps(
+                    {"want": want[:64], "reply_to": reply_q, "req": req_id}
+                ).encode("utf-8"),
+            )
+        except Exception:  # noqa: BLE001 — peer queue gone: recompute
+            return
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + PREFIX_FETCH_TIMEOUT_S
+        while loop.time() < deadline:
+            try:
+                msg = await self.broker.broker.get(reply_q)
+            except Exception:  # noqa: BLE001 — transport hiccup
+                break
+            if msg is None:
+                await asyncio.sleep(0.05)
+                continue
+            try:
+                payload = json.loads(msg.body)
+            except Exception:  # noqa: BLE001
+                payload = None
+            await msg.ack()
+            if not isinstance(payload, dict) or payload.get("req") != req_id:
+                continue  # stale reply from an earlier timed-out fetch
+            chunks = payload.get("chunks") or []
+            if chunks:
+                try:
+                    n = await loop.run_in_executor(
+                        None,
+                        lambda: self.engine.ingest_prefix_chunks(chunks),
+                    )
+                    self.prefix_chunks_fetched += n
+                    self.logger.info(
+                        "Fetched %d prefix page(s) from %s", n, peer
+                    )
+                except SnapshotError as exc:
+                    # Incompatible fleet member — loud, then recompute.
+                    self.logger.warning(
+                        "Peer %s shipped incompatible prefix chunks: %s",
+                        peer,
+                        exc,
+                    )
+            return
+        self.prefix_fetch_timeouts += 1
+
     # --- per-job processing (reference vllm_worker.py:136-195) ------------
     def _sampling_for(self, job: Job):
         """Job → SamplingParams: structured ``job.sampling`` wins, loose
@@ -411,6 +628,12 @@ class TPUWorker(BaseWorker):
         params = self._sampling_for(job)
         out = None
         snapshot = self._resume_snapshot(job)
+        if self._prefix_enabled():
+            text = job_affinity_text(job)
+            if text:
+                self._note_prefix_chain(text)
+                if snapshot is None:
+                    await self._maybe_fetch_prefix(job, text)
         if snapshot is not None:
             trace = self._job_traces.get(job.id)
             if trace is not None:
@@ -498,4 +721,14 @@ class TPUWorker(BaseWorker):
         return result
 
     def _engine_stats(self):
-        return self.engine.stats() if self.engine is not None else None
+        if self.engine is None:
+            return None
+        stats = self.engine.stats()
+        if self.config.prefix_affinity:
+            stats = {
+                **stats,
+                "prefix_chunks_served": self.prefix_chunks_served,
+                "prefix_chunks_fetched": self.prefix_chunks_fetched,
+                "prefix_fetch_timeouts": self.prefix_fetch_timeouts,
+            }
+        return stats

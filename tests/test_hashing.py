@@ -1,0 +1,114 @@
+"""Shared blake2b hashing helpers (utils/hashing.py).
+
+These digests are the fleet-wide identity of cached KV pages and the
+dedup worker's embedding buckets: two processes with different
+PYTHONHASHSEED values (or different machines entirely) must produce the
+SAME bytes, or host-tier blobs and shipped pages silently stop matching
+and dedup degrades to per-process agreement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from llmq_tpu.utils.hashing import (
+    CHAIN_DIGEST_SIZE,
+    chain_hash,
+    stable_bucket,
+    text_prefix_chain,
+    token_prefix_chain,
+)
+
+pytestmark = pytest.mark.unit
+
+
+class TestChainHash:
+    def test_digest_size_and_determinism(self):
+        h = chain_hash(b"", [1, 2, 3])
+        assert len(h) == CHAIN_DIGEST_SIZE
+        assert h == chain_hash(b"", [1, 2, 3])
+        assert h != chain_hash(b"", [1, 2, 4])
+        assert h != chain_hash(h, [1, 2, 3])  # prev digest matters
+
+    def test_token_boundary_not_ambiguous(self):
+        # Fixed-width token encoding: [1, 23] must not collide with
+        # [12, 3]-style concatenation ambiguities.
+        assert chain_hash(b"", [1, 23]) != chain_hash(b"", [12, 3])
+
+    def test_negative_token_ids_allowed(self):
+        assert chain_hash(b"", [-1]) != chain_hash(b"", [1])
+
+
+class TestTokenPrefixChain:
+    def test_full_pages_only_last_position_excluded(self):
+        # 16 tokens / page_size 8: position 15 must always recompute,
+        # so only page 0 hashes (n_full = (16-1)//8 = 1).
+        assert len(token_prefix_chain(list(range(16)), 8)) == 1
+        assert len(token_prefix_chain(list(range(17)), 8)) == 2
+        assert token_prefix_chain(list(range(8)), 8) == []
+        assert token_prefix_chain([], 8) == []
+
+    def test_chain_links_depend_on_left_context(self):
+        a = token_prefix_chain(list(range(24)), 8)
+        b = token_prefix_chain([99] + list(range(1, 24)), 8)
+        assert a[0] != b[0]
+        assert a[1] != b[1]  # differing page 0 poisons every later link
+
+    def test_shared_prefix_shares_leading_hashes(self):
+        a = token_prefix_chain(list(range(24)) + [1, 2], 8)
+        b = token_prefix_chain(list(range(24)) + [3, 4], 8)
+        assert a[:3] == b[:3]
+
+
+class TestTextPrefixChain:
+    def test_full_chunks_only_and_cap(self):
+        assert text_prefix_chain("x" * 255) == []
+        assert len(text_prefix_chain("x" * 256)) == 1
+        assert len(text_prefix_chain("x" * 4096)) == 4  # max_chunks cap
+        assert len(text_prefix_chain("ab" * 300, chunk_chars=100)) == 4
+
+    def test_hex_digests_and_shared_head(self):
+        a = text_prefix_chain("s" * 256 + "tail one")
+        b = text_prefix_chain("s" * 256 + "other")
+        assert a == b  # partial tails never hash
+        assert all(len(h) == 2 * CHAIN_DIGEST_SIZE for h in a)
+
+
+class TestStableBucket:
+    def test_range_and_determinism(self):
+        assert 0 <= stable_bucket("abc", 4096) < 4096
+        assert stable_bucket("abc", 4096) == stable_bucket("abc", 4096)
+
+
+def test_digests_stable_across_hash_seeds():
+    """The fleet contract: every digest this module emits is
+    byte-identical across processes with different PYTHONHASHSEED —
+    the scheduler's prefix cache, the host tier, shipped chunks, and
+    dedup buckets all key on these bytes across machine boundaries."""
+    script = (
+        "import json\n"
+        "from llmq_tpu.utils.hashing import (stable_bucket,\n"
+        "    token_prefix_chain, text_prefix_chain)\n"
+        "chain = [h.hex() for h in token_prefix_chain(list(range(40)), 8)]\n"
+        "print(json.dumps({\n"
+        "    'bucket': stable_bucket('the quick brown fox', 4096),\n"
+        "    'chain': chain,\n"
+        "    'text': text_prefix_chain('s' * 600, chunk_chars=256),\n"
+        "}))\n"
+    )
+    outs = []
+    for seed in ("0", "12345"):
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**os.environ, "PYTHONHASHSEED": seed, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        outs.append(json.loads(proc.stdout))
+    assert outs[0] == outs[1]
+    assert len(outs[0]["chain"]) == 4  # (40-1)//8 full pages

@@ -1,0 +1,344 @@
+"""Prefix-affinity routing + cross-worker page shipping.
+
+Layer 2/3 of the fleet-wide prefix cache: workers advertise hot
+text-chain digests in heartbeats; ``BrokerManager.publish_job`` routes
+jobs sharing an advertised prefix to the advertiser's private queue
+``<q>.w.<worker_id>``; a worker that gets a job whose prefix pages live
+on a peer fetches them over ``<q>.kv.<worker_id>`` instead of
+recomputing. Everything is best-effort: no fresh heartbeat, no peer, or
+a fetch timeout all degrade to the shared queue / a plain prefill.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from llmq_tpu.broker.manager import (
+    HEALTH_SUFFIX,
+    BrokerManager,
+    affinity_queue_name,
+    job_affinity_text,
+    kv_fetch_queue_name,
+    rendezvous_pick,
+)
+from llmq_tpu.core.config import Config, get_config
+from llmq_tpu.core.models import Job, WorkerHealth, utcnow
+from llmq_tpu.utils.hashing import text_prefix_chain
+from llmq_tpu.workers.tpu_worker import TPUWorker
+
+# ≥256 chars so text_prefix_chain yields at least one digest; templated
+# jobs share it, unrelated jobs don't.
+TEMPLATE = ("SYSTEM: you are a helpful assistant. " * 8)[:280]
+
+
+def make_config(mem_url, **kw):
+    kw.setdefault("prefix_affinity", True)
+    return Config(broker_url=mem_url, **kw)
+
+
+def make_worker(mem_url, queue="aff-q", **kw):
+    kw.setdefault("model", "preset://tiny")
+    kw.setdefault("tensor_parallel", 1)
+    kw.setdefault("max_model_len", 512)
+    kw.setdefault("num_pages", 80)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("prefill_chunk_size", 8)
+    kw.setdefault("enable_prefix_caching", True)
+    config = kw.pop("config", None) or make_config(mem_url)
+    return TPUWorker(queue, config=config, concurrency=4, **kw)
+
+
+# --- pure helpers -----------------------------------------------------------
+
+
+class TestHelpers:
+    def test_config_env_flag(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_PREFIX_AFFINITY", "1")
+        assert get_config().prefix_affinity is True
+        monkeypatch.setenv("LLMQ_PREFIX_AFFINITY", "0")
+        assert get_config().prefix_affinity is False
+        monkeypatch.delenv("LLMQ_PREFIX_AFFINITY")
+        assert get_config().prefix_affinity is False
+
+    def test_worker_health_prefix_chains_roundtrip(self):
+        chains = text_prefix_chain(TEMPLATE + "tail")
+        health = WorkerHealth(
+            worker_id="w1",
+            status="running",
+            last_seen=utcnow(),
+            jobs_processed=3,
+            prefix_chains=chains,
+        )
+        again = WorkerHealth.model_validate_json(health.model_dump_json())
+        assert again.prefix_chains == chains
+        # Pre-affinity heartbeats (no field) still parse.
+        old = json.loads(health.model_dump_json())
+        del old["prefix_chains"]
+        assert WorkerHealth.model_validate(old).prefix_chains is None
+
+    def test_rendezvous_deterministic_and_stable(self):
+        workers = ["w1", "w2", "w3"]
+        winner = rendezvous_pick("ab" * 16, workers)
+        assert winner in workers
+        assert winner == rendezvous_pick("ab" * 16, list(reversed(workers)))
+        # Removing a losing advertiser never remaps the chain.
+        rest = [w for w in workers if w != winner]
+        loser_gone = [w for w in workers if w != rest[0]]
+        assert rendezvous_pick("ab" * 16, loser_gone) == winner
+
+    def test_job_affinity_text(self):
+        job = Job(id="j", prompt="say {word}", word="hello")
+        assert job_affinity_text(job) == "say hello"
+        chat = Job(id="c", messages=[{"role": "user", "content": "hi"}])
+        assert job_affinity_text(chat) == "hi"
+        # Unresolved placeholders pass through verbatim (the worker
+        # formats identically, so digests still agree) — never raise.
+        broken = Job(id="b", prompt="say {missing}")
+        assert job_affinity_text(broken) == "say {missing}"
+
+    def test_queue_names(self):
+        assert affinity_queue_name("q", "w1") == "q.w.w1"
+        assert kv_fetch_queue_name("q", "w1") == "q.kv.w1"
+
+
+# --- routing over the memory broker -----------------------------------------
+
+
+async def _mgr_with_advert(mem_url, queue, worker_id, chains, *, age_s=0.0):
+    """A connected manager plus one advertised heartbeat on the health
+    queue (and the advertiser's private queue declared, as the worker
+    itself would have done)."""
+    mgr = BrokerManager(make_config(mem_url))
+    await mgr.connect()
+    await mgr.setup_queue_infrastructure(queue)
+    await mgr.broker.declare_queue(
+        queue + HEALTH_SUFFIX, ttl_ms=120_000, max_redeliveries=1_000_000_000
+    )
+    await mgr.broker.declare_queue(affinity_queue_name(queue, worker_id))
+    last_seen = utcnow()
+    if age_s:
+        from datetime import timedelta
+
+        last_seen = last_seen - timedelta(seconds=age_s)
+    health = WorkerHealth(
+        worker_id=worker_id,
+        status="running",
+        last_seen=last_seen,
+        jobs_processed=1,
+        prefix_chains=chains,
+    )
+    await mgr.broker.publish(
+        queue + HEALTH_SUFFIX, health.model_dump_json().encode("utf-8")
+    )
+    return mgr
+
+
+async def test_routes_to_advertising_worker(mem_url):
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, "q", "w1", chains)
+    try:
+        await mgr.publish_job("q", Job(id="j1", prompt=TEMPLATE + " Q?"))
+        msg = await mgr.broker.get(affinity_queue_name("q", "w1"))
+        assert msg is not None, "templated job should land on w1's queue"
+        assert json.loads(msg.body)["id"] == "j1"
+        await msg.ack()
+        assert await mgr.broker.get("q") is None
+        assert mgr.affinity_routed == 1 and mgr.affinity_fallback == 0
+    finally:
+        await mgr.disconnect()
+
+
+async def test_unrelated_job_falls_back_to_shared_queue(mem_url):
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, "q", "w1", chains)
+    try:
+        await mgr.publish_job("q", Job(id="j2", prompt="X" * 300))
+        msg = await mgr.broker.get("q")
+        assert msg is not None, "unrelated job belongs on the shared queue"
+        await msg.ack()
+        assert await mgr.broker.get(affinity_queue_name("q", "w1")) is None
+        assert mgr.affinity_fallback == 1
+    finally:
+        await mgr.disconnect()
+
+
+async def test_short_prompt_never_routes(mem_url):
+    """Prompts under one text chunk have no chain — always shared."""
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, "q", "w1", chains)
+    try:
+        await mgr.publish_job("q", Job(id="j3", prompt="short"))
+        assert (msg := await mgr.broker.get("q")) is not None
+        await msg.ack()
+    finally:
+        await mgr.disconnect()
+
+
+async def test_stale_heartbeat_does_not_route(mem_url):
+    """An advertisement older than the freshness window is dead weight:
+    the worker (and its pages) may be gone, so jobs stay shared."""
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, "q", "w1", chains, age_s=600.0)
+    try:
+        await mgr.publish_job("q", Job(id="j4", prompt=TEMPLATE + " Q?"))
+        assert (msg := await mgr.broker.get("q")) is not None
+        await msg.ack()
+        assert await mgr.broker.get(affinity_queue_name("q", "w1")) is None
+    finally:
+        await mgr.disconnect()
+
+
+async def test_affinity_off_never_peeks(mem_url):
+    """With the flag off, publish_job must not touch the health queue
+    (routing work is pure overhead for non-templated fleets)."""
+    mgr = BrokerManager(make_config(mem_url, prefix_affinity=False))
+    await mgr.connect()
+    try:
+        await mgr.setup_queue_infrastructure("q")
+        await mgr.publish_job("q", Job(id="j5", prompt=TEMPLATE + " Q?"))
+        assert (msg := await mgr.broker.get("q")) is not None
+        await msg.ack()
+        assert mgr.affinity_routed == 0 and mgr.affinity_fallback == 0
+    finally:
+        await mgr.disconnect()
+
+
+async def test_affinity_map_caches_between_publishes(mem_url):
+    """The heartbeat peek happens at most once per refresh window, not
+    once per job — submit loops run at full rate."""
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, "q", "w1", chains)
+    try:
+        for i in range(5):
+            await mgr.publish_job("q", Job(id=f"b-{i}", prompt=TEMPLATE + "?"))
+        assert mgr.affinity_routed == 5
+        # The single peeked-and-requeued heartbeat is still there.
+        beats = await mgr.get_worker_health("q")
+        assert set(beats) == {"w1"}
+    finally:
+        await mgr.disconnect()
+
+
+# --- cross-worker page shipping (two real engines) --------------------------
+
+
+@pytest.mark.integration
+async def test_two_workers_ship_prefix_pages(mem_url, monkeypatch):
+    """The full layer-3 path: worker A builds prefix pages from
+    templated traffic and advertises them; worker B, handed a job with
+    the same template, fetches the missing KV pages from A over the
+    broker and lands them in its host tier instead of recomputing."""
+    monkeypatch.setenv("LLMQ_PREFIX_HOST_GB", "0.05")
+    queue = "ship-q"
+    jobs = [
+        Job(
+            id=f"warm-{i}",
+            prompt=TEMPLATE + f" item {i}",
+            temperature=0.0,
+            max_tokens=4,
+            ignore_eos=True,
+        )
+        for i in range(2)
+    ]
+    worker_a = make_worker(mem_url, queue=queue)
+    broker = BrokerManager(make_config(mem_url))
+    await broker.connect()
+    await broker.setup_queue_infrastructure(queue)
+    task_a = asyncio.create_task(worker_a.run())
+    worker_b = None
+    try:
+        # Wait for A's consumers (incl. the kv-fetch server) to attach.
+        deadline = asyncio.get_event_loop().time() + 120.0
+        while worker_a._kv_consumer_tag is None:
+            assert asyncio.get_event_loop().time() < deadline, "A never ready"
+            await asyncio.sleep(0.05)
+        results = []
+
+        async def handler(message):
+            results.append(message)
+            await message.ack()
+
+        await broker.consume_results(queue + ".results", handler)
+        for job in jobs:
+            await broker.publish_job(queue, job)
+        while len(results) < len(jobs):
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # A processed templated traffic: it tracked the text chain and
+        # holds the prefix pages (device cache and/or host tier).
+        assert worker_a._prefix_chains(), "A should advertise chains"
+        await worker_a._publish_heartbeat()
+
+        # B: fresh engine, empty caches, same fleet config. Same process
+        # as A, so disambiguate the host-pid-derived worker id BEFORE the
+        # queues keyed on it are declared.
+        worker_b = make_worker(mem_url, queue=queue)
+        worker_b.worker_id = worker_b.worker_id + "-b"
+        await worker_b.initialize()
+        await worker_b._start_extra_consumers()
+        job = Job(
+            id="cold-on-b",
+            prompt=TEMPLATE + " item 99",
+            temperature=0.0,
+            max_tokens=4,
+            ignore_eos=True,
+        )
+        store_b = worker_b.engine.core.prefix_store
+        assert store_b is not None and len(store_b) == 0
+        await worker_b._maybe_fetch_prefix(job, job_affinity_text(job))
+        assert worker_b.prefix_chunks_fetched > 0, "B fetched nothing"
+        assert len(store_b) == worker_b.prefix_chunks_fetched
+        assert worker_a.prefix_chunks_served >= worker_b.prefix_chunks_fetched
+        # The shipped pages are the REAL thing: processing the job on B
+        # promotes them (prefix hits) instead of re-prefilling.
+        hits_before = worker_b.engine.core.scheduler.prefix_hits
+        out = await worker_b._process_job(job)
+        assert isinstance(out, str)
+        assert worker_b.engine.core.scheduler.prefix_hits > hits_before
+        assert worker_b.engine.core.prefix_promotes > 0
+    finally:
+        if worker_b is not None:
+            await worker_b.shutdown()
+        worker_a.request_shutdown()
+        await asyncio.wait_for(task_a, timeout=60)
+        await broker.disconnect()
+
+
+@pytest.mark.integration
+async def test_fetch_timeout_degrades_to_recompute(mem_url, monkeypatch):
+    """A dead peer (advertised but not serving) must cost ~the fetch
+    timeout, not correctness: the job still processes locally."""
+    monkeypatch.setenv("LLMQ_PREFIX_HOST_GB", "0.05")
+    import llmq_tpu.workers.tpu_worker as tw
+
+    monkeypatch.setattr(tw, "PREFIX_FETCH_TIMEOUT_S", 0.3)
+    queue = "dead-peer-q"
+    chains = text_prefix_chain(TEMPLATE + "anything")
+    mgr = await _mgr_with_advert(mem_url, queue, "ghost", chains)
+    worker = None
+    try:
+        # The ghost's kv queue exists (it "ran once") but nothing consumes.
+        await mgr.broker.declare_queue(
+            kv_fetch_queue_name(queue, "ghost"), ttl_ms=30_000
+        )
+        worker = make_worker(mem_url, queue=queue)
+        await worker.initialize()
+        job = Job(
+            id="orphan",
+            prompt=TEMPLATE + " item",
+            temperature=0.0,
+            max_tokens=3,
+            ignore_eos=True,
+        )
+        await worker._maybe_fetch_prefix(job, job_affinity_text(job))
+        assert worker.prefix_fetch_timeouts == 1
+        assert worker.prefix_chunks_fetched == 0
+        out = await worker._process_job(job)
+        assert isinstance(out, str) and len(out) > 0
+    finally:
+        if worker is not None:
+            await worker.shutdown()
+        await mgr.disconnect()

@@ -1,0 +1,361 @@
+"""Host-RAM prefix cold tier: store semantics, chunk wire codec, and
+engine-level demote→promote / abort-safety / cross-engine shipping.
+
+The load-bearing property is the same BIT-exactness bar the snapshot
+plane holds: a greedy continuation served from host-restored (or
+peer-shipped) prefix pages must produce exactly the tokens a cold
+prefill would have — the blobs are the very bytes the device computed,
+parked and scattered back without any dequantize round trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmq_tpu.engine.engine import EngineConfig, EngineCore
+from llmq_tpu.engine.prefix_store import (
+    CHUNK_MAGIC,
+    PrefixStore,
+    check_chunk_compat,
+    chunk_from_b64,
+    chunk_from_bytes,
+    chunk_to_b64,
+    chunk_to_bytes,
+)
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.snapshot import (
+    SnapshotCompatError,
+    SnapshotError,
+    SnapshotIntegrityError,
+    SnapshotVersionError,
+)
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+from llmq_tpu.utils.hashing import token_prefix_chain
+
+pytestmark = pytest.mark.unit
+
+CFG = ModelConfig.tiny(vocab_size=304)
+PARAMS_F32 = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+# 16-char shared head = 2 full 8-token pages under ByteTokenizer.
+TEMPLATE = "SYSTEM: answer. "
+
+
+def make_core(params=None, tp=1, **overrides) -> EngineCore:
+    defaults = dict(
+        max_num_seqs=4,
+        max_model_len=64,
+        page_size=8,
+        num_pages=40,
+        kv_dtype=jnp.float32,
+        min_prefill_bucket=16,
+        prefill_chunk_size=8,
+        enable_prefix_caching=True,
+        prefix_host_gb=0.25,
+    )
+    defaults.update(overrides)
+    return EngineCore(
+        CFG,
+        PARAMS_F32 if params is None else params,
+        ByteTokenizer(),
+        mesh=make_mesh(tensor_parallel=tp),
+        engine_config=EngineConfig(**defaults),
+    )
+
+
+def greedy(max_tokens=12, **kw):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True, **kw
+    )
+
+
+def run_all(core, requests):
+    for rid, prompt, params in requests:
+        core.add_request(rid, prompt=prompt, params=params)
+    outs = {}
+    for _ in range(2000):
+        for out in core.step():
+            outs[out.rid] = out
+        if not core.has_work:
+            break
+    assert len(outs) == len(requests), "engine stalled"
+    return outs
+
+
+def _page(seed, nbytes=None, shape=(2, 1, 8, 2, 4)):
+    rng = np.random.default_rng(seed)
+    arr = rng.standard_normal(shape).astype(np.float32)
+    return arr
+
+
+class TestPrefixStore:
+    def test_put_get_roundtrip_and_lru_budget(self):
+        page_bytes = 2 * _page(0).nbytes  # k + v
+        store = PrefixStore(3 * page_bytes, page_size=8)
+        for i in range(3):
+            assert store.put(bytes([i]) * 16, _page(i), _page(100 + i))
+        assert len(store) == 3
+        assert store.occupancy_bytes == 3 * page_bytes
+        # Touch entry 0 so it is MRU; inserting a 4th evicts entry 1.
+        assert store.get(bytes([0]) * 16) is not None
+        assert store.put(bytes([3]) * 16, _page(3), _page(103))
+        assert store.evictions == 1
+        assert bytes([1]) * 16 not in store
+        assert bytes([0]) * 16 in store
+        got = store.get(bytes([3]) * 16)
+        np.testing.assert_array_equal(got.k, _page(3))
+        np.testing.assert_array_equal(got.v, _page(103))
+
+    def test_oversize_blob_rejected_without_eviction(self):
+        store = PrefixStore(8, page_size=8)
+        assert not store.put(b"x" * 16, _page(0), _page(1))
+        assert len(store) == 0 and store.occupancy_bytes == 0
+
+    def test_match_chain_is_contiguous_from_head(self):
+        store = PrefixStore(1 << 20, page_size=8)
+        keys = [bytes([i]) * 16 for i in range(4)]
+        for i in (0, 1, 3):  # hole at 2
+            store.put(keys[i], _page(i), _page(100 + i))
+        run = store.match_chain(keys)
+        assert [h for h, _ in run] == keys[:2]  # stops at the hole
+        assert store.match_chain([keys[2], keys[3]]) == []
+
+    def test_invalidate_clears_everything(self):
+        store = PrefixStore(1 << 20, page_size=8)
+        store.put(b"a" * 16, _page(0), _page(1))
+        store.invalidate()
+        assert len(store) == 0 and store.occupancy_bytes == 0
+        assert store.get(b"a" * 16) is None
+
+    def test_hot_chains_ranked_by_hits(self):
+        store = PrefixStore(1 << 20, page_size=8)
+        for i in range(3):
+            store.put(bytes([i]) * 16, _page(i), _page(100 + i))
+        for _ in range(3):
+            store.get(bytes([2]) * 16)
+        store.get(bytes([0]) * 16)
+        hot = store.hot_chains(2)
+        assert hot[0] == (bytes([2]) * 16).hex()
+        assert hot[1] == (bytes([0]) * 16).hex()
+
+
+class TestChunkCodec:
+    SIG = {"num_layers": 2, "kv_dtype": "float32"}
+
+    def _blob(self):
+        return chunk_to_bytes(
+            b"k" * 16, _page(7), _page(8), model_sig=self.SIG, page_size=8
+        )
+
+    def test_roundtrip(self):
+        key, k, v, sig, ps = chunk_from_bytes(self._blob())
+        assert key == b"k" * 16 and sig == self.SIG and ps == 8
+        np.testing.assert_array_equal(k, _page(7))
+        np.testing.assert_array_equal(v, _page(8))
+        assert k.dtype == np.float32
+
+    def test_b64_roundtrip(self):
+        blob = self._blob()
+        assert chunk_from_b64(chunk_to_b64(blob)) == blob
+        with pytest.raises(SnapshotError):
+            chunk_from_b64("not!!base64")
+
+    def test_tamper_detected(self):
+        blob = bytearray(self._blob())
+        blob[-1] ^= 0xFF
+        with pytest.raises(SnapshotIntegrityError):
+            chunk_from_bytes(bytes(blob))
+
+    def test_truncation_detected(self):
+        blob = self._blob()
+        with pytest.raises(SnapshotIntegrityError):
+            chunk_from_bytes(blob[: len(blob) // 2])
+        with pytest.raises(SnapshotIntegrityError):
+            chunk_from_bytes(blob[:10])
+
+    def test_bad_magic_and_future_version(self):
+        blob = self._blob()
+        with pytest.raises(SnapshotError):
+            chunk_from_bytes(b"NOTMAGIC" + blob[len(CHUNK_MAGIC) :])
+        newer = bytearray(blob)
+        newer[len(CHUNK_MAGIC)] = 0xFF  # little-endian u16 version
+        with pytest.raises(SnapshotVersionError):
+            chunk_from_bytes(bytes(newer))
+
+    def test_compat_check(self):
+        check_chunk_compat(self.SIG, 8, want_sig=self.SIG, want_page_size=8)
+        with pytest.raises(SnapshotCompatError):
+            check_chunk_compat(
+                self.SIG, 16, want_sig=self.SIG, want_page_size=8
+            )
+        with pytest.raises(SnapshotCompatError):
+            check_chunk_compat(
+                {"num_layers": 3}, 8, want_sig=self.SIG, want_page_size=8
+            )
+
+
+class TestEngineHostTier:
+    def test_requires_prefix_caching(self):
+        with pytest.raises(ValueError, match="enable_prefix_caching"):
+            make_core(enable_prefix_caching=False, prefill_chunk_size=None)
+
+    def test_env_pin_overrides_config(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_PREFIX_HOST_GB", "0.5")
+        core = make_core(prefix_host_gb=0.0)
+        assert core.prefix_store is not None
+        assert core.prefix_store.budget_bytes == int(0.5 * 2**30)
+
+    def test_demote_promote_greedy_bit_identical(self):
+        core = make_core()
+        prompt = TEMPLATE + "first question?"
+        cold = run_all(core, [("cold", prompt, greedy())])["cold"]
+        assert core.prefill_tokens > 0
+        cold_prefill = core.prefill_tokens
+        # Finished request parked its 2 full prefix pages in the device
+        # cache; flush demotes them to the host tier and empties the
+        # device cache, so the rerun can only hit via promotion.
+        flushed = core.flush_prefix_to_host()
+        assert flushed > 0
+        assert core.prefix_demotes > 0
+        assert len(core.prefix_store) >= 2
+        assert not core.scheduler._prefix_cache
+        warm = run_all(core, [("warm", prompt, greedy())])["warm"]
+        assert warm.token_ids == cold.token_ids  # bit-identical continuation
+        assert core.prefix_promotes >= 2
+        assert core.scheduler.prefix_hits >= 2
+        # The promoted pages' positions were NOT re-prefilled.
+        assert core.prefill_tokens - cold_prefill <= cold_prefill - 16
+
+    def test_promoted_pages_shared_by_later_admits(self):
+        core = make_core()
+        prompt = TEMPLATE + "shared tail q?"
+        n_pages = len(token_prefix_chain(ByteTokenizer().encode(prompt), 8))
+        run_all(core, [("a", prompt, greedy(6))])
+        core.flush_prefix_to_host()
+        promotes_before = core.prefix_promotes
+        hits_before = core.scheduler.prefix_hits
+        outs = run_all(
+            core,
+            [("b", prompt, greedy(6)), ("c", prompt, greedy(6))],
+        )
+        assert outs["b"].token_ids == outs["c"].token_ids
+        # One admission promoted from host; the other shared the
+        # freshly promoted device pages (no double promotion) — both
+        # count as cache hits.
+        assert core.prefix_promotes == promotes_before + n_pages
+        assert core.scheduler.prefix_hits == hits_before + 2 * n_pages
+
+    def test_abort_drops_host_tier_and_suppresses_demotion(self):
+        core = make_core()
+        prompt = TEMPLATE + "to be aborted"
+        run_all(core, [("r0", prompt, greedy(6))])
+        core.flush_prefix_to_host()
+        assert len(core.prefix_store) > 0
+        # Re-populate the device cache so abort's invalidation walks
+        # cached pages — with demotion suppression missing they would
+        # re-park poisoned content in the host store.
+        run_all(core, [("r1", prompt, greedy(6))])
+        demotes_before = core.prefix_demotes
+        core.abort_all("test_abort")
+        assert len(core.prefix_store) == 0  # host tier invalidated
+        assert core.prefix_demotes == demotes_before  # nothing re-parked
+
+    def test_mid_prefill_abort_no_stale_host_blob(self):
+        """Abort while a prompt's prefill is mid-flight: the host tier
+        must end empty, and a rerun must match a never-aborted engine
+        (no stale blob from the aborted buffers is ever re-inserted)."""
+        core = make_core()
+        prompt = TEMPLATE + "interrupted prompt body"
+
+        calls = []
+
+        def boom(kind):
+            calls.append(kind)
+            if kind == "prefill" and len(calls) == 1:
+                raise RuntimeError("injected mid-prefill failure")
+
+        core.on_dispatch = boom
+        core.add_request("dead", prompt=prompt, params=greedy())
+        with pytest.raises(RuntimeError, match="injected"):
+            for _ in range(50):
+                core.step()
+        core.on_dispatch = None
+        core.abort_all("error")  # what AsyncEngine does on step failure
+        assert len(core.prefix_store) == 0
+        # Rerun on the recovered engine vs a clean engine: bit parity
+        # proves no stale KV (device or host tier) leaked into it.
+        out = run_all(core, [("retry", prompt, greedy())])["retry"]
+        ref_core = make_core()
+        ref = run_all(ref_core, [("ref", prompt, greedy())])["ref"]
+        assert out.token_ids == ref.token_ids
+
+    def test_export_ingest_ship_between_engines(self):
+        """Cross-engine page shipping: engine A exports its prefix
+        chunks, engine B ingests them, and B's first templated request
+        reuses the shipped pages with bit-identical greedy output."""
+        a = make_core()
+        prompt = TEMPLATE + "cross worker q?"
+        cold = run_all(a, [("cold", prompt, greedy())])["cold"]
+        a.flush_prefix_to_host()
+        ids = ByteTokenizer().encode(prompt)
+        digests = [h.hex() for h in token_prefix_chain(ids, 8)]
+        chunks = a.export_prefix_chunks(digests)
+        assert len(chunks) == len(digests)
+        assert a.prefix_chunks_exported == len(digests)
+
+        b = make_core()
+        assert b.ingest_prefix_chunks(chunks) == len(chunks)
+        assert b.prefix_chunks_ingested == len(chunks)
+        warm = run_all(b, [("warm", prompt, greedy())])["warm"]
+        assert warm.token_ids == cold.token_ids
+        assert b.prefix_promotes == len(digests)
+        assert b.scheduler.prefix_hits == len(digests)
+
+    def test_export_from_device_cache_without_flush(self):
+        """Digests still resident only in the DEVICE cache export via an
+        on-demand gather — a peer can pull pages the host tier never
+        saw."""
+        a = make_core()
+        prompt = TEMPLATE + "device export"
+        run_all(a, [("r", prompt, greedy(6))])
+        ids = ByteTokenizer().encode(prompt)
+        digests = [h.hex() for h in token_prefix_chain(ids, 8)]
+        chunks = a.export_prefix_chunks(digests)
+        assert len(chunks) == len(digests)
+        # Unknown digests are skipped, not errors (best-effort shipping).
+        assert a.export_prefix_chunks(["ff" * 16]) == []
+
+    def test_ingest_rejects_incompatible_chunks(self):
+        a = make_core()
+        blob = chunk_to_bytes(
+            b"z" * 16,
+            _page(0),
+            _page(1),
+            model_sig={"num_layers": 99},
+            page_size=8,
+        )
+        with pytest.raises(SnapshotCompatError):
+            a.ingest_prefix_chunks([chunk_to_b64(blob)])
+
+    def test_stats_and_gauges_expose_prefix_plane(self):
+        core = make_core()
+        prompt = TEMPLATE + "stats check"
+        run_all(core, [("r", prompt, greedy(6))])
+        core.flush_prefix_to_host()
+        run_all(core, [("r2", prompt, greedy(6))])
+        s = core.stats()
+        assert s["prefix_hit_rate"] > 0
+        assert s["prefix_demotes"] > 0
+        assert s["prefix_promotes"] > 0
+        assert s["prefill_tokens"] > 0
+        assert s["prefix_host_bytes"] >= 0
+        assert s["prefix_host_budget_bytes"] == int(0.25 * 2**30)
+        from llmq_tpu.obs.metrics import get_registry
+
+        text = get_registry().render_prometheus()
+        assert "llmq_prefix_hit_pages" in text
+        assert "llmq_prefix_host_bytes" in text

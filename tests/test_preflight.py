@@ -295,6 +295,23 @@ def test_snapshot_probe_runs():
     assert "metric: snapshot_probe_ok" in proc.stdout
 
 
+def test_prefix_cache_probe_runs():
+    """The fleet prefix-cache rung runs end to end on CPU: intra-engine
+    reuse with cache-free parity, host-tier demote→promote with
+    cold-prefill parity, and a two-worker page ship over the memory
+    broker with cross-worker token parity."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/prefix_cache_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:prefix_cache_probe", proc)
+    assert "reuse leg ok" in proc.stdout
+    assert "host-tier leg ok" in proc.stdout
+    assert "ship leg ok" in proc.stdout
+    assert "metric: prefix_cache_probe_ok" in proc.stdout
+
+
 def test_bench_tiny_int4_runs():
     """One representative bench command runs end to end on CPU with the
     int4 group-quantized weight ladder, emitting the metric line with

@@ -37,6 +37,10 @@ run 300 collectives python tools/profile_collectives.py
 # 1c. Observability plane on the real device: /metrics scrape + trace
 #     round trip (host-side only; ephemeral port avoids collisions).
 run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
+# 1d. Fleet prefix-cache plane: reuse / host-tier / cross-worker-ship
+#     parity at the tiny preset (the KV gathers and scatters run on the
+#     real chip; cheap, so it stays ahead of the long benches).
+run 900 prefix_probe python tools/prefix_cache_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

@@ -46,6 +46,11 @@ run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 # and a seeded kill-resume mini-chaos on the memory broker — proves
 # crash-resume holds with device-resident KV, not just on CPU.
 run 900 snapshot_probe python tools/snapshot_probe.py
+# Fleet-wide prefix-cache plane: intra-engine reuse parity, host-tier
+# demote->promote parity, and a two-worker page ship over the memory
+# broker — proves the KV gather/scatter paths on the real chip, not
+# just CPU.
+run 900 prefix_probe python tools/prefix_cache_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
