@@ -1,0 +1,237 @@
+"""unbounded-host-buffer: instance containers that only ever grow.
+
+Long-running workers keep per-request bookkeeping in plain host-side
+dicts and lists — traces, usage maps, peer tables, failure histories. A
+container that is written on the hot path but never popped, capped, or
+reset grows for the life of the process and eventually takes the worker
+down with a host OOM — the slowest possible failure mode, and the one
+the HostMemoryGovernor cannot see because the bytes hide inside Python
+objects rather than registered tiers.
+
+The rule flags an instance attribute that is (a) initialised to an
+empty container in ``__init__`` (``{}``, ``[]``, ``dict()``, ``list()``,
+``set()``, ``OrderedDict()``, ``defaultdict(...)``, or ``deque()``
+without ``maxlen``) and (b) grown somewhere in the class — subscript
+assignment, ``append``/``add``/``extend``/``update``/``setdefault``, or
+``+=`` — with (c) no visible bound anywhere in the class. Any of the
+following counts as a bound and clears the attribute:
+
+- an eviction call: ``.pop`` / ``.popitem`` / ``.popleft`` / ``.clear``
+  / ``.remove`` / ``.discard`` on the attribute, or ``del attr[...]``
+- a reassignment outside ``__init__`` (batch-flush / reset patterns)
+- a ``len(attr)`` comparison (cap checks like
+  ``while len(self.x) > CAP: ...`` or ``if len(self.x) < CAP: ...``)
+
+The heuristic is deliberately structural, not flow-sensitive: a pop on
+an error path still counts as a bound. Genuinely bounded-by-design
+buffers the rule cannot see through (e.g. keyed by a fleet-sized id
+set) should carry ``# llmq: ignore[unbounded-host-buffer]`` with the
+justification in a comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+)
+
+UNBOUNDED_HOST_BUFFER = Rule(
+    "unbounded-host-buffer",
+    "warning",
+    "instance container grows without any visible pop/cap/reset",
+)
+
+#: Call names (after alias resolution) that build an empty, unbounded
+#: container. ``deque`` is handled separately so ``maxlen=`` exempts it.
+_CONTAINER_CTORS = {
+    "dict",
+    "list",
+    "set",
+    "OrderedDict",
+    "collections.OrderedDict",
+    "defaultdict",
+    "collections.defaultdict",
+}
+
+_DEQUE_CTORS = {"deque", "collections.deque"}
+
+_GROW_METHODS = {
+    "append",
+    "appendleft",
+    "add",
+    "extend",
+    "update",
+    "setdefault",
+    "insert",
+}
+
+_SHRINK_METHODS = {
+    "pop",
+    "popitem",
+    "popleft",
+    "clear",
+    "remove",
+    "discard",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` → ``"x"``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_empty_container(value: ast.AST, imports: ImportMap) -> bool:
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True
+    if isinstance(value, ast.List) and not value.elts:
+        return True
+    if isinstance(value, ast.Call):
+        full = imports.resolve(value.func)
+        if full in _CONTAINER_CTORS:
+            # dict()/list()/set()/OrderedDict() with seed args may be a
+            # fixed table; only the empty form is a growth candidate.
+            # defaultdict's factory arg doesn't seed it, so allow args.
+            if full.endswith("defaultdict"):
+                return True
+            return not value.args and not value.keywords
+        if full in _DEQUE_CTORS:
+            return not any(kw.arg == "maxlen" for kw in value.keywords)
+    return False
+
+
+def _candidate_attrs(
+    init: ast.AST, imports: ImportMap
+) -> Dict[str, Tuple[int, int]]:
+    """Attrs assigned an empty container in ``__init__`` → (line, col)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        else:
+            continue
+        if not _is_empty_container(value, imports):
+            continue
+        for target in targets:
+            attr = _self_attr(target)
+            if attr is not None and attr not in out:
+                out[attr] = (target.lineno, target.col_offset)
+    return out
+
+
+def _scan_method(
+    method: ast.AST, *, is_init: bool, grown: Set[str], bounded: Set[str]
+) -> None:
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    if func.attr in _GROW_METHODS:
+                        grown.add(attr)
+                    elif func.attr in _SHRINK_METHODS:
+                        bounded.add(attr)
+            # len(self.x) in a comparison — a cap check.
+            if (
+                isinstance(func, ast.Name)
+                and func.id == "len"
+                and len(node.args) == 1
+            ):
+                attr = _self_attr(node.args[0])
+                parent_cmp = getattr(node, "_llmq_parent", None)
+                if attr is not None and isinstance(parent_cmp, ast.Compare):
+                    bounded.add(attr)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            # Flatten tuple unpacks: ``out, self.x = self.x, []`` is the
+            # flush idiom and must register as a reassignment.
+            flat = []
+            for target in targets:
+                if isinstance(target, (ast.Tuple, ast.List)):
+                    flat.extend(target.elts)
+                else:
+                    flat.append(target)
+            for target in flat:
+                if isinstance(target, ast.Subscript):
+                    attr = _self_attr(target.value)
+                    if attr is not None:
+                        grown.add(attr)
+                else:
+                    attr = _self_attr(target)
+                    if attr is None:
+                        continue
+                    if isinstance(node, ast.AugAssign):
+                        grown.add(attr)
+                    elif not is_init:
+                        # Reassignment outside __init__: flush/reset.
+                        bounded.add(attr)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = _self_attr(target.value)
+                    if attr is not None:
+                        bounded.add(attr)
+
+
+class HostBufferChecker(Checker):
+    rules = (UNBOUNDED_HOST_BUFFER,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        for cls in ast.walk(source.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [
+                stmt
+                for stmt in cls.body
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            init = next((m for m in methods if m.name == "__init__"), None)
+            if init is None:
+                continue
+            candidates = _candidate_attrs(init, imports)
+            if not candidates:
+                continue
+            grown: Set[str] = set()
+            bounded: Set[str] = set()
+            for method in methods:
+                _scan_method(
+                    method,
+                    is_init=method is init,
+                    grown=grown,
+                    bounded=bounded,
+                )
+            for attr, (line, col) in sorted(candidates.items()):
+                if attr in grown and attr not in bounded:
+                    yield Violation(
+                        rule=UNBOUNDED_HOST_BUFFER,
+                        path=source.path,
+                        line=line,
+                        col=col,
+                        message=(
+                            f"self.{attr} is grown in {cls.name} but never "
+                            "popped, capped, or reset — it will grow for "
+                            "the life of the process; add eviction or "
+                            "justify with a pragma"
+                        ),
+                    )

@@ -402,6 +402,15 @@ class AmqpBroker(Broker):
         result = await q.purge()
         return getattr(result, "message_count", 0)
 
+    async def delete_queue(self, name: str) -> None:
+        try:
+            q = await self._ensure(name)
+            await q.delete(if_unused=False, if_empty=False)
+        except Exception:  # noqa: BLE001 — deletion is best-effort cleanup
+            pass
+        finally:
+            self._queues.pop(name, None)
+
 
 def _settler(msg):
     async def settle(verb: str, requeue: bool) -> None:

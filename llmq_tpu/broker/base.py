@@ -208,6 +208,18 @@ class Broker(abc.ABC):
     @abc.abstractmethod
     async def purge(self, queue: str) -> int: ...
 
+    async def delete_queue(self, name: str) -> None:
+        """Remove a queue outright (used to retire per-worker affinity
+        queues on graceful shutdown, so a dead worker's private queue
+        cannot strand messages). Callers drain/republish first; any
+        message still present is dropped. Default falls back to a purge
+        so minimal implementations keep working; real registries
+        override to unregister the queue itself."""
+        try:
+            await self.purge(name)
+        except Exception:  # noqa: BLE001 — deletion is best-effort cleanup
+            pass
+
     async def __aenter__(self) -> "Broker":
         await self.connect()
         return self

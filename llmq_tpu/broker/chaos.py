@@ -255,3 +255,9 @@ class ChaosBroker(Broker):
     async def purge(self, queue: str) -> int:
         self._check_alive()
         return await self.inner.purge(queue)
+
+    async def delete_queue(self, name: str) -> None:
+        # Exempt from kills (like declare): deletion is shutdown-path
+        # topology cleanup, not a data-plane op worth fault-injecting.
+        self._check_alive()
+        await self.inner.delete_queue(name)

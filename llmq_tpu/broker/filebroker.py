@@ -351,6 +351,17 @@ class FileBroker(Broker):
                 pass
         return n
 
+    async def delete_queue(self, name: str) -> None:
+        import shutil
+
+        self._declared.discard(name)
+        try:
+            shutil.rmtree(self._qdir(name))
+        except FileNotFoundError:
+            pass
+        except OSError:  # concurrent writers racing the removal: best-effort
+            pass
+
 
 def _list_files(d: Path) -> List[Path]:
     try:

@@ -21,6 +21,7 @@ match, stale heartbeat, or the flag off → the shared queue, unchanged.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import logging
@@ -33,7 +34,14 @@ from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import ErrorInfo, Job, QueueStats, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
 from llmq_tpu.core.template import resolve_template_string, resolve_template_value
-from llmq_tpu.obs import TRACE_FIELD, new_trace, trace_event, trace_from_payload
+from llmq_tpu.obs import (
+    TRACE_FIELD,
+    emit_trace_event,
+    new_trace,
+    trace_event,
+    trace_from_payload,
+)
+from llmq_tpu.utils.aio import reap_all, spawn
 from llmq_tpu.utils.hashing import text_prefix_chain
 
 logger = logging.getLogger(__name__)
@@ -41,13 +49,26 @@ logger = logging.getLogger(__name__)
 RESULTS_SUFFIX = ".results"
 FAILED_SUFFIX = ".failed"
 HEALTH_SUFFIX = ".health"
+QUARANTINE_SUFFIX = ".quarantine"
+
+# Heartbeat cadence (workers publish WorkerHealth this often) and the
+# fleet-wide staleness threshold derived from it: a worker that missed two
+# beats is treated as gone — its advertised pages stop routing jobs and
+# its private affinity queue becomes reclaimable. Defined here (the lowest
+# layer that needs them) so workers, the monitor, and the janitor all agree
+# on one number.
+HEARTBEAT_INTERVAL_S = 30.0
+STALE_AFTER_S = 2 * HEARTBEAT_INTERVAL_S
 
 # How long a cached affinity map is trusted before re-peeking heartbeats.
 AFFINITY_REFRESH_S = 10.0
 # A heartbeat older than this no longer routes jobs: the worker missed two
 # 30 s beats, so its advertised pages may be gone with it (matches the
 # monitor's staleness window, 2 × HEARTBEAT_INTERVAL_S).
-AFFINITY_FRESH_S = 60.0
+AFFINITY_FRESH_S = STALE_AFTER_S
+
+# Affinity-orphan janitor cadence (reclaim pass per queue).
+RECLAIM_INTERVAL_S = 15.0
 
 
 def results_queue_name(queue: str) -> str:
@@ -99,11 +120,27 @@ class BrokerManager:
         self.url = url or self.config.broker_url
         self._broker: Optional[Broker] = None
         # Prefix-affinity routing state: per-queue {digest_hex: [worker_id]}
-        # maps plus the monotonic stamp of their last heartbeat peek.
-        self._affinity_map: Dict[str, Dict[str, List[str]]] = {}
-        self._affinity_at: Dict[str, float] = {}
+        # maps plus the monotonic stamp of their last heartbeat peek. Keyed
+        # by queue name — bounded by the handful of queues one manager
+        # serves; each queue's value is REPLACED wholesale on refresh.
+        self._affinity_map: Dict[str, Dict[str, List[str]]] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._affinity_at: Dict[str, float] = {}  # llmq: ignore[unbounded-host-buffer]
+        # Per-queue {worker_id: last_seen epoch seconds} — retained past the
+        # cache refresh so routing re-checks freshness per job, and past
+        # health-TTL expiry so the janitor still knows which private queues
+        # ever existed (a dead worker's beats evaporate after 120 s). The
+        # inner map IS pruned: the reclaim janitor pops each worker id it
+        # retires; the outer map is bounded by served queue count.
+        self._worker_seen: Dict[str, Dict[str, float]] = {}  # llmq: ignore[unbounded-host-buffer]
+        # Per-queue observed fleet service rate (stamp, jobs/s) for
+        # deadline admission control; one entry per served queue.
+        self._fleet_rate: Dict[str, tuple] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._janitors: Dict[str, Any] = {}
+        self._janitor_tasks: set = set()
         self.affinity_routed = 0
         self.affinity_fallback = 0
+        self.affinity_reclaimed = 0
+        self.jobs_shed = 0
 
     @property
     def broker(self) -> Broker:
@@ -138,6 +175,8 @@ class BrokerManager:
             logger.debug("Connected to broker at %s", self.url)
 
     async def disconnect(self) -> None:
+        await reap_all(self._janitor_tasks, label="affinity janitor")
+        self._janitors.clear()
         if self._broker is not None:
             await self._broker.close()
             self._broker = None
@@ -167,6 +206,10 @@ class BrokerManager:
             results_queue_name(queue), max_redeliveries=1_000_000_000
         )
         await self.broker.declare_queue(queue + FAILED_SUFFIX)
+        if self.config.quarantine_attempts > 0:
+            await self.broker.declare_queue(queue + QUARANTINE_SUFFIX)
+        if self.config.prefix_affinity:
+            self.start_affinity_janitor(queue)
 
     async def setup_pipeline_infrastructure(self, pipeline: PipelineConfig) -> None:
         """Declare every stage queue + the single final results queue."""
@@ -222,6 +265,7 @@ class BrokerManager:
         except Exception:  # noqa: BLE001 — health queue missing/unreadable
             beats = {}
         wall = utcnow()
+        self._record_worker_seen(queue, beats)
         for wid, health in beats.items():
             if not health.prefix_chains:
                 continue
@@ -232,6 +276,30 @@ class BrokerManager:
         self._affinity_map[queue] = mapping
         self._affinity_at[queue] = now
         return mapping
+
+    def _record_worker_seen(
+        self, queue: str, beats: Dict[str, WorkerHealth]
+    ) -> None:
+        """Retain each worker's last heartbeat time (epoch seconds) beyond
+        the affinity cache AND beyond health-message TTL — route-time
+        staleness checks and the orphan janitor both read it."""
+        seen = self._worker_seen.setdefault(queue, {})
+        for wid, health in beats.items():
+            try:
+                at = health.last_seen.timestamp()
+            except Exception:  # noqa: BLE001 — malformed timestamp
+                continue
+            if at > seen.get(wid, 0.0):
+                seen[wid] = at
+
+    def _fresh_workers(self, queue: str, workers: List[str]) -> List[str]:
+        """Filter a candidate list down to workers whose *heartbeat* is
+        still within STALE_AFTER_S right now — the cached affinity map is
+        up to AFFINITY_REFRESH_S old, so a worker can die inside the cache
+        window and still look routable without this re-check."""
+        seen = self._worker_seen.get(queue, {})
+        now = time.time()
+        return [w for w in workers if now - seen.get(w, 0.0) <= STALE_AFTER_S]
 
     async def _route_for_affinity(self, queue: str, job: Job) -> str:
         """The queue this job should land on: the private queue of the
@@ -245,14 +313,175 @@ class BrokerManager:
             return queue
         # Deepest matching digest wins: it pins the most shared context.
         for digest in reversed(chain):
-            workers = mapping.get(digest)
+            workers = self._fresh_workers(queue, mapping.get(digest) or [])
             if workers:
                 wid = rendezvous_pick(digest, workers)
                 return affinity_queue_name(queue, wid)
         return queue
 
+    # --- affinity-orphan reclaim ------------------------------------------
+    def start_affinity_janitor(
+        self, queue: str, *, interval_s: float = RECLAIM_INTERVAL_S
+    ) -> None:
+        """Start the per-queue background janitor that reclaims orphaned
+        ``<q>.w.<id>`` queues (idempotent per queue)."""
+        if queue in self._janitors:
+            return
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(interval_s)
+                try:
+                    await self.reclaim_orphaned_affinity_queues(queue)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — janitor must outlive blips
+                    logger.debug("Affinity reclaim pass failed", exc_info=True)
+
+        self._janitors[queue] = spawn(
+            loop(),
+            registry=self._janitor_tasks,
+            name=f"affinity-janitor:{queue}",
+        )
+
+    async def reclaim_orphaned_affinity_queues(self, queue: str) -> int:
+        """One reclaim pass: for every worker this manager has ever seen
+        heartbeat on ``queue`` whose last beat is older than
+        ``STALE_AFTER_S``, drain its private ``<q>.w.<id>`` queue back onto
+        the shared queue and delete it (plus its ``<q>.kv.<id>`` RPC
+        queue). Returns the number of messages republished.
+
+        Orphaned messages would otherwise strand forever: nothing consumes
+        a dead worker's private queue, and health-message TTL (120 s)
+        erases the evidence the worker existed — hence the in-memory
+        ``_worker_seen`` record.
+        """
+        try:
+            beats = await self.get_worker_health(queue)
+        except Exception:  # noqa: BLE001
+            beats = {}
+        self._record_worker_seen(queue, beats)
+        seen = self._worker_seen.get(queue, {})
+        now = time.time()
+        reclaimed = 0
+        for wid, last in list(seen.items()):
+            if now - last <= STALE_AFTER_S:
+                continue
+            aq = affinity_queue_name(queue, wid)
+            # Re-publish whatever the dead worker's queue still holds onto
+            # the shared queue, preserving ids/headers (payload untouched,
+            # so traces and resume snapshots ride along).
+            while True:
+                msg = await self.broker.get(aq)
+                if msg is None:
+                    break
+                await self.broker.publish(
+                    queue,
+                    msg.body,
+                    message_id=msg.message_id,
+                    headers=msg.headers,
+                )
+                await msg.ack()
+                reclaimed += 1
+                emit_trace_event(
+                    str(msg.message_id), "affinity_reclaimed", worker=wid
+                )
+            await self.broker.delete_queue(aq)
+            await self.broker.delete_queue(kv_fetch_queue_name(queue, wid))
+            seen.pop(wid, None)
+            logger.info(
+                "Reclaimed affinity queue %s (%d stranded messages)",
+                aq,
+                reclaimed,
+            )
+        self.affinity_reclaimed += reclaimed
+        return reclaimed
+
+    # --- deadline admission control ---------------------------------------
+    async def _observed_fleet_rate(self, queue: str) -> Optional[float]:
+        """Aggregate fleet service rate (jobs/s) from fresh heartbeats'
+        avg_duration_ms — the PR 7 obs plane. None when no worker has
+        reported a duration yet (then admission control stays out of the
+        way: no data, no shedding). Cached like the affinity map."""
+        now = time.monotonic()
+        cached = self._fleet_rate.get(queue)
+        if cached is not None and now - cached[0] < AFFINITY_REFRESH_S:
+            return cached[1]
+        try:
+            beats = await self.get_worker_health(queue)
+        except Exception:  # noqa: BLE001
+            beats = {}
+        self._record_worker_seen(queue, beats)
+        wall = utcnow()
+        rate = 0.0
+        for health in beats.values():
+            if (wall - health.last_seen).total_seconds() > STALE_AFTER_S:
+                continue
+            if health.avg_duration_ms and health.avg_duration_ms > 0:
+                rate += 1000.0 / health.avg_duration_ms
+        result = rate if rate > 0 else None
+        self._fleet_rate[queue] = (now, result)
+        return result
+
+    async def _should_shed(self, queue: str, deadline_at: float) -> bool:
+        """Publish-side load shedding: when queue depth divided by the
+        observed fleet service rate cannot meet this job's deadline, fail
+        it NOW as a dead-letter instead of letting it queue, time out,
+        and waste a worker slot discovering that."""
+        budget_s = deadline_at - time.time()
+        if budget_s <= 0:
+            return True  # already expired at submit
+        rate = await self._observed_fleet_rate(queue)
+        if rate is None:
+            return False  # no observed service rate: don't guess
+        try:
+            depth = (await self.get_queue_stats(queue)).message_count_ready
+        except Exception:  # noqa: BLE001
+            depth = None
+        if depth is None:
+            return False
+        return depth / rate > budget_s
+
+    async def shed_job(self, queue: str, job: Job, *, reason: str) -> None:
+        """Dead-letter a job at admission time as ``deadline_exceeded`` —
+        shed work is never silently dropped; it lands on ``<q>.failed``
+        with the same headers a worker-side deadline expiry produces."""
+        payload = job.model_dump(mode="json")
+        trace = trace_from_payload(payload)
+        if trace is None:
+            trace = payload[TRACE_FIELD] = new_trace(job.id)
+        trace_event(trace, "shed", queue=queue, reason=reason)
+        emit_trace_event(job.id, "shed", queue=queue, reason=reason)
+        await self.broker.publish(
+            queue + FAILED_SUFFIX,
+            json.dumps(payload, default=str).encode("utf-8"),
+            message_id=job.id,
+            headers={
+                "x-error": "deadline_exceeded",
+                "x-failure-reason": "deadline_exceeded",
+                "x-shed": reason,
+            },
+        )
+        self.jobs_shed += 1
+
     # --- publish ----------------------------------------------------------
     async def publish_job(self, queue: str, job: Job) -> None:
+        # Deadline stamping: a fresh submit converts the relative budget
+        # (job field, else config default) into an absolute deadline_at.
+        # Re-publishes (pipeline handoffs, requeues) already carry
+        # deadline_at and keep it — the deadline is end-to-end.
+        if job.deadline_at is None:
+            budget_ms = job.deadline_ms or self.config.deadline_ms or 0
+            if budget_ms > 0:
+                job.deadline_at = time.time() + budget_ms / 1000.0
+        if job.deadline_at is not None:
+            try:
+                shed = await self._should_shed(queue, job.deadline_at)
+            except Exception:  # noqa: BLE001 — admission control best-effort
+                shed = False
+            if shed:
+                await self.shed_job(queue, job, reason="admission_control")
+                return
         target = queue
         if self.config.prefix_affinity:
             try:
@@ -401,6 +630,7 @@ class BrokerManager:
                     ),
                     worker_id=msg.headers.get("x-worker-id"),
                     redeliveries=int(msg.headers.get("x-delivery-count", 0) or 0),
+                    failure_reason=msg.headers.get("x-failure-reason"),
                 )
             )
         for msg in fetched:

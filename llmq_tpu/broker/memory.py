@@ -353,6 +353,22 @@ class BrokerCore:
         q.ready.clear()
         return ids
 
+    def delete(self, queue: str) -> list:
+        """Unregister a queue outright, dropping whatever it still holds
+        (ready AND unacked — callers drain/republish first). Returns the
+        dropped message ids for journaling. Distinct from purge: the
+        queue stops existing, so nothing can strand on it."""
+        q = self.queues.pop(queue, None)
+        if q is None:
+            return []
+        ids = [m.message_id for m in q.ready]
+        ids.extend(q.unacked.keys())
+        q.ready.clear()
+        q.unacked.clear()
+        q.consumers.clear()
+        self._dispatch_scheduled.discard(queue)
+        return ids
+
 
 # Placeholder handler for get_one's transient consumer: the caller of get()
 # owns settling the returned message, so this handler never runs it.
@@ -457,3 +473,6 @@ class MemoryBroker(Broker):
 
     async def purge(self, queue: str) -> int:
         return len(self.core.purge(queue))
+
+    async def delete_queue(self, name: str) -> None:
+        self.core.delete(name)

@@ -447,3 +447,9 @@ class ResilientBroker(Broker):
 
     async def purge(self, queue: str) -> int:
         return await self._run(lambda: self.inner.purge(queue))
+
+    async def delete_queue(self, name: str) -> None:
+        # Drop from the recorded topology FIRST so a reconnect replay
+        # doesn't re-declare a queue we are in the middle of retiring.
+        self._topology.pop(name, None)
+        await self._run(lambda: self.inner.delete_queue(name))

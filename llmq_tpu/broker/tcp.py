@@ -424,6 +424,22 @@ class BrokerServer:
                     {"op": "ack", "queue": req["queue"], "message_id": mid}
                 )
             await send(reply(purged=len(purged_ids)))
+        elif op == "delete":
+            # Queue deletion drops ready AND unacked messages — journal an
+            # ack per dropped id so a restart doesn't resurrect them onto
+            # a queue that no longer exists.
+            dropped_ids = self.core.delete(req["queue"])
+            for mid in dropped_ids:
+                self._journal(
+                    {"op": "ack", "queue": req["queue"], "message_id": mid}
+                )
+            for key in [
+                k
+                for k, (q, _) in self._pending_settles.items()
+                if q == req["queue"]
+            ]:
+                self._pending_settles.pop(key, None)
+            await send(reply(deleted=len(dropped_ids)))
         else:
             await send(
                 {"type": "reply", "req_id": req_id, "ok": False, "error": f"bad op {op!r}"}
@@ -658,3 +674,6 @@ class TcpBroker(Broker):
     async def purge(self, queue: str) -> int:
         reply = await self._request({"op": "purge", "queue": queue})
         return int(reply.get("purged", 0))
+
+    async def delete_queue(self, name: str) -> None:
+        await self._request({"op": "delete", "queue": name})

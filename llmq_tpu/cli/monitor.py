@@ -19,6 +19,7 @@ from rich.table import Table
 
 from llmq_tpu.broker.manager import (
     FAILED_SUFFIX,
+    QUARANTINE_SUFFIX,
     BrokerManager,
     results_queue_name,
 )
@@ -200,12 +201,15 @@ async def show_errors(queue: str, *, limit: int = 10) -> None:
             console.print(f"[green]No dead-lettered jobs in '{queue}.failed'[/green]")
             return
         table = Table(title=f"Dead-lettered jobs: {queue}.failed")
-        for col in ("job id", "error", "redeliveries", "worker"):
+        for col in ("job id", "error", "reason", "redeliveries", "worker"):
             table.add_column(col)
         for err in errors:
             table.add_row(
                 err.job_id,
                 err.error_message,
+                # Machine-readable failure class (deadline_exceeded,
+                # engine_error:<Type>, ...) — absent on legacy entries.
+                err.failure_reason or "-",
                 str(err.redeliveries),
                 err.worker_id or "-",
             )
@@ -296,7 +300,33 @@ def _fmt_pcts(es: dict, lo_key: str, hi_key: str) -> str:
     return f"{f(lo)}/{f(hi)}"
 
 
-def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
+def _selfheal_cell(es: dict) -> str:
+    """Compact per-worker robustness summary from heartbeat engine stats.
+
+    The producers are superset-only (counters appear once they move), so
+    a clean worker renders "-" and the dashboard looks identical to the
+    pre-self-healing one until something actually degrades."""
+    parts = []
+    for key, tag in (
+        ("jobs_deadline_exceeded", "ddl"),
+        ("jobs_quarantined", "quar"),
+        ("kv_fetch_failures", "kvf"),
+        ("kv_serve_busy_rejects", "busy"),
+    ):
+        value = es.get(key)
+        if value:
+            parts.append(f"{tag}:{value}")
+    if es.get("breaker_tripped"):
+        parts.append("[red]BRK[/red]")
+    return " ".join(parts) if parts else "-"
+
+
+def _render_top(
+    queue: str,
+    beats: Dict[str, WorkerHealth],
+    stats: QueueStats,
+    quarantine_depth: Optional[int] = None,
+):
     """One refresh frame: fleet summary line + per-worker table, built
     from the freshest heartbeat per worker."""
     from rich.console import Group
@@ -324,8 +354,17 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
     )
     if occs:
         header += f" | occupancy {sum(occs) / len(occs):.0%}"
+    if quarantine_depth:
+        header += f" | [red]quarantined {quarantine_depth}[/red]"
+    # The self-heal column is itself superset-only: it renders only when
+    # some worker reports degradation, so a healthy fleet's dashboard is
+    # byte-identical to the pre-self-healing one (and the table keeps its
+    # width on narrow consoles).
+    show_selfheal = any(
+        _selfheal_cell(h.engine_stats or {}) != "-" for h in beats.values()
+    )
     table = Table(title=f"Worker heartbeats (last {_stale_window_text()})")
-    for col in (
+    cols = [
         "worker",
         "status",
         "jobs",
@@ -336,7 +375,10 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
         "itl p50/p95 ms",
         "reconnects",
         "last seen",
-    ):
+    ]
+    if show_selfheal:
+        cols.insert(8, "self-heal")
+    for col in cols:
         table.add_column(col)
     for wid in sorted(beats):
         health = beats[wid]
@@ -346,7 +388,7 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
         # Prefix-cache hit rate: prompt pages served from cache (device
         # reuse + host-tier promotes) over all chain pages seen.
         hit = es.get("prefix_hit_rate")
-        table.add_row(
+        cells = [
             wid,
             "[red]stale[/red]" if is_stale else health.status,
             str(health.jobs_processed),
@@ -357,7 +399,10 @@ def _render_top(queue: str, beats: Dict[str, WorkerHealth], stats: QueueStats):
             _fmt_pcts(es, "itl_p50_ms", "itl_p95_ms"),
             str(health.reconnects) if health.reconnects is not None else "-",
             health.last_seen.strftime("%H:%M:%S"),
-        )
+        ]
+        if show_selfheal:
+            cells.insert(8, _selfheal_cell(es))
+        table.add_row(*cells)
     return Group(header, table)
 
 
@@ -379,7 +424,19 @@ async def monitor_top(
             while True:
                 beats = await _collect_heartbeats(mgr, queue)
                 stats = await mgr.get_queue_stats(queue)
-                live.update(_render_top(queue, beats, stats), refresh=True)
+                # Quarantine depth: poison jobs parked for operator triage.
+                # The queue only exists once a worker files something, so
+                # a missing queue reads as a clean fleet.
+                qstats = await mgr.get_queue_stats(queue + QUARANTINE_SUFFIX)
+                qdepth = (
+                    qstats.message_count
+                    if qstats.stats_source != "unavailable"
+                    else None
+                )
+                live.update(
+                    _render_top(queue, beats, stats, quarantine_depth=qdepth),
+                    refresh=True,
+                )
                 count += 1
                 if iterations is not None and count >= iterations:
                     return

@@ -224,6 +224,47 @@ class Config(BaseModel):
         description="Ceiling on the exponential redelivery backoff.",
     )
 
+    deadline_ms: int = Field(
+        default_factory=lambda: _env_int("LLMQ_DEADLINE_MS", default=0),
+        description="Default per-job completion deadline (ms from submit). "
+        "Expired jobs dead-letter as deadline_exceeded instead of running; "
+        "the submit path sheds early when queue depth x observed service "
+        "rate cannot meet it. 0 disables (no deadline stamped).",
+    )
+
+    host_mem_gb: float = Field(
+        default_factory=lambda: _env_float("LLMQ_HOST_MEM_GB", default=0.0),
+        description="Shared host-RAM byte budget (GiB) governing the prefix "
+        "cold tier, snapshot swap, and resume-republish blobs together "
+        "(utils/host_mem.HostMemoryGovernor). Under pressure the governor "
+        "degrades in order: evict cold prefixes, refuse swap-preempt "
+        "(recompute-preemption fallback), refuse KV-ship serves. "
+        "0 disables the shared budget (per-store budgets still apply).",
+    )
+
+    quarantine_attempts: int = Field(
+        default_factory=lambda: _env_int("LLMQ_QUARANTINE_ATTEMPTS", default=0),
+        description="Fleet-wide attempts before a job that keeps crashing "
+        "the engine is quarantined to <queue>.quarantine instead of "
+        "cycling through workers. 0 disables quarantine.",
+    )
+
+    peer_serve_concurrency: int = Field(
+        default_factory=lambda: _env_int(
+            "LLMQ_PEER_SERVE_CONCURRENCY", default=2
+        ),
+        description="Concurrent KV-ship fetch requests a worker serves "
+        "before replying busy (the requester recomputes immediately "
+        "instead of burning its fetch timeout).",
+    )
+
+    breaker_failures: int = Field(
+        default_factory=lambda: _env_int("LLMQ_BREAKER_FAILURES", default=0),
+        description="Consecutive engine failures before a worker trips its "
+        "circuit breaker and self-drains via the handoff path (its jobs "
+        "requeue/hand off to healthy peers). 0 disables.",
+    )
+
     job_timeout_s: Optional[float] = Field(
         default_factory=lambda: _env_float("LLMQ_JOB_TIMEOUT_S"),
         description="Per-job processing timeout: a job running past it is "

@@ -25,7 +25,16 @@ from typing import Any, Dict, List, Optional
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
 
-_RESERVED_JOB_FIELDS = {"id", "prompt", "messages", "chat_mode", "stop", "sampling"}
+_RESERVED_JOB_FIELDS = {
+    "id",
+    "prompt",
+    "messages",
+    "chat_mode",
+    "stop",
+    "sampling",
+    "deadline_ms",
+    "deadline_at",
+}
 
 
 def utcnow() -> datetime:
@@ -68,6 +77,19 @@ class Job(BaseModel):
     )
     sampling: Optional[SamplingOptions] = Field(
         None, description="Per-job sampling overrides"
+    )
+    deadline_ms: Optional[int] = Field(
+        None,
+        ge=1,
+        description="Completion-deadline budget (ms from submit). The "
+        "submit path stamps deadline_at from it; expired jobs dead-letter "
+        "as deadline_exceeded instead of running. None = config default.",
+    )
+    deadline_at: Optional[float] = Field(
+        None,
+        description="Absolute deadline (epoch seconds), stamped at submit "
+        "from deadline_ms. Checked at claim, between decode blocks, and "
+        "before expensive recovery paths (KV fetch, swap restore).",
     )
 
     model_config = ConfigDict(extra="allow")
@@ -171,3 +193,9 @@ class ErrorInfo(BaseModel):
     timestamp: datetime = Field(default_factory=utcnow)
     worker_id: Optional[str] = None
     redeliveries: int = 0
+    failure_reason: Optional[str] = Field(
+        None,
+        description="Machine-readable failure class (engine_error, "
+        "deadline_exceeded, unparseable, ...) — the fingerprint the "
+        "poison-job quarantine keys on; None for pre-quarantine records.",
+    )

@@ -108,6 +108,7 @@ from llmq_tpu.obs.metrics import (
 )
 from llmq_tpu.obs.trace import emit_trace_event
 from llmq_tpu.ops import dispatch as _dispatch
+from llmq_tpu.utils.host_mem import get_governor
 from llmq_tpu.ops.attention import mixed_query_grid
 from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
 from llmq_tpu.parallel.sharding import kv_page_pspec, param_shardings
@@ -616,7 +617,28 @@ class EngineCore:
         self.prefix_promotes = 0  # pages restored from the host tier
         self.prefix_chunks_exported = 0  # pages serialized for peers
         self.prefix_chunks_ingested = 0  # shipped pages accepted
+        self.deadline_expirations = 0  # sequences expired by the sweep
+        self.swap_refused = 0  # captures the host-memory governor declined
+        # Flipped by the first deadline-carrying request so the per-step
+        # sweep costs nothing on deadline-free deployments.
+        self._deadlines_enabled = False
         self._started_at = time.monotonic()
+
+        # Unified host-memory governor: the prefix cold tier and the
+        # swap-restore blobs report into the shared budget (registration
+        # only when a budget is configured — default engines touch
+        # nothing). Names are per-instance so test processes running
+        # several engines don't shadow each other's gauges.
+        gov = get_governor()
+        if gov.enabled:
+            tag = f"engine-{id(self):x}"
+            gov.register(f"swap:{tag}", self._swap_restore_bytes)
+            if self.prefix_store is not None:
+                gov.register(
+                    f"prefix:{tag}",
+                    lambda: self.prefix_store.occupancy_bytes,
+                    evict_fn=self._evict_prefix_bytes,
+                )
 
         # Observability: host-side only — a histogram record is a bucket
         # increment, never inside jitted code. Per-engine instances
@@ -632,8 +654,10 @@ class EngineCore:
             "Inter-token latency at the host boundary",
             buckets=ITL_BUCKETS,
         )
-        self._dispatch_rings: Dict[str, Deque[float]] = {}
-        self._dispatch_hists: Dict[str, Histogram] = {}
+        # Keyed by dispatch kind ("prefill"/"decode"/"mixed") — a fixed
+        # set; the ring deques themselves carry maxlen.
+        self._dispatch_rings: Dict[str, Deque[float]] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._dispatch_hists: Dict[str, Histogram] = {}  # llmq: ignore[unbounded-host-buffer]
         reg = get_registry()
         for metric in (
             self.ttft_hist,
@@ -1332,6 +1356,7 @@ class EngineCore:
         messages: Optional[List[Dict[str, str]]] = None,
         prompt_ids: Optional[List[int]] = None,
         params: Optional[SamplingParams] = None,
+        deadline_at: Optional[float] = None,
     ) -> Sequence:
         if prompt_ids is None:
             if messages is not None:
@@ -1355,7 +1380,10 @@ class EngineCore:
             rid=rid,
             prompt_ids=list(prompt_ids),
             params=params,
+            deadline_at=deadline_at,
         )
+        if deadline_at is not None:
+            self._deadlines_enabled = True
         self.total_prompt_tokens += len(seq.prompt_ids)
         self.scheduler.add(seq)
         return seq
@@ -1384,6 +1412,8 @@ class EngineCore:
         latency is unchanged.
         """
         finished: List[RequestOutput] = []
+        if self._deadlines_enabled:
+            self._expire_deadlines(finished)
         # Sequences decodable BEFORE this wave: only they justify
         # interleaving decode between admission chunks — a cold-start
         # wave decoding its own fresh rows would pay full-cost steps at
@@ -1406,6 +1436,34 @@ class EngineCore:
         """Running sequences the decode step actually advances (prefilled;
         mid-prefill rows are in ``running`` but have no decode state)."""
         return [s for s in self.scheduler.running.values() if s.prefilled]
+
+    def _expire_deadlines(self, finished: List[RequestOutput]) -> None:
+        """Between-steps deadline sweep: waiting or running sequences
+        whose wall-clock deadline has passed finish with
+        ``deadline_exceeded`` — their slots and pages go to requests that
+        can still meet theirs. Running mid-prefill rows are skipped (an
+        in-flight chunk loop may still write their pages); they expire on
+        the next sweep once prefilled."""
+        now = time.time()
+        for seq in [
+            s
+            for s in self.scheduler.waiting
+            if s.deadline_at is not None and now > s.deadline_at
+        ]:
+            self.scheduler.waiting.remove(seq)
+            self.scheduler.finish(seq, "deadline_exceeded")
+            finished.append(self._output_for(seq))
+            self.deadline_expirations += 1
+        for seq in [
+            s
+            for s in self.scheduler.running.values()
+            if s.prefilled and s.deadline_at is not None and now > s.deadline_at
+        ]:
+            self._finish_seq(
+                seq, "deadline_exceeded", device_detected=False,
+                finished=finished,
+            )
+            self.deadline_expirations += 1
 
     def _try_admit(self, finished: List[RequestOutput]) -> bool:
         """Admit + prefill up to one chunk; True if anything was admitted
@@ -1606,6 +1664,8 @@ class EngineCore:
         n = snapshot_mod.pages_for(valid, self.cfg.page_size)
         if n == 0 or n > len(pages):
             return
+        if not self._admit_swap_capture(n):
+            return  # recompute fallback: re-admission re-prefills
         idx = jnp.asarray(pages[:n], jnp.int32)
         # np.asarray blocks until the gather lands, so the fresh host
         # buffers are safe against the pools' later donation.
@@ -1613,6 +1673,46 @@ class EngineCore:
         v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
+
+    def _page_host_bytes(self) -> int:
+        """Host bytes one swapped KV page costs (K + V)."""
+        per_page = (
+            int(self.k_pages.size)
+            * int(jnp.dtype(self.k_pages.dtype).itemsize)
+        ) // max(1, self.scheduler.config.num_pages)
+        return 2 * per_page
+
+    def _admit_swap_capture(self, n_pages: int) -> bool:
+        """Ask the host-memory governor before buffering ``n_pages`` of
+        swapped KV. A refusal downgrades the preemption to recompute
+        (the pre-swap behavior — always correct, slower to resume)."""
+        if get_governor().admit_swap(n_pages * self._page_host_bytes()):
+            return True
+        self.swap_refused += 1
+        return False
+
+    def _swap_restore_bytes(self) -> int:
+        """Governor gauge: host bytes currently held by swap/restore KV
+        blobs awaiting re-admission."""
+        total = 0
+        for seq in list(self.scheduler.waiting):
+            r = seq.restore
+            if r is not None:
+                total += int(r.k.nbytes) + int(r.v.nbytes)
+        return total
+
+    def _evict_prefix_bytes(self, nbytes: int) -> int:
+        """Governor evictor: drop cold prefix entries (oldest first)
+        until ``nbytes`` are freed or the store is empty."""
+        store = self.prefix_store
+        if store is None:
+            return 0
+        freed = 0
+        while freed < nbytes and len(store):
+            before = store.occupancy_bytes
+            store._evict_one()
+            freed += before - store.occupancy_bytes
+        return freed
 
     def _on_scheduler_preempt(self, seq: Sequence, deferred: bool) -> None:
         """Scheduler ``on_preempt`` hook. Deferred self-preemptions queue
@@ -1633,6 +1733,8 @@ class EngineCore:
         n = snapshot_mod.pages_for(valid, self.cfg.page_size)
         if n == 0 or n > len(seq.pages):
             return
+        if not self._admit_swap_capture(n):
+            return  # recompute fallback: re-admission re-prefills
         idx = jnp.asarray(seq.pages[:n], jnp.int32)
         k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
         v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
@@ -2742,7 +2844,12 @@ class EngineCore:
             self.snapshots_extracted += 1
         return snaps
 
-    def insert_request(self, snap: RequestSnapshot) -> Sequence:
+    def insert_request(
+        self,
+        snap: RequestSnapshot,
+        *,
+        deadline_at: Optional[float] = None,
+    ) -> Sequence:
         """Re-insert an extracted request, here or on a different engine.
         KV pages are remapped to whatever physical pages admission hands
         out (repacked host-side if the page size differs); the sampling
@@ -2788,7 +2895,10 @@ class EngineCore:
             preempt_count=snap.preempt_count,
             detok_len=snap.detok_len,
             detok_text=snap.detok_text,
+            deadline_at=deadline_at,
         )
+        if deadline_at is not None:
+            self._deadlines_enabled = True
         if (
             snap.kv_k is not None
             and snap.kv_v is not None
@@ -2988,6 +3098,14 @@ class EngineCore:
             )[0]
         if self.prefix_store is not None:
             s.update(self.prefix_store.stats())
+        # Fleet self-healing counters (superset-only: appear once moved).
+        if self.deadline_expirations:
+            s["deadline_expirations"] = self.deadline_expirations
+        if self.swap_refused:
+            s["swap_refused"] = self.swap_refused
+        gov = get_governor()
+        if gov.enabled:
+            s["host_mem"] = gov.stats()
         return s
 
 
@@ -3035,6 +3153,7 @@ class AsyncEngine:
         messages: Optional[List[Dict[str, str]]] = None,
         prompt_ids: Optional[List[int]] = None,
         params: Optional[SamplingParams] = None,
+        deadline_at: Optional[float] = None,
     ) -> RequestOutput:
         import asyncio
 
@@ -3042,7 +3161,9 @@ class AsyncEngine:
             raise RuntimeError("engine is draining for handoff")
         fut: Future = Future()
         self._futures[rid] = fut
-        self._intake.put((rid, prompt, messages, prompt_ids, params, None))
+        self._intake.put(
+            (rid, prompt, messages, prompt_ids, params, None, deadline_at)
+        )
         self._wake.set()
         try:
             return await asyncio.wrap_future(fut)
@@ -3050,7 +3171,11 @@ class AsyncEngine:
             self._futures.pop(rid, None)
 
     async def resume(
-        self, *, rid: str, snapshot: RequestSnapshot
+        self,
+        *,
+        rid: str,
+        snapshot: RequestSnapshot,
+        deadline_at: Optional[float] = None,
     ) -> RequestOutput:
         """Continue a request from a :class:`RequestSnapshot` (published
         by a peer's drain-with-handoff). Completes exactly like generate();
@@ -3061,7 +3186,7 @@ class AsyncEngine:
             raise RuntimeError("engine is draining for handoff")
         fut: Future = Future()
         self._futures[rid] = fut
-        self._intake.put((rid, None, None, None, None, snapshot))
+        self._intake.put((rid, None, None, None, None, snapshot, deadline_at))
         self._wake.set()
         try:
             return await asyncio.wrap_future(fut)
@@ -3079,6 +3204,7 @@ class AsyncEngine:
                 kwargs.get("prompt_ids"),
                 kwargs.get("params"),
                 kwargs.get("snapshot"),
+                kwargs.get("deadline_at"),
             )
         )
         self._wake.set()
@@ -3230,10 +3356,10 @@ class AsyncEngine:
                     break
                 if item is None:
                     continue
-                rid, prompt, messages, prompt_ids, params, snapshot = item
+                rid, prompt, messages, prompt_ids, params, snapshot, dl = item
                 try:
                     if snapshot is not None:
-                        self.core.insert_request(snapshot)
+                        self.core.insert_request(snapshot, deadline_at=dl)
                     else:
                         self.core.add_request(
                             rid,
@@ -3241,6 +3367,7 @@ class AsyncEngine:
                             messages=messages,
                             prompt_ids=prompt_ids,
                             params=params,
+                            deadline_at=dl,
                         )
                     drained = True
                 except Exception as exc:  # tokenization/validation error

@@ -180,6 +180,10 @@ class Sequence:
     admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
     preempt_count: int = 0
     prefilled: bool = False  # KV cache holds this sequence (engine sets it)
+    # Wall-clock (time.time()) deadline, or None. The engine's sweep
+    # expires waiting/running sequences past it between decode steps with
+    # finish_reason="deadline_exceeded"; the worker dead-letters those.
+    deadline_at: Optional[float] = None
     finish_reason: Optional[str] = None
     finish_text: Optional[str] = None  # pre-truncated text on stop-string hit
     # Incremental detokenization cache (engine-owned, stop-string

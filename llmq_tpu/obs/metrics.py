@@ -232,7 +232,9 @@ class MetricsRegistry:
     """Ordered collection of metrics + the Prometheus text renderer."""
 
     def __init__(self) -> None:
-        self._metrics: Dict[Tuple, Metric] = {}
+        # Keyed by (name, labels) of instrumented code sites — a static
+        # set fixed at import/startup, not per-request state.
+        self._metrics: Dict[Tuple, Metric] = {}  # llmq: ignore[unbounded-host-buffer]
         self._lock = threading.Lock()
 
     # --- registration -----------------------------------------------------

@@ -1,0 +1,192 @@
+"""Unified host-memory governor.
+
+Three subsystems buffer KV-derived bytes in host RAM — the prefix cold
+tier (``engine/prefix_store.py``), snapshot swap-preemption
+(``engine/engine.py`` capture paths), and resume-republish blobs
+(``workers/base.py`` handoff) — and before this module each sized itself
+independently, so their budgets only composed by luck: a worker tuned
+for a 4 GiB prefix tier plus a burst of swap-preempts could overshoot
+container RAM and get OOM-killed, taking every in-flight request with it.
+
+:class:`HostMemoryGovernor` gives them one shared byte budget
+(``LLMQ_HOST_MEM_GB``) with an explicit degradation ladder — each rung
+trades throughput, never correctness:
+
+1. **Evict cold prefixes.** Prefix pages are a pure cache; dropping one
+   costs a re-prefill at worst.
+2. **Refuse swap-preempt** (above ``SWAP_REFUSE_FRAC`` of budget, after
+   eviction). The engine falls back to recompute-preemption — the
+   pre-PR-8 behavior, always correct, just slower on resume.
+3. **Refuse KV-ship serves** (above ``SERVE_REFUSE_FRAC``). Peers
+   recompute locally instead of pulling pages; export buffers are the
+   last optional allocation standing.
+
+Resume-republish blobs are *accounted but never refused* — refusing them
+would strand an in-flight request during drain, which is exactly the
+moment the handoff path must not fail.
+
+A budget of 0 (the default) disables the governor entirely: every
+``admit_*`` answers yes and no eviction pressure is applied, so existing
+deployments see no behavior change until they opt in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+# Degradation-ladder thresholds, as fractions of the byte budget. Swap
+# refuses before serve so that rising pressure sheds optional *local*
+# buffering before it stops helping *remote* peers — by the time serves
+# are refused the host is nearly full and export buffers are the only
+# allocation left to cut.
+SWAP_REFUSE_FRAC = 0.85
+SERVE_REFUSE_FRAC = 0.95
+
+
+class HostMemoryGovernor:
+    """One shared byte budget across host-RAM consumers.
+
+    Consumers ``register(name, usage_fn, evict_fn=None)`` — ``usage_fn``
+    reports their current bytes, ``evict_fn(nbytes)`` (optional) frees at
+    least-effort toward ``nbytes`` and returns bytes actually freed.
+    Admission checks then see the *global* occupancy, not one store's.
+    """
+
+    def __init__(self, budget_bytes: int) -> None:
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._lock = threading.Lock()
+        self._usage_fns: Dict[str, Callable[[], int]] = {}
+        self._evict_fns: Dict[str, Callable[[int], int]] = {}
+        # Degradation/pressure counters (surfaced via stats()/heartbeats).
+        self.evictions_forced = 0
+        self.swap_refusals = 0
+        self.serve_refusals = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    def register(
+        self,
+        name: str,
+        usage_fn: Callable[[], int],
+        evict_fn: Optional[Callable[[int], int]] = None,
+    ) -> None:
+        """Register (or replace) a consumer. Idempotent per name so
+        engine restarts inside one process re-bind cleanly."""
+        with self._lock:
+            self._usage_fns[name] = usage_fn
+            if evict_fn is not None:
+                self._evict_fns[name] = evict_fn
+            else:
+                self._evict_fns.pop(name, None)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._usage_fns.pop(name, None)
+            self._evict_fns.pop(name, None)
+
+    def usage_bytes(self) -> int:
+        """Sum of all registered consumers' current bytes (0 on any
+        consumer error — a broken gauge must not wedge admission)."""
+        with self._lock:
+            fns = list(self._usage_fns.values())
+        total = 0
+        for fn in fns:
+            try:
+                total += max(0, int(fn()))
+            except Exception:  # noqa: BLE001 — gauges are best-effort
+                pass
+        return total
+
+    def _evict_toward(self, target_bytes: int) -> int:
+        """Ladder rung 1: ask evictors (cold prefixes first — they are
+        the only registered evictors today) to free until global usage
+        fits under ``target_bytes``. Returns bytes freed."""
+        freed = 0
+        with self._lock:
+            evictors = list(self._evict_fns.values())
+        for evict in evictors:
+            over = self.usage_bytes() - target_bytes
+            if over <= 0:
+                break
+            try:
+                got = int(evict(over))
+            except Exception:  # noqa: BLE001
+                got = 0
+            if got > 0:
+                freed += got
+                self.evictions_forced += 1
+        return freed
+
+    def admit_swap(self, nbytes: int) -> bool:
+        """May the engine buffer a swap-preempt capture of ``nbytes``?
+
+        Tries prefix eviction first; refuses only if even after eviction
+        the capture would push usage past ``SWAP_REFUSE_FRAC`` of budget.
+        A refusal is safe — the caller falls back to recompute-preemption.
+        """
+        if not self.enabled:
+            return True
+        limit = int(self.budget_bytes * SWAP_REFUSE_FRAC)
+        if self.usage_bytes() + nbytes <= limit:
+            return True
+        self._evict_toward(limit - nbytes)
+        if self.usage_bytes() + nbytes <= limit:
+            return True
+        self.swap_refusals += 1
+        return False
+
+    def admit_serve(self) -> bool:
+        """May this worker build an export buffer to serve a KV-ship
+        fetch? Refused only near the top of the budget (the final rung);
+        the peer recomputes, which is always correct."""
+        if not self.enabled:
+            return True
+        if self.usage_bytes() <= int(self.budget_bytes * SERVE_REFUSE_FRAC):
+            return True
+        self.serve_refusals += 1
+        return False
+
+    def note_resume_blob(self, nbytes: int) -> None:
+        """Account a resume-republish blob. Never refuses (refusal would
+        strand an in-flight request mid-drain) but applies eviction
+        pressure so the *next* optional allocation sees the cost."""
+        if not self.enabled or nbytes <= 0:
+            return
+        if self.usage_bytes() + nbytes > self.budget_bytes:
+            self._evict_toward(self.budget_bytes - nbytes)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "usage_bytes": self.usage_bytes(),
+            "evictions_forced": self.evictions_forced,
+            "swap_refusals": self.swap_refusals,
+            "serve_refusals": self.serve_refusals,
+        }
+
+
+_governor: Optional[HostMemoryGovernor] = None
+_governor_lock = threading.Lock()
+
+
+def get_governor() -> HostMemoryGovernor:
+    """Process-wide governor, sized from ``LLMQ_HOST_MEM_GB`` on first
+    use (0/unset = disabled — all admissions pass)."""
+    global _governor
+    with _governor_lock:
+        if _governor is None:
+            from llmq_tpu.core.config import get_config
+
+            gb = get_config().host_mem_gb or 0.0
+            _governor = HostMemoryGovernor(int(gb * (1 << 30)))
+        return _governor
+
+
+def set_governor(governor: Optional[HostMemoryGovernor]) -> None:
+    """Swap the process governor (tests / probes re-size budgets)."""
+    global _governor
+    with _governor_lock:
+        _governor = governor

@@ -40,8 +40,11 @@ from llmq_tpu.broker.base import DeliveredMessage
 from llmq_tpu.broker.manager import (
     FAILED_SUFFIX,
     HEALTH_SUFFIX,
+    HEARTBEAT_INTERVAL_S,
+    QUARANTINE_SUFFIX,
     BrokerManager,
     affinity_queue_name,
+    kv_fetch_queue_name,
 )
 from llmq_tpu.core.config import Config, get_config
 from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
@@ -64,7 +67,25 @@ from llmq_tpu.workers.resume import (
 )
 
 HEALTH_TTL_MS = 120_000
-HEARTBEAT_INTERVAL_S = 30.0
+
+# HEARTBEAT_INTERVAL_S now lives in broker.manager (the janitor and the
+# monitor share it); re-exported here for existing importers.
+__all__ = [
+    "BaseWorker",
+    "DeadlineExceeded",
+    "HEALTH_TTL_MS",
+    "HEARTBEAT_INTERVAL_S",
+]
+
+# Worker-local memory of why recent jobs failed (job_id -> reason), bounded:
+# feeds the x-failure-reason header when a job quarantines on this worker.
+_FAILURE_MEMORY_CAP = 1024
+
+
+class DeadlineExceeded(Exception):
+    """A job's deadline passed while it was in flight (engine sweep or a
+    pre-recovery check). The message loop dead-letters it as
+    ``deadline_exceeded`` instead of publishing a result or requeueing."""
 
 
 class BaseWorker(abc.ABC):
@@ -110,6 +131,14 @@ class BaseWorker(abc.ABC):
         # worker already published for. Redelivered or resumed jobs that
         # land on this worker twice publish once.
         self._dedup = ResultDeduper()
+        # Fleet self-healing state: per-job failure reasons (bounded FIFO
+        # alongside insertion order), consecutive engine failures for the
+        # circuit breaker, and robustness counters surfaced in heartbeats.
+        self._failure_reasons: dict = {}
+        self._consecutive_failures = 0
+        self.jobs_deadline_exceeded = 0
+        self.jobs_quarantined = 0
+        self.breaker_tripped = False
 
     # --- abstract surface (reference base.py:57-75) -----------------------
     @abc.abstractmethod
@@ -171,7 +200,7 @@ class BaseWorker(abc.ABC):
             if self.config.prefix_affinity:
                 self._affinity_consumer_tag = await self.broker.consume_jobs(
                     affinity_queue_name(self.queue, self.worker_id),
-                    self._process_message,
+                    self._process_affinity_message,
                     prefetch=self.concurrency,
                 )
             await self._start_extra_consumers()
@@ -228,6 +257,8 @@ class BaseWorker(abc.ABC):
             )
         except asyncio.TimeoutError:
             self.logger.warning("Timed out draining %d in-flight jobs", self._in_flight)
+        if self.config.prefix_affinity and self.broker.connected:
+            await self._retire_affinity_queue()
         await self._cleanup_processor()
         if self.broker.connected:
             await self.broker.disconnect()
@@ -244,11 +275,169 @@ class BaseWorker(abc.ABC):
         carrying — the plain drain (or redelivery) covers them."""
         return None
 
+    async def _retire_affinity_queue(self) -> None:
+        """Graceful-shutdown half of affinity-orphan reclaim: republish
+        anything still sitting on this worker's private queue to the
+        shared queue, then delete the queue (and the KV-ship RPC queue)
+        so nothing can strand on them after the worker is gone. The
+        janitor covers crashed workers; this covers the common case
+        without waiting out a heartbeat staleness window."""
+        aq = affinity_queue_name(self.queue, self.worker_id)
+        moved = 0
+        try:
+            while True:
+                msg = await self.broker.broker.get(aq)
+                if msg is None:
+                    break
+                await self.broker.broker.publish(
+                    self.queue,
+                    msg.body,
+                    message_id=msg.message_id,
+                    headers=msg.headers,
+                )
+                await msg.ack()
+                moved += 1
+            await self.broker.broker.delete_queue(aq)
+            await self.broker.broker.delete_queue(
+                kv_fetch_queue_name(self.queue, self.worker_id)
+            )
+        except Exception:  # noqa: BLE001 — the janitor reclaims what's left
+            self.logger.warning(
+                "Affinity queue retirement incomplete", exc_info=True
+            )
+        if moved:
+            self.logger.info(
+                "Returned %d unclaimed jobs from %s to the shared queue",
+                moved,
+                aq,
+            )
+
     async def _start_extra_consumers(self) -> None:
         """Hook: attach additional consumers after the main job consumer
         is live (the TPU worker serves prefix-page fetch requests here).
         Base workers have none."""
         return None
+
+    async def _process_affinity_message(self, message: DeliveredMessage) -> None:
+        """Jobs from this worker's private ``<q>.w.<id>`` queue, with a
+        claim-side orphan guard: a job routed here while the worker is
+        draining (the submitter's cached fleet view can lag the shutdown
+        by ~10 s) bounces straight back to the shared queue instead of
+        waiting for the janitor's reclaim pass."""
+        if not self.running:
+            try:
+                await self.broker.broker.publish(
+                    self.queue,
+                    message.body,
+                    message_id=message.message_id,
+                    headers=message.headers,
+                )
+                emit_trace_event(
+                    message.message_id or "unknown",
+                    "affinity_bounced",
+                    worker_id=self.worker_id,
+                )
+                await message.ack()
+            except Exception:  # noqa: BLE001 — transport down: redeliver
+                await message.reject(requeue=True)
+            return
+        await self._process_message(message)
+
+    def _remember_failure(self, job_id: str, reason: str) -> None:
+        self._failure_reasons[job_id] = reason
+        while len(self._failure_reasons) > _FAILURE_MEMORY_CAP:
+            self._failure_reasons.pop(next(iter(self._failure_reasons)))
+
+    def _deadline_expired(self, job: Job) -> bool:
+        return job.deadline_at is not None and time.time() > job.deadline_at
+
+    async def _dead_letter_deadline(
+        self, job: Job, message: DeliveredMessage, trace: dict
+    ) -> None:
+        """A job whose deadline passed is dead-lettered as
+        ``deadline_exceeded`` — explicitly filed on ``<q>.failed``, never
+        silently dropped, so the submitter can count and requeue it."""
+        self.jobs_deadline_exceeded += 1
+        trace_event(trace, "deadline_exceeded", worker_id=self.worker_id)
+        emit_trace_event(
+            job.id, "deadline_exceeded", worker_id=self.worker_id
+        )
+        headers = dict(message.headers or {})
+        headers["x-error"] = "deadline_exceeded"
+        headers["x-failure-reason"] = "deadline_exceeded"
+        headers["x-worker-id"] = self.worker_id
+        headers["x-delivery-count"] = message.delivery_count
+        headers.setdefault("x-death-queue", self.queue)
+        try:
+            await self.broker.broker.publish(
+                self.queue + FAILED_SUFFIX,
+                message.body,
+                message_id=message.message_id,
+                headers=headers,
+            )
+        except Exception:  # noqa: BLE001 — best-effort: never block the loop
+            self.logger.warning("Deadline dead-letter failed", exc_info=True)
+        finally:
+            await message.ack()
+
+    async def _quarantine(
+        self, job: Job, message: DeliveredMessage, trace: dict, *, reason: str
+    ) -> None:
+        """File a poison job on ``<q>.quarantine``: it has crashed workers
+        ``quarantine_attempts`` times fleet-wide (the broker's
+        delivery_count IS the fleet-wide attempt counter — it rides the
+        message, not any one worker). Quarantine keeps it out of the
+        redelivery loop without losing the payload or its history."""
+        self.jobs_quarantined += 1
+        trace_event(
+            trace,
+            "quarantined",
+            worker_id=self.worker_id,
+            reason=reason,
+            attempts=message.delivery_count + 1,
+        )
+        emit_trace_event(
+            job.id, "quarantined", worker_id=self.worker_id, reason=reason
+        )
+        headers = dict(message.headers or {})
+        headers["x-error"] = f"quarantined after repeated failures: {reason}"
+        headers["x-failure-reason"] = reason
+        headers["x-worker-id"] = self.worker_id
+        headers["x-delivery-count"] = message.delivery_count + 1
+        headers.setdefault("x-death-queue", self.queue)
+        try:
+            await self.broker.broker.publish(
+                self.queue + QUARANTINE_SUFFIX,
+                message.body,
+                message_id=message.message_id,
+                headers=headers,
+            )
+            await message.ack()
+        except Exception:  # noqa: BLE001 — transport down: keep at-least-once
+            await message.reject(requeue=True)
+
+    def _note_engine_failure(self, reason: str) -> None:
+        """Circuit breaker: M consecutive engine failures (not one bad
+        job — *every* recent job failing) means this worker is the
+        problem. Self-drain via the handoff path so its jobs move to
+        healthy peers instead of churning here."""
+        self._consecutive_failures += 1
+        m = self.config.breaker_failures
+        if m > 0 and self._consecutive_failures >= m and not self.breaker_tripped:
+            self.breaker_tripped = True
+            self.logger.error(
+                "Circuit breaker: %d consecutive engine failures "
+                "(last: %s); self-draining",
+                self._consecutive_failures,
+                reason,
+            )
+            emit_trace_event(
+                self.worker_id,
+                "breaker_tripped",
+                worker_id=self.worker_id,
+                failures=self._consecutive_failures,
+            )
+            self.request_shutdown()
 
     # --- the hot loop (reference base.py:137-245) -------------------------
     async def _process_message(self, message: DeliveredMessage) -> None:
@@ -280,6 +469,26 @@ class BaseWorker(abc.ABC):
         )
         emit_trace_event(job.id, "claimed", worker_id=self.worker_id)
         self._job_traces[job.id] = trace
+        # Claim-time self-healing guards (no-ops at default config):
+        if self._deadline_expired(job):
+            await self._dead_letter_deadline(job, message, trace)
+            self._job_traces.pop(job.id, None)
+            self._settle_in_flight()
+            return
+        n_quarantine = self.config.quarantine_attempts
+        if n_quarantine > 0 and message.delivery_count >= n_quarantine:
+            # Backstop for the reject-time check below: catches a copy
+            # whose Nth failure landed on a worker that died mid-settle
+            # (the redelivered message then carries delivery_count >= N).
+            await self._quarantine(
+                job,
+                message,
+                trace,
+                reason=self._failure_reasons.get(job.id, "repeated_failures"),
+            )
+            self._job_traces.pop(job.id, None)
+            self._settle_in_flight()
+            return
         try:
             output = await self._run_with_timeout(job)
             duration_ms = (time.monotonic() - start) * 1000
@@ -310,6 +519,7 @@ class BaseWorker(abc.ABC):
                 self._dedup.record(job.id, offset)
             await message.ack()
             self.jobs_processed += 1
+            self._consecutive_failures = 0
             self.total_duration_ms += duration_ms
             if self.jobs_processed % 100 == 0:
                 self.logger.info(
@@ -317,6 +527,11 @@ class BaseWorker(abc.ABC):
                     self.jobs_processed,
                     self.total_duration_ms / self.jobs_processed,
                 )
+        except DeadlineExceeded:
+            # The deadline passed mid-flight (engine sweep, or a guard in
+            # front of an expensive recovery path). Same terminal state as
+            # the claim-time check: one explicit dead-letter, no requeue.
+            await self._dead_letter_deadline(job, message, trace)
         except JobHandoff as exc:
             # Drain-with-handoff: the engine resolved this request with a
             # snapshot of its partial progress instead of a completion.
@@ -336,6 +551,10 @@ class BaseWorker(abc.ABC):
             )
             self.jobs_failed += 1
             self.jobs_timed_out += 1
+            self._remember_failure(job.id, "timeout")
+            self._note_engine_failure("timeout")
+            if await self._maybe_quarantine(job, message, trace, reason="timeout"):
+                return
             emit_trace_event(
                 job.id, "requeued", worker_id=self.worker_id, reason="timeout"
             )
@@ -366,6 +585,11 @@ class BaseWorker(abc.ABC):
                 extra={"job_id": job.id},
             )
             self.jobs_failed += 1
+            reason = f"engine_error:{type(exc).__name__}"
+            self._remember_failure(job.id, reason)
+            self._note_engine_failure(reason)
+            if await self._maybe_quarantine(job, message, trace, reason=reason):
+                return
             emit_trace_event(
                 job.id, "requeued", worker_id=self.worker_id, reason=str(exc)
             )
@@ -376,6 +600,19 @@ class BaseWorker(abc.ABC):
         finally:
             self._job_traces.pop(job.id, None)
             self._settle_in_flight()
+
+    async def _maybe_quarantine(
+        self, job: Job, message: DeliveredMessage, trace: dict, *, reason: str
+    ) -> bool:
+        """Reject-time quarantine check: this failure is attempt
+        ``delivery_count + 1``; at the Nth fleet-wide attempt the job
+        quarantines (with the in-hand failure reason) instead of
+        requeueing. Returns True when the message was settled here."""
+        n = self.config.quarantine_attempts
+        if n > 0 and message.delivery_count + 1 >= n:
+            await self._quarantine(job, message, trace, reason=reason)
+            return True
+        return False
 
     def _note_retry_exhausted(
         self, job: Job, delivery_count: int, trace: dict, *, reason: str
@@ -443,9 +680,15 @@ class BaseWorker(abc.ABC):
             job.id, "handoff", worker_id=self.worker_id, emitted=exc.emitted
         )
         try:
+            body = json.dumps(payload).encode("utf-8")
+            # Resume blobs share the host-memory budget (accounted, never
+            # refused: refusing one would strand a request mid-drain).
+            from llmq_tpu.utils.host_mem import get_governor
+
+            get_governor().note_resume_blob(len(body))
             await self.broker.broker.publish(
                 self.queue,
-                json.dumps(payload).encode("utf-8"),
+                body,
                 message_id=job.id,
             )
         except Exception:  # noqa: BLE001 — transport down mid-shutdown
@@ -571,7 +814,7 @@ class BaseWorker(abc.ABC):
                 else None
             ),
             queue=self.queue,
-            engine_stats=self._engine_stats(),
+            engine_stats=self._stats_with_robustness(),
             reconnects=stats.reconnects if stats is not None else None,
             metrics=get_registry().summary() or None,
             prefix_chains=self._prefix_chains(),
@@ -587,6 +830,19 @@ class BaseWorker(abc.ABC):
     def _engine_stats(self) -> Optional[dict]:
         """Subclasses may surface engine metrics (batch occupancy etc.)."""
         return None
+
+    def _stats_with_robustness(self) -> Optional[dict]:
+        """Engine stats plus fleet self-healing counters (superset-only:
+        nothing is added until a counter moves, so pre-existing heartbeat
+        consumers see unchanged payloads at default config)."""
+        stats = dict(self._engine_stats() or {})
+        for name in ("jobs_deadline_exceeded", "jobs_quarantined"):
+            value = getattr(self, name, 0)
+            if value:
+                stats[name] = value
+        if self.breaker_tripped:
+            stats["breaker_tripped"] = True
+        return stats or None
 
     def _prefix_chains(self) -> Optional[list]:
         """Subclasses may advertise hot prefix-chain digests (hex) for

@@ -17,9 +17,11 @@ this worker builds the native engine on the local TPU slice:
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import socket
+import time
 from collections import OrderedDict
 from pathlib import Path
 from typing import List, Optional
@@ -30,9 +32,10 @@ from llmq_tpu.broker.manager import (
     rendezvous_pick,
 )
 from llmq_tpu.core.models import Job
-from llmq_tpu.obs import trace_event, trace_event_at
+from llmq_tpu.obs import emit_trace_event, trace_event, trace_event_at
 from llmq_tpu.utils.hashing import text_prefix_chain, token_prefix_chain
-from llmq_tpu.workers.base import BaseWorker
+from llmq_tpu.utils.host_mem import get_governor
+from llmq_tpu.workers.base import BaseWorker, DeadlineExceeded
 from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff
 
 PRESET_SCHEMES = ("preset://", "dummy://", "random://")
@@ -44,6 +47,18 @@ PRESET_SCHEMES = ("preset://", "dummy://", "random://")
 CHAIN_TRACK_CAP = 512
 CHAIN_ADVERTISE_N = 8
 PREFIX_FETCH_TIMEOUT_S = 2.0
+
+# A peer that timed out a fetch is skipped for this long (negative cache):
+# its queue may be an orphan the janitor hasn't reclaimed yet, and every
+# fetch against it stalls a job by the full fetch timeout.
+PEER_NEGATIVE_CACHE_S = 30.0
+
+
+def _chunk_digest(chunk: str) -> str:
+    """Transport-level digest of one serialized prefix chunk. The chunk
+    codec self-verifies its *payload* on ingest; this outer digest lets
+    the requester reject a corrupted ship before paying deserialization."""
+    return hashlib.blake2b(chunk.encode("utf-8"), digest_size=16).hexdigest()
 
 
 class TPUWorker(BaseWorker):
@@ -99,6 +114,14 @@ class TPUWorker(BaseWorker):
         self.prefix_chunks_served = 0
         self.prefix_chunks_fetched = 0
         self.prefix_fetch_timeouts = 0
+        # KV-ship hardening state: per-requester in-flight serve counts
+        # (capped by Config.peer_serve_concurrency), a short negative
+        # cache of peers that timed out (peer -> monotonic expiry), and
+        # failure-class counters surfaced via heartbeats.
+        self._peer_serving: dict = {}
+        self._dead_peers: dict = {}
+        self.kv_fetch_failures = 0
+        self.kv_serve_busy_rejects = 0
         super().__init__(queue, **kwargs)
         # Prefetch must exceed the continuous batch's slot count or the
         # engine starves: with slots=192 and the default prefetch=100,
@@ -442,14 +465,41 @@ class TPUWorker(BaseWorker):
         )
 
     async def _serve_kv_fetch(self, message) -> None:
-        """One fetch request: ``{"want": [hex], "reply_to": q, "req": id}``
-        → export whatever of the want-list is resident (host tier or
-        device cache) and publish the chunks back. Always acks: a failed
-        export just means the requester recomputes."""
+        """One fetch request: ``{"want": [hex], "reply_to": q, "req": id,
+        "from": worker_id}`` → export whatever of the want-list is resident
+        (host tier or device cache) and publish the chunks back, each with
+        an outer blake2b digest the requester verifies before ingest.
+
+        Serving is bounded: more than ``Config.peer_serve_concurrency``
+        in-flight exports for one requester — or a host-memory governor
+        past its serve watermark — replies ``{"busy": true}`` immediately
+        so the requester recomputes instead of waiting out its timeout.
+        Always acks: a failed export just means the requester recomputes."""
+        peer_key = None
         try:
             req = json.loads(message.body)
             want = [str(d) for d in (req.get("want") or [])][:64]
             reply_to = req.get("reply_to")
+            req_id = req.get("req")
+            peer_key = str(req.get("from") or reply_to or "?")
+            cap = self.config.peer_serve_concurrency
+            busy = (
+                cap > 0 and self._peer_serving.get(peer_key, 0) >= cap
+            ) or not get_governor().admit_serve()
+            if busy:
+                self.kv_serve_busy_rejects += 1
+                peer_key = None  # nothing in flight to decrement
+                if reply_to:
+                    await self.broker.broker.publish(
+                        reply_to,
+                        json.dumps({"req": req_id, "busy": True}).encode(
+                            "utf-8"
+                        ),
+                    )
+                return
+            self._peer_serving[peer_key] = (
+                self._peer_serving.get(peer_key, 0) + 1
+            )
             chunks: List[str] = []
             if want and self.engine is not None:
                 loop = asyncio.get_running_loop()
@@ -460,13 +510,23 @@ class TPUWorker(BaseWorker):
                 await self.broker.broker.publish(
                     reply_to,
                     json.dumps(
-                        {"req": req.get("req"), "chunks": chunks}
+                        {
+                            "req": req_id,
+                            "chunks": chunks,
+                            "digests": [_chunk_digest(c) for c in chunks],
+                        }
                     ).encode("utf-8"),
                 )
             self.prefix_chunks_served += len(chunks)
         except Exception:  # noqa: BLE001 — serving is best-effort
             self.logger.debug("KV fetch request failed", exc_info=True)
         finally:
+            if peer_key is not None:
+                left = self._peer_serving.get(peer_key, 1) - 1
+                if left > 0:
+                    self._peer_serving[peer_key] = left
+                else:
+                    self._peer_serving.pop(peer_key, None)
             try:
                 await message.ack()
             except Exception:  # noqa: BLE001 — already settled / transport gone
@@ -489,9 +549,12 @@ class TPUWorker(BaseWorker):
             return
         mapping = await self.broker.affinity_targets(self.queue)
         peer = None
+        now = time.monotonic()
         for digest in reversed(tchain):
             candidates = [
-                w for w in mapping.get(digest, []) if w != self.worker_id
+                w
+                for w in mapping.get(digest, [])
+                if w != self.worker_id and not self._peer_dead(w, now)
             ]
             if candidates:
                 peer = rendezvous_pick(digest, candidates)
@@ -516,6 +579,36 @@ class TPUWorker(BaseWorker):
         async with self._fetch_lock:
             await self._fetch_from_peer(peer, want, job.id)
 
+    def _peer_dead(self, peer: str, now: float) -> bool:
+        """Negative-cache check: a peer that timed out a fetch within the
+        last ``PEER_NEGATIVE_CACHE_S`` is skipped (expired entries drop)."""
+        expiry = self._dead_peers.get(peer)
+        if expiry is None:
+            return False
+        if now >= expiry:
+            self._dead_peers.pop(peer, None)
+            return False
+        return True
+
+    def _note_kv_fetch_failed(
+        self, req_id: str, peer: str, reason: str
+    ) -> None:
+        """Classify a failed cross-worker page fetch on the job's trace
+        (reason ∈ timeout / busy / digest-mismatch) — the fetch itself is
+        best-effort, but *why* it failed is what distinguishes a dead peer
+        from an overloaded one from a corrupt ship in `monitor top`."""
+        self.kv_fetch_failures += 1
+        trace = self._job_traces.get(req_id)
+        if trace is not None:
+            trace_event(trace, "kv_fetch_failed", peer=peer, reason=reason)
+        emit_trace_event(
+            req_id,
+            "kv_fetch_failed",
+            worker_id=self.worker_id,
+            peer=peer,
+            reason=reason,
+        )
+
     async def _fetch_from_peer(
         self, peer: str, want: List[str], req_id: str
     ) -> None:
@@ -531,7 +624,12 @@ class TPUWorker(BaseWorker):
             await self.broker.broker.publish(
                 kv_fetch_queue_name(self.queue, peer),
                 json.dumps(
-                    {"want": want[:64], "reply_to": reply_q, "req": req_id}
+                    {
+                        "want": want[:64],
+                        "reply_to": reply_q,
+                        "req": req_id,
+                        "from": self.worker_id,
+                    }
                 ).encode("utf-8"),
             )
         except Exception:  # noqa: BLE001 — peer queue gone: recompute
@@ -553,7 +651,25 @@ class TPUWorker(BaseWorker):
             await msg.ack()
             if not isinstance(payload, dict) or payload.get("req") != req_id:
                 continue  # stale reply from an earlier timed-out fetch
+            if payload.get("busy"):
+                # The peer is saturated (serve cap or host-memory
+                # governor): recompute now, don't wait out the timeout.
+                # No negative cache — busy is load, not death.
+                self._note_kv_fetch_failed(req_id, peer, "busy")
+                return
             chunks = payload.get("chunks") or []
+            digests = payload.get("digests")
+            if chunks and isinstance(digests, list):
+                # Outer transport digests (older peers omit them — the
+                # chunk codec's own payload check still applies there).
+                if len(digests) != len(chunks) or any(
+                    _chunk_digest(c) != d for c, d in zip(chunks, digests)
+                ):
+                    self.logger.warning(
+                        "Peer %s shipped chunks failing digest check", peer
+                    )
+                    self._note_kv_fetch_failed(req_id, peer, "digest-mismatch")
+                    return
             if chunks:
                 try:
                     n = await loop.run_in_executor(
@@ -565,14 +681,18 @@ class TPUWorker(BaseWorker):
                         "Fetched %d prefix page(s) from %s", n, peer
                     )
                 except SnapshotError as exc:
-                    # Incompatible fleet member — loud, then recompute.
+                    # Payload-level integrity/compat failure — same class
+                    # as a transport digest mismatch for the fleet view.
                     self.logger.warning(
                         "Peer %s shipped incompatible prefix chunks: %s",
                         peer,
                         exc,
                     )
+                    self._note_kv_fetch_failed(req_id, peer, "digest-mismatch")
             return
         self.prefix_fetch_timeouts += 1
+        self._dead_peers[peer] = time.monotonic() + PEER_NEGATIVE_CACHE_S
+        self._note_kv_fetch_failed(req_id, peer, "timeout")
 
     # --- per-job processing (reference vllm_worker.py:136-195) ------------
     def _sampling_for(self, job: Job):
@@ -627,12 +747,28 @@ class TPUWorker(BaseWorker):
 
         params = self._sampling_for(job)
         out = None
+        # Engine passthrough: a stamped deadline rides into generate()/
+        # resume() so the scheduler sweep can expire the request between
+        # decode steps. Sent only when set — defaults change nothing.
+        gen_kw = (
+            {} if job.deadline_at is None else {"deadline_at": job.deadline_at}
+        )
+        if job.deadline_at is not None and time.time() > job.deadline_at:
+            # Claim-time check passed but the deadline has since lapsed
+            # (e.g. slots were busy): fail before any engine work.
+            raise DeadlineExceeded(job.id)
         snapshot = self._resume_snapshot(job)
         if self._prefix_enabled():
             text = job_affinity_text(job)
             if text:
                 self._note_prefix_chain(text)
-                if snapshot is None:
+                if snapshot is None and (
+                    job.deadline_at is None
+                    or time.time() + PREFIX_FETCH_TIMEOUT_S < job.deadline_at
+                ):
+                    # The fetch may stall up to its full timeout: a job
+                    # whose remaining budget can't cover that goes
+                    # straight to a local prefill.
                     await self._maybe_fetch_prefix(job, text)
         if snapshot is not None:
             trace = self._job_traces.get(job.id)
@@ -641,7 +777,9 @@ class TPUWorker(BaseWorker):
                     trace, "resumed", offset=len(snapshot.output_ids)
                 )
             try:
-                out = await self.engine.resume(rid=job.id, snapshot=snapshot)
+                out = await self.engine.resume(
+                    rid=job.id, snapshot=snapshot, **gen_kw
+                )
             except SnapshotError as exc:
                 # Valid blob, wrong engine (model signature / KV dtype
                 # mismatch) — recompute from the prompt instead.
@@ -655,19 +793,26 @@ class TPUWorker(BaseWorker):
         if out is None:
             if job.messages is not None:
                 out = await self.engine.generate(
-                    rid=job.id, messages=job.messages, params=params
+                    rid=job.id, messages=job.messages, params=params, **gen_kw
                 )
             elif job.chat_mode:
                 messages = [
                     {"role": "user", "content": job.get_formatted_prompt()}
                 ]
                 out = await self.engine.generate(
-                    rid=job.id, messages=messages, params=params
+                    rid=job.id, messages=messages, params=params, **gen_kw
                 )
             else:
                 out = await self.engine.generate(
-                    rid=job.id, prompt=job.get_formatted_prompt(), params=params
+                    rid=job.id,
+                    prompt=job.get_formatted_prompt(),
+                    params=params,
+                    **gen_kw,
                 )
+        if getattr(out, "finish_reason", None) == "deadline_exceeded":
+            # The engine's sweep expired the request between decode
+            # blocks: terminal dead-letter, not a (truncated) result.
+            raise DeadlineExceeded(job.id)
         if isinstance(out, HandoffOutput):
             # This worker is draining: surface the partial progress to the
             # base loop, which republishes the job as resumable.
@@ -731,4 +876,9 @@ class TPUWorker(BaseWorker):
                 "prefix_chunks_fetched": self.prefix_chunks_fetched,
                 "prefix_fetch_timeouts": self.prefix_fetch_timeouts,
             }
+            # Superset-only: the hardening counters appear once they move.
+            if self.kv_fetch_failures:
+                stats["kv_fetch_failures"] = self.kv_fetch_failures
+            if self.kv_serve_busy_rejects:
+                stats["kv_serve_busy_rejects"] = self.kv_serve_busy_rejects
         return stats

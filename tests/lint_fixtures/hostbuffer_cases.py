@@ -1,0 +1,134 @@
+"""unbounded-host-buffer: instance containers that only ever grow."""
+
+import collections
+from collections import OrderedDict, defaultdict, deque
+
+
+class BadResultCache:
+    def __init__(self):
+        self.results = {}  # EXPECT[unbounded-host-buffer]
+
+    def record(self, job_id, payload):
+        self.results[job_id] = payload
+
+
+class BadTraceLog:
+    def __init__(self):
+        self.events = []  # EXPECT[unbounded-host-buffer]
+
+    def trace(self, event):
+        self.events.append(event)
+
+
+class BadCtorForms:
+    def __init__(self):
+        self.by_worker = OrderedDict()  # EXPECT[unbounded-host-buffer]
+        self.by_peer = defaultdict(list)  # EXPECT[unbounded-host-buffer]
+        self.backlog = deque()  # EXPECT[unbounded-host-buffer]
+
+    def note(self, wid, peer, item):
+        self.by_worker[wid] = item
+        self.by_peer[peer].append(item)
+        self.by_peer[peer] = item
+        self.backlog.append(item)
+
+
+class BadAugAssign:
+    def __init__(self):
+        self.lines = []  # EXPECT[unbounded-host-buffer]
+
+    def log(self, line):
+        self.lines += [line]
+
+
+class GoodPoppedInFlight:
+    # The release path pops the entry — bounded by concurrency.
+    def __init__(self):
+        self.inflight = {}
+
+    def start(self, job_id, ctx):
+        self.inflight[job_id] = ctx
+
+    def finish(self, job_id):
+        return self.inflight.pop(job_id, None)
+
+
+class GoodCappedRing:
+    # Explicit cap: the while-loop evicts oldest entries past 128.
+    def __init__(self):
+        self.recent = []
+
+    def push(self, item):
+        self.recent.append(item)
+        while len(self.recent) > 128:
+            self.recent.pop(0)
+
+
+class GoodLenGuard:
+    # Admission check against a cap before every insert.
+    def __init__(self):
+        self.seen = {}
+
+    def note(self, key):
+        if len(self.seen) < 1024:
+            self.seen[key] = True
+
+
+class GoodFlushReset:
+    # Batch buffer reset wholesale on every flush.
+    def __init__(self):
+        self.batch = []
+
+    def add(self, item):
+        self.batch.append(item)
+
+    def flush(self):
+        out, self.batch = self.batch, []
+        return out
+
+
+class GoodDelEviction:
+    def __init__(self):
+        self.table = {}
+
+    def put(self, key, value):
+        self.table[key] = value
+
+    def expire(self, key):
+        del self.table[key]
+
+
+class GoodReadOnly:
+    # Never written after __init__ — not a growth candidate.
+    def __init__(self):
+        self.constants = {}
+
+    def get(self, key):
+        return self.constants.get(key)
+
+
+class GoodBoundedDeque:
+    # maxlen makes the deque self-evicting.
+    def __init__(self):
+        self.window = collections.deque(maxlen=64)
+
+    def push(self, item):
+        self.window.append(item)
+
+
+class GoodSeededTable:
+    # Seeded dict() call: a fixed lookup table, not an accumulator.
+    def __init__(self):
+        self.names = dict(a=1)
+
+    def rename(self, key, value):
+        self.names[key] = value
+
+
+class SuppressedAudit:
+    def __init__(self):
+        # Bounded by the run's job count, which the caller caps.
+        self.audit = []  # llmq: ignore[unbounded-host-buffer]
+
+    def log(self, entry):
+        self.audit.append(entry)

@@ -10,13 +10,21 @@ the same queues without experiencing the injected faults themselves.
 
 import asyncio
 import json
+import time
 
 import pytest
 
 from llmq_tpu.broker.chaos import ChaosBroker, WorkerKillSwitch
-from llmq_tpu.broker.manager import BrokerManager
+from llmq_tpu.broker.manager import (
+    HEALTH_SUFFIX,
+    BrokerManager,
+    affinity_queue_name,
+    kv_fetch_queue_name,
+)
 from llmq_tpu.core.config import Config
-from llmq_tpu.core.models import Job
+from llmq_tpu.core.models import Job, WorkerHealth, utcnow
+from llmq_tpu.utils.hashing import text_prefix_chain
+from llmq_tpu.utils.host_mem import HostMemoryGovernor, set_governor
 from llmq_tpu.workers.dummy import DummyWorker
 from llmq_tpu.workers.tpu_worker import TPUWorker
 
@@ -453,3 +461,322 @@ class TestChaosTrace:
         assert claimed["delivery_count"] == 1
         walls = [e["t_wall"] for e in trace["events"]]
         assert walls == sorted(walls)
+
+
+# ≥256 chars so text_prefix_chain yields a digest — jobs sharing it look
+# affinity-routable to an advertising (ghost) peer.
+_SELFHEAL_TEMPLATE = ("SYSTEM: you are a helpful assistant. " * 8)[:280]
+
+
+class TestFleetSelfHealing:
+    """PR-10 fleet invariant: every submitted job terminates as exactly
+    one of {one result, one ``deadline_exceeded`` dead-letter, one
+    quarantine entry} — zero stranded messages, zero duplicates — under
+    orphaned affinity queues, KV-RPC partitions, host-memory pressure,
+    and deterministically poisonous jobs."""
+
+    async def test_orphaned_affinity_queue_reclaimed_exactly_once(
+        self, mem_ns
+    ):
+        """Jobs stranded on a dead worker's private ``<q>.w.<id>`` queue
+        are republished to the shared queue by the janitor pass (exactly
+        once each), the orphan queue stops existing, and a live worker's
+        private queue is left alone."""
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("oq")
+            dead_q = affinity_queue_name("oq", "deadw")
+            live_q = affinity_queue_name("oq", "livew")
+            await mgr.broker.declare_queue(dead_q)
+            await mgr.broker.declare_queue(live_q)
+            jobs = [Job(id=f"o{i}", prompt=f"stranded {i}") for i in range(4)]
+            for j in jobs:
+                await mgr.publish_job(dead_q, j)
+            await mgr.broker.publish(live_q, b"{}", message_id="keep")
+            # The janitor keys staleness off remembered heartbeat times —
+            # a worker silent past STALE_AFTER_S is gone; a fresh one
+            # must keep its queue even with stranded-looking messages.
+            mgr._worker_seen["oq"] = {
+                "deadw": time.time() - 1000.0,
+                "livew": time.time(),
+            }
+
+            reclaimed = await mgr.reclaim_orphaned_affinity_queues("oq")
+            assert reclaimed == len(jobs)
+            assert mgr.affinity_reclaimed == len(jobs)
+            # The orphan queue (and its kv RPC twin) no longer exists;
+            # the live worker's queue still holds its message.
+            assert await mgr.broker.get(dead_q) is None
+            assert "deadw" not in mgr._worker_seen["oq"]
+            keep = await mgr.broker.get(live_q)
+            assert keep is not None and keep.message_id == "keep"
+            await keep.reject(requeue=True)
+
+            # A second pass is a no-op: nothing double-republishes.
+            assert await mgr.reclaim_orphaned_affinity_queues("oq") == 0
+
+            worker = DummyWorker("oq", delay=0, config=cfg, concurrency=8)
+            task = asyncio.ensure_future(worker.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "oq.results", {j.id for j in jobs}, timeout=60.0
+                )
+            finally:
+                worker.request_shutdown()
+                await asyncio.wait_for(task, timeout=30.0)
+            ids = [p["id"] for p in payloads]
+            assert sorted(ids) == sorted({j.id for j in jobs}), (
+                f"reclaim broke exactly-once: {ids}"
+            )
+            assert (await mgr.broker.stats("oq")).message_count == 0
+
+    async def test_kv_partition_recomputes_token_identically(
+        self, mem_ns, monkeypatch
+    ):
+        """An advertised peer that never answers its ``<q>.kv.<id>`` RPC
+        (network partition / silent death) costs one fetch timeout, a
+        ``kv_fetch_failed`` trace event, and a negative-cache entry — the
+        jobs themselves recompute locally with token-identical results."""
+        monkeypatch.setenv("LLMQ_PREFIX_HOST_GB", "0.05")
+        import llmq_tpu.workers.tpu_worker as tw
+
+        monkeypatch.setattr(tw, "PREFIX_FETCH_TIMEOUT_S", 0.3)
+        from llmq_tpu.obs import trace_from_payload
+
+        engine_kw = dict(
+            max_model_len=512,
+            num_pages=80,
+            page_size=8,
+            max_num_seqs=4,
+            prefill_chunk_size=8,
+            enable_prefix_caching=True,
+        )
+        jobs = [
+            Job(
+                id=f"pf{i}",
+                prompt=_SELFHEAL_TEMPLATE + f" item {i}",
+                temperature=0.0,
+                max_tokens=8,
+                ignore_eos=True,
+            )
+            for i in range(3)
+        ]
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, engine_kw)
+
+        plain_cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(plain_cfg) as mgr:
+            await mgr.setup_queue_infrastructure("pfq")
+            # A ghost peer advertises the jobs' prefix chain but nothing
+            # serves its kv queue — requests land there and rot.
+            await mgr.broker.declare_queue(
+                "pfq" + HEALTH_SUFFIX,
+                ttl_ms=120_000,
+                max_redeliveries=1_000_000_000,
+            )
+            await mgr.broker.declare_queue(
+                kv_fetch_queue_name("pfq", "ghost"), ttl_ms=30_000
+            )
+            ghost = WorkerHealth(
+                worker_id="ghost",
+                status="running",
+                last_seen=utcnow(),
+                jobs_processed=1,
+                prefix_chains=text_prefix_chain(_SELFHEAL_TEMPLATE + "x"),
+            )
+            await mgr.broker.publish(
+                "pfq" + HEALTH_SUFFIX, ghost.model_dump_json().encode("utf-8")
+            )
+            for j in jobs:
+                await mgr.publish_job("pfq", j)
+
+            worker_cfg = Config(
+                broker_url=f"memory://{mem_ns}",
+                max_redeliveries=1000,
+                prefix_affinity=True,
+            )
+            worker = TPUWorker(
+                "pfq",
+                config=worker_cfg,
+                concurrency=4,
+                model="preset://tiny",
+                tensor_parallel=1,
+                dtype="float32",
+                **engine_kw,
+            )
+            task = asyncio.ensure_future(worker.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "pfq.results", want_ids
+                )
+            finally:
+                worker.request_shutdown()
+                await asyncio.wait_for(task, timeout=60.0)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(want_ids), f"duplicates/losses: {ids}"
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged while recomputing around the "
+                "partitioned peer"
+            )
+        assert worker.kv_fetch_failures >= 1
+        assert worker.prefix_fetch_timeouts >= 1
+        assert "ghost" in worker._dead_peers, "peer not negative-cached"
+        fetch_events = [
+            e
+            for p in payloads
+            if (trace := trace_from_payload(p)) is not None
+            for e in trace["events"]
+            if e["name"] == "kv_fetch_failed"
+        ]
+        assert fetch_events, "no kv_fetch_failed event reached a trace"
+        assert all(e["peer"] == "ghost" for e in fetch_events)
+        assert all(e["reason"] == "timeout" for e in fetch_events)
+
+    async def test_host_memory_pressure_degrades_in_ladder_order(
+        self, mem_ns, monkeypatch
+    ):
+        """Under a tiny host-memory budget the governor evicts the cold
+        tier FIRST, then refuses swap-preempt captures (engine falls back
+        to recompute-preemption), and never touches the serve rung — and
+        every job still completes token-identically, exactly once."""
+        monkeypatch.setenv("LLMQ_PREEMPT_MODE", "swap")
+        engine_kw = dict(
+            num_pages=11,
+            max_num_seqs=3,
+            max_model_len=96,
+            page_size=8,
+        )
+        jobs = [
+            Job(
+                id=f"hm{i}",
+                prompt="hello request %d " % i + "ab" * (4 * i),
+                temperature=0.0,
+                max_tokens=30,
+                ignore_eos=True,
+            )
+            for i in range(3)
+        ]
+        want_ids = {j.id for j in jobs}
+        # Baseline runs before the governor exists: swap captures admit,
+        # and swap-vs-recompute parity is already pinned by
+        # test_snapshot.TestSwapPreemption.
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, engine_kw)
+
+        # Budget far below one KV page: any swap capture must first
+        # squeeze the (fake) cold tier dry, then be refused.
+        gov = HostMemoryGovernor(4096)
+        cold = {"bytes": 2048}
+
+        def _evict_cold(_nbytes: int) -> int:
+            freed = cold["bytes"]
+            cold["bytes"] = 0
+            return freed
+
+        gov.register("cold-tier", lambda: cold["bytes"], evict_fn=_evict_cold)
+        set_governor(gov)
+        try:
+            cfg = Config(
+                broker_url=f"memory://{mem_ns}", max_redeliveries=1000
+            )
+            async with BrokerManager(cfg) as mgr:
+                await mgr.setup_queue_infrastructure("hmq")
+                for j in jobs:
+                    await mgr.publish_job("hmq", j)
+                worker = _tpu_worker(mem_ns, "hmq", **engine_kw)
+                task = asyncio.ensure_future(worker.run())
+                try:
+                    payloads = await _collect_all_payloads(
+                        mgr, "hmq.results", want_ids
+                    )
+                finally:
+                    worker.request_shutdown()
+                    await asyncio.wait_for(task, timeout=60.0)
+        finally:
+            set_governor(None)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(want_ids), f"duplicates/losses: {ids}"
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged under recompute fallback"
+            )
+        # Ladder order: rung 1 (evict) engaged and drained the cold tier,
+        # rung 2 (refuse swap) engaged after it, rung 3 (refuse serves)
+        # never needed — pressure stopped at swap refusal.
+        assert gov.evictions_forced >= 1, "cold tier never squeezed"
+        assert cold["bytes"] == 0
+        assert gov.swap_refusals >= 1, (
+            "no swap capture was ever refused — pool not tight enough?"
+        )
+        assert gov.serve_refusals == 0
+
+    async def test_poison_job_quarantined_after_n_attempts(self, mem_ns):
+        """A job that deterministically crashes its worker lands on
+        ``<q>.quarantine`` after exactly ``quarantine_attempts``
+        fleet-wide attempts — one entry, correct failure headers, no
+        result, no DLQ copy — while healthy jobs complete untouched."""
+        cfg = Config(
+            broker_url=f"memory://{mem_ns}",
+            max_redeliveries=1000,
+            quarantine_attempts=3,
+        )
+
+        class PoisonWorker(DummyWorker):
+            async def _process_job(self, job):
+                if job.id == "poison":
+                    raise RuntimeError("deterministic poison")
+                return await super()._process_job(job)
+
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("pzq")
+            good = [Job(id=f"g{i}", prompt=f"fine {i}") for i in range(5)]
+            for j in good:
+                await mgr.publish_job("pzq", j)
+            await mgr.publish_job("pzq", Job(id="poison", prompt="boom"))
+
+            worker = PoisonWorker("pzq", delay=0, config=cfg, concurrency=4)
+            task = asyncio.ensure_future(worker.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "pzq.results", {j.id for j in good}, timeout=60.0
+                )
+                q_msgs = []
+                deadline = asyncio.get_running_loop().time() + 60.0
+                while not q_msgs:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "poison job never quarantined"
+                    )
+                    msg = await mgr.broker.get("pzq.quarantine")
+                    if msg is None:
+                        await asyncio.sleep(0.05)
+                        continue
+                    q_msgs.append(msg)
+                # Grace drain: a second entry would mean the quarantine
+                # raced the redelivery loop and filed twice.
+                await asyncio.sleep(0.5)
+                while (msg := await mgr.broker.get("pzq.quarantine")) is not None:
+                    q_msgs.append(msg)
+            finally:
+                worker.request_shutdown()
+                await asyncio.wait_for(task, timeout=30.0)
+
+            assert len(q_msgs) == 1, "poison job quarantined more than once"
+            entry = q_msgs[0]
+            assert entry.message_id == "poison"
+            assert json.loads(entry.body)["id"] == "poison"
+            assert entry.headers["x-failure-reason"] == (
+                "engine_error:RuntimeError"
+            )
+            assert int(entry.headers["x-delivery-count"]) == 3
+            await entry.ack()
+
+            ids = [p["id"] for p in payloads]
+            assert sorted(ids) == sorted(j.id for j in good)
+            assert "poison" not in ids
+            assert worker.jobs_quarantined == 1
+            # Terminal exactly-once: nothing stranded, nothing in the DLQ
+            # (quarantine replaced dead-lettering for this job).
+            assert (await mgr.broker.stats("pzq")).message_count == 0
+            assert (await mgr.broker.stats("pzq.failed")).message_count == 0

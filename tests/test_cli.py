@@ -332,3 +332,90 @@ async def test_monitor_top_once_renders_fleet(mem_url, monkeypatch, capsys):
     assert "40/90" in out
     assert "3/7" in out
     assert "fleet" in out and "fresh worker(s)" in out
+    # Superset-only: a clean fleet shows no self-healing surfaces at all.
+    assert "self-heal" not in out
+    assert "quarantined" not in out
+
+
+async def test_monitor_top_degraded_fleet_shows_selfheal(
+    mem_url, monkeypatch, capsys
+):
+    """When a worker reports robustness counters (deadline kills, a
+    tripped breaker) and jobs sit in quarantine, `monitor top` surfaces
+    both — the self-heal column and the quarantine depth in the header."""
+    from rich.console import Console
+
+    import llmq_tpu.cli.monitor as monitor_mod
+    from llmq_tpu.broker.manager import QUARANTINE_SUFFIX, BrokerManager
+    from llmq_tpu.cli.monitor import monitor_top
+    from llmq_tpu.core.config import Config
+    from llmq_tpu.core.models import WorkerHealth, utcnow
+    from llmq_tpu.workers.base import HEALTH_SUFFIX
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    # Wide console: the degraded frame adds a column and a header chunk;
+    # the default 80-col test console would ellipsize the cells under test.
+    monkeypatch.setattr(monitor_mod, "console", Console(width=200))
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("dq")
+        await mgr.broker.declare_queue(
+            "dq" + HEALTH_SUFFIX, max_redeliveries=10**9
+        )
+        health = WorkerHealth(
+            worker_id="w-sick",
+            status="running",
+            last_seen=utcnow(),
+            jobs_processed=4,
+            queue="dq",
+            engine_stats={
+                "tokens_per_sec": 10.0,
+                "jobs_deadline_exceeded": 2,
+                "jobs_quarantined": 1,
+                "breaker_tripped": True,
+            },
+        )
+        await mgr.broker.publish(
+            "dq" + HEALTH_SUFFIX, health.model_dump_json().encode("utf-8")
+        )
+        await mgr.broker.declare_queue(
+            "dq" + QUARANTINE_SUFFIX, max_redeliveries=10**9
+        )
+        await mgr.broker.publish(
+            "dq" + QUARANTINE_SUFFIX, b'{"id": "poison"}', message_id="poison"
+        )
+        await monitor_top("dq", iterations=1)
+    out = capsys.readouterr().out
+    assert "quarantined 1" in out
+    assert "self-h" in out  # column header (may wrap on narrow consoles)
+    assert "ddl:2" in out
+    assert "quar:1" in out
+    assert "BRK" in out
+
+
+async def test_errors_view_shows_failure_reason(mem_url, monkeypatch, capsys):
+    """`errors` renders the machine-readable failure class next to the
+    human error message — deadline sheds and poison kills are visible
+    without grepping worker logs."""
+    from llmq_tpu.broker.manager import FAILED_SUFFIX, BrokerManager
+    from llmq_tpu.cli.monitor import show_errors
+    from llmq_tpu.core.config import Config
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    cfg = Config(broker_url=mem_url)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("eq")
+        await mgr.broker.publish(
+            "eq" + FAILED_SUFFIX,
+            b'{"id": "late-1", "prompt": "x"}',
+            message_id="late-1",
+            headers={
+                "x-error": "deadline expired before claim",
+                "x-failure-reason": "deadline_exceeded",
+                "x-delivery-count": "1",
+            },
+        )
+        await show_errors("eq")
+    out = capsys.readouterr().out
+    assert "late-1" in out
+    assert "deadline_exceeded" in out

@@ -1,0 +1,119 @@
+"""HostMemoryGovernor: shared budget, degradation-ladder order."""
+
+import pytest
+
+from llmq_tpu.utils.host_mem import (
+    SERVE_REFUSE_FRAC,
+    SWAP_REFUSE_FRAC,
+    HostMemoryGovernor,
+    get_governor,
+    set_governor,
+)
+
+
+class FakeStore:
+    """A registered consumer with evictable bytes (prefix-store shaped)."""
+
+    def __init__(self, used: int, evictable: int = 0) -> None:
+        self.used = used
+        self.evictable = evictable
+
+    def usage(self) -> int:
+        return self.used
+
+    def evict(self, nbytes: int) -> int:
+        freed = min(self.evictable, self.used, max(0, nbytes))
+        self.used -= freed
+        self.evictable -= freed
+        return freed
+
+
+def test_disabled_governor_admits_everything():
+    gov = HostMemoryGovernor(0)
+    assert not gov.enabled
+    assert gov.admit_swap(1 << 40)
+    assert gov.admit_serve()
+    gov.note_resume_blob(1 << 40)
+    assert gov.stats()["swap_refusals"] == 0
+
+
+def test_admission_under_budget():
+    gov = HostMemoryGovernor(1000)
+    store = FakeStore(used=100)
+    gov.register("prefix", store.usage)
+    assert gov.admit_swap(200)
+    assert gov.admit_serve()
+
+
+def test_degradation_order_evict_then_swap_then_serve():
+    """Rising pressure trips the ladder rungs in order: forced prefix
+    eviction first, then swap refusal, then serve refusal."""
+    gov = HostMemoryGovernor(1000)
+    store = FakeStore(used=900, evictable=300)
+    gov.register("prefix", store.usage, store.evict)
+
+    # Rung 1: a swap that fits only after eviction evicts, then admits.
+    assert gov.admit_swap(100)
+    assert gov.evictions_forced >= 1
+    assert store.used < 900
+    assert gov.swap_refusals == 0
+
+    # Rung 2: nothing left to evict and the capture cannot fit under the
+    # swap threshold -> refuse swap, but serves still pass (usage is
+    # below the serve threshold).
+    store.used = int(1000 * SWAP_REFUSE_FRAC)  # at the swap limit
+    store.evictable = 0
+    assert not gov.admit_swap(500)
+    assert gov.swap_refusals == 1
+    assert gov.admit_serve()
+    assert gov.serve_refusals == 0
+
+    # Rung 3: past the serve threshold -> serves refuse too.
+    store.used = int(1000 * SERVE_REFUSE_FRAC) + 1
+    assert not gov.admit_serve()
+    assert gov.serve_refusals == 1
+
+
+def test_resume_blob_never_refused_but_applies_pressure():
+    gov = HostMemoryGovernor(1000)
+    store = FakeStore(used=950, evictable=500)
+    gov.register("prefix", store.usage, store.evict)
+    gov.note_resume_blob(400)  # over budget -> evicts toward fit
+    assert store.used < 950
+
+
+def test_usage_survives_broken_gauge():
+    gov = HostMemoryGovernor(1000)
+    gov.register("bad", lambda: (_ for _ in ()).throw(RuntimeError()))
+    gov.register("good", lambda: 123)
+    assert gov.usage_bytes() == 123
+
+
+def test_register_is_idempotent_and_unregister_clears():
+    gov = HostMemoryGovernor(1000)
+    store = FakeStore(used=10)
+    gov.register("s", store.usage, store.evict)
+    gov.register("s", store.usage)  # replace without evictor
+    assert "s" not in gov._evict_fns
+    gov.unregister("s")
+    assert gov.usage_bytes() == 0
+
+
+def test_get_governor_reads_env(monkeypatch):
+    set_governor(None)
+    monkeypatch.setenv("LLMQ_HOST_MEM_GB", "2")
+    try:
+        gov = get_governor()
+        assert gov.budget_bytes == 2 * (1 << 30)
+        assert get_governor() is gov  # singleton
+    finally:
+        set_governor(None)
+
+
+def test_get_governor_default_disabled(monkeypatch):
+    set_governor(None)
+    monkeypatch.delenv("LLMQ_HOST_MEM_GB", raising=False)
+    try:
+        assert not get_governor().enabled
+    finally:
+        set_governor(None)

@@ -37,6 +37,7 @@ CASES = [
     ("collective_axis_cases.py", {"collective-axis"}),
     ("wallclock_cases.py", {"wallclock-duration"}),
     ("pickle_cases.py", {"pickle-snapshot"}),
+    ("hostbuffer_cases.py", {"unbounded-host-buffer"}),
 ]
 
 

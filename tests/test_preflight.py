@@ -171,11 +171,12 @@ def test_ladders_parse():
     """Both runbooks yield their full command ladders (a parser that
     silently matches nothing would make every other test vacuous)."""
     names = [name for name, _, _ in all_steps()]
-    assert sum(n.startswith("hardware_session") for n in names) >= 8
-    assert sum(n.startswith("chip_watch") for n in names) >= 15
+    assert sum(n.startswith("hardware_session") for n in names) >= 9
+    assert sum(n.startswith("chip_watch") for n in names) >= 16
     joined = " ".join(names)
     assert "kernel_v123" in joined and "queue_drain_tpu" in joined
     assert "metrics_probe" in joined
+    assert "fleet_chaos_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -310,6 +311,23 @@ def test_prefix_cache_probe_runs():
     assert "host-tier leg ok" in proc.stdout
     assert "ship leg ok" in proc.stdout
     assert "metric: prefix_cache_probe_ok" in proc.stdout
+
+
+def test_fleet_chaos_probe_runs():
+    """The fleet self-healing rung runs end to end on CPU: orphaned
+    affinity queues reclaimed exactly once, an unmeetable deadline shed
+    at submit as an explicit dead-letter, and the host-memory governor's
+    degradation ladder engaging its rungs in order."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/fleet_chaos_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:fleet_chaos_probe", proc)
+    assert "reclaim leg ok" in proc.stdout
+    assert "shed leg ok" in proc.stdout
+    assert "governor leg ok" in proc.stdout
+    assert "metric: fleet_chaos_probe_ok" in proc.stdout
 
 
 def test_bench_tiny_int4_runs():

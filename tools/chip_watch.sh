@@ -41,6 +41,10 @@ run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 #     parity at the tiny preset (the KV gathers and scatters run on the
 #     real chip; cheap, so it stays ahead of the long benches).
 run 900 prefix_probe python tools/prefix_cache_probe.py
+# 1e. Fleet self-healing plane: orphan reclaim / deadline shed / host
+#     memory governor ladder (host-side only; cheap, stays ahead of the
+#     long benches).
+run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

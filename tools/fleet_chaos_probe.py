@@ -1,0 +1,201 @@
+"""End-to-end probe of the fleet self-healing plane.
+
+Three legs, each printing a ``probe: <leg> ok`` line:
+
+1. **reclaim** — affinity-orphan reclaim: jobs stranded on a dead
+   worker's private ``<q>.w.<id>`` queue are republished to the shared
+   queue by one janitor pass and processed exactly once; the orphan
+   queue stops existing; a fresh worker's queue is untouched.
+2. **shed** — deadline admission control: with an observed fleet
+   service rate that cannot clear the queue inside a job's deadline,
+   the submit path dead-letters the job NOW (``x-failure-reason:
+   deadline_exceeded``) instead of letting it queue and rot; a job
+   with a generous budget still publishes normally.
+3. **governor** — host-memory degradation ladder: a governor under
+   byte pressure evicts the cold tier first, refuses swap-preempt
+   captures second, and refuses KV-ship serves only at the top rung —
+   in that order, never out of it.
+
+Runs on CPU (preflight) and on device (hardware_session rungs)
+identically — everything here is broker + host-side bookkeeping.
+
+    python tools/fleet_chaos_probe.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llmq_tpu.broker.manager import (
+    HEALTH_SUFFIX,
+    BrokerManager,
+    affinity_queue_name,
+)
+from llmq_tpu.core.config import Config
+from llmq_tpu.core.models import Job, WorkerHealth, utcnow
+from llmq_tpu.utils.host_mem import (
+    SERVE_REFUSE_FRAC,
+    SWAP_REFUSE_FRAC,
+    HostMemoryGovernor,
+)
+from llmq_tpu.workers.dummy import DummyWorker
+
+NS = "fleet-chaos-probe"
+
+
+async def run_reclaim_leg():
+    cfg = Config(broker_url=f"memory://{NS}-reclaim", max_redeliveries=1000)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("oq")
+        dead_q = affinity_queue_name("oq", "deadw")
+        live_q = affinity_queue_name("oq", "livew")
+        await mgr.broker.declare_queue(dead_q)
+        await mgr.broker.declare_queue(live_q)
+        jobs = [Job(id=f"o{i}", prompt=f"stranded {i}") for i in range(4)]
+        for j in jobs:
+            await mgr.publish_job(dead_q, j)
+        await mgr.broker.publish(live_q, b"{}", message_id="keep")
+        mgr._worker_seen["oq"] = {
+            "deadw": time.time() - 1000.0,
+            "livew": time.time(),
+        }
+
+        reclaimed = await mgr.reclaim_orphaned_affinity_queues("oq")
+        assert reclaimed == len(jobs), f"reclaimed {reclaimed}/{len(jobs)}"
+        assert await mgr.broker.get(dead_q) is None, "orphan queue survived"
+        keep = await mgr.broker.get(live_q)
+        assert keep is not None, "live worker's queue was reclaimed"
+        await keep.reject(requeue=True)
+        assert await mgr.reclaim_orphaned_affinity_queues("oq") == 0
+
+        worker = DummyWorker("oq", delay=0, config=cfg, concurrency=8)
+        task = asyncio.ensure_future(worker.run())
+        try:
+            got = []
+            deadline = asyncio.get_running_loop().time() + 60.0
+            while len(got) < len(jobs):
+                assert (
+                    asyncio.get_running_loop().time() < deadline
+                ), f"only {len(got)}/{len(jobs)} reclaimed jobs finished"
+                msg = await mgr.broker.get("oq.results")
+                if msg is None:
+                    await asyncio.sleep(0.02)
+                    continue
+                got.append(json.loads(msg.body)["id"])
+                await msg.ack()
+        finally:
+            worker.request_shutdown()
+            await asyncio.wait_for(task, timeout=30.0)
+        assert sorted(got) == sorted(j.id for j in jobs), (
+            f"exactly-once broken: {got}"
+        )
+    print(
+        f"probe: reclaim leg ok — {reclaimed} stranded jobs republished, "
+        "orphan queue deleted, exactly one result each"
+    )
+
+
+async def run_shed_leg():
+    cfg = Config(broker_url=f"memory://{NS}-shed", max_redeliveries=1000)
+    async with BrokerManager(cfg) as mgr:
+        await mgr.setup_queue_infrastructure("sq")
+        # Fleet telemetry the admission check reads: one worker averaging
+        # 60 s/job, with a small backlog already queued → any deadline
+        # under several minutes is unmeetable.
+        await mgr.broker.declare_queue(
+            "sq" + HEALTH_SUFFIX, ttl_ms=120_000,
+            max_redeliveries=1_000_000_000,
+        )
+        beat = WorkerHealth(
+            worker_id="slow-w",
+            status="running",
+            last_seen=utcnow(),
+            jobs_processed=10,
+            avg_duration_ms=60_000.0,
+        )
+        await mgr.broker.publish(
+            "sq" + HEALTH_SUFFIX, beat.model_dump_json().encode("utf-8")
+        )
+        for i in range(3):
+            await mgr.publish_job("sq", Job(id=f"b{i}", prompt=f"bg {i}"))
+
+        await mgr.publish_job(
+            "sq", Job(id="doomed", prompt="x", deadline_ms=1_000)
+        )
+        assert mgr.jobs_shed == 1, "unmeetable deadline was not shed"
+        failed = await mgr.get_failed_jobs("sq", limit=10)
+        shed = [e for e in failed if e.job_id == "doomed"]
+        assert len(shed) == 1, f"shed job not on the DLQ: {failed}"
+        assert shed[0].failure_reason == "deadline_exceeded"
+
+        await mgr.publish_job(
+            "sq", Job(id="fine", prompt="y", deadline_ms=3_600_000)
+        )
+        assert mgr.jobs_shed == 1, "meetable deadline was shed"
+        depth = (await mgr.get_queue_stats("sq")).message_count_ready
+        assert depth == 4, f"expected 3 background + 1 admitted, got {depth}"
+    print(
+        "probe: shed leg ok — unmeetable 1 s deadline dead-lettered at "
+        "submit (x-failure-reason=deadline_exceeded), 1 h deadline admitted"
+    )
+
+
+def run_governor_leg():
+    budget = 1_000_000
+    gov = HostMemoryGovernor(budget)
+    cold = {"bytes": 300_000}
+    fixed = {"bytes": 0}
+
+    def evict_cold(nbytes):
+        freed = min(cold["bytes"], max(0, int(nbytes)))
+        cold["bytes"] -= freed
+        return freed
+
+    gov.register("cold-tier", lambda: cold["bytes"], evict_fn=evict_cold)
+    gov.register("fixed", lambda: fixed["bytes"])
+
+    # Under the swap line: admitted without touching the cold tier.
+    assert gov.admit_swap(100_000)
+    assert gov.evictions_forced == 0 and gov.swap_refusals == 0
+    # Over the swap line but coverable by eviction: rung 1 fires, the
+    # capture is then admitted — no refusal yet.
+    fixed["bytes"] = 600_000  # + cold 300k + capture 200k > 850k line
+    assert gov.admit_swap(200_000)
+    assert gov.evictions_forced == 1 and gov.swap_refusals == 0
+    assert cold["bytes"] < 300_000, "eviction freed nothing"
+    # Nothing left to evict and still over the line: rung 2 refuses.
+    cold["bytes"] = 0
+    fixed["bytes"] = 800_000
+    assert not gov.admit_swap(200_000)
+    assert gov.swap_refusals == 1
+    # Serves survive swap pressure — they refuse only at the top rung.
+    assert gov.admit_serve()
+    assert gov.serve_refusals == 0
+    fixed["bytes"] = int(budget * SERVE_REFUSE_FRAC) + 1
+    assert not gov.admit_serve()
+    assert gov.serve_refusals == 1
+    # Resume blobs are accounted, never refused (they carry in-flight
+    # work mid-drain); they only apply eviction pressure.
+    gov.note_resume_blob(100_000)
+    s = gov.stats()
+    assert s["evictions_forced"] >= 1
+    print(
+        "probe: governor leg ok — ladder held: evict (rung 1) before "
+        "swap-refuse (rung 2) before serve-refuse (rung 3), resume blobs "
+        "never refused"
+    )
+
+
+def main():
+    asyncio.run(run_reclaim_leg())
+    asyncio.run(run_shed_leg())
+    run_governor_leg()
+    print("metric: fleet_chaos_probe_ok legs=3")
+
+
+if __name__ == "__main__":
+    main()

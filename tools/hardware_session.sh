@@ -51,6 +51,11 @@ run 900 snapshot_probe python tools/snapshot_probe.py
 # broker — proves the KV gather/scatter paths on the real chip, not
 # just CPU.
 run 900 prefix_probe python tools/prefix_cache_probe.py
+# Fleet self-healing plane: affinity-orphan reclaim exactly-once,
+# deadline admission shedding, and the host-memory degradation ladder
+# (broker + host-side bookkeeping; cheap, keeps the robustness plane
+# honest on the same image the benches run on).
+run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
