@@ -3,6 +3,7 @@
 from llmq_tpu.analysis.checkers.blocking import BlockingCallChecker
 from llmq_tpu.analysis.checkers.cancellation import CancelledSwallowChecker
 from llmq_tpu.analysis.checkers.collective_axis import CollectiveAxisChecker
+from llmq_tpu.analysis.checkers.devicefetch import DeviceFetchChecker
 from llmq_tpu.analysis.checkers.hostbuffer import HostBufferChecker
 from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
 from llmq_tpu.analysis.checkers.pickles import PickleSnapshotChecker
@@ -20,6 +21,7 @@ ALL_CHECKERS = (
     WallclockDurationChecker,
     PickleSnapshotChecker,
     HostBufferChecker,
+    DeviceFetchChecker,
 )
 
 #: rule id -> Rule, across every registered checker.

@@ -1,0 +1,133 @@
+"""unguarded-device-fetch: host-blocking device reads outside watchdog brackets.
+
+The device-fault containment layer only *detects* a wedged XLA call when
+the call runs inside a watchdog bracket (``with self._wd(kind): ...`` in
+``EngineCore``, or an explicit ``DispatchWatchdog.guard(...)``). A
+host-blocking device read added outside a bracket — ``np.asarray`` on a
+device value, ``jax.device_get``, ``.block_until_ready()`` — reopens the
+exact hole the watchdog closed: the engine thread can wedge there forever
+with nothing monitoring it, and the janitor only reclaims the worker once
+heartbeats also die.
+
+The rule applies to classes that have adopted the bracket discipline (any
+``with self._wd(...)`` / ``.guard(...)`` in the class body — in practice
+``EngineCore``): inside such a class, every host-blocking device read must
+sit under a bracket or carry a justified ``# llmq: ignore[...]`` pragma
+(host-only reads — freshly-built numpy inputs, shape probes at
+construction time — are legitimate and documented at the call site).
+Classes without brackets are exempt: the discipline is opt-in per class,
+not imposed on host-side code that never touches the device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+)
+
+UNGUARDED_DEVICE_FETCH = Rule(
+    "unguarded-device-fetch",
+    "error",
+    "host-blocking device read outside a watchdog bracket in a "
+    "bracket-disciplined class",
+)
+
+#: numpy functions that force a device→host transfer when handed a device
+#: value (the jaxsync set minus ``copy``, which this repo only ever calls
+#: on host arrays).
+_FETCH_FUNCS = {"asarray", "array"}
+_FETCH_RESOLVED = {"jax.device_get", "jax.block_until_ready"}
+
+
+def _is_guard_call(node: ast.AST) -> bool:
+    """``self._wd(...)`` or ``<watchdog>.guard(...)`` — the two spellings
+    of a watchdog bracket."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("_wd", "guard")
+    )
+
+
+def _guarded_nodes(cls: ast.ClassDef) -> Set[int]:
+    """ids of every AST node under a watchdog-bracketed ``with``."""
+    guarded: Set[int] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if any(_is_guard_call(item.context_expr) for item in node.items):
+            for sub in ast.walk(node):
+                guarded.add(id(sub))
+    return guarded
+
+
+class DeviceFetchChecker(Checker):
+    rules = (UNGUARDED_DEVICE_FETCH,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        imports = ImportMap(source.tree)
+        numpy_aliases = {
+            local
+            for local, full in imports.aliases.items()
+            if full == "numpy" or full.startswith("numpy.")
+        }
+        numpy_aliases.add("numpy")
+        for cls in ast.walk(source.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_nodes(cls)
+            if not guarded:
+                continue  # class has no brackets: discipline not adopted
+            for node in ast.walk(cls):
+                if id(node) in guarded or not isinstance(node, ast.Call):
+                    continue
+                message = self._fetch_message(node, numpy_aliases, imports)
+                if message is not None:
+                    yield Violation(
+                        rule=UNGUARDED_DEVICE_FETCH,
+                        path=source.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=message,
+                    )
+
+    @staticmethod
+    def _fetch_message(
+        node: ast.Call, numpy_aliases: Set[str], imports: ImportMap
+    ) -> "str | None":
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = func.value
+        if (
+            isinstance(recv, ast.Name)
+            and recv.id in numpy_aliases
+            and func.attr in _FETCH_FUNCS
+        ):
+            return (
+                f"{recv.id}.{func.attr}() on a device value blocks the "
+                "host unmonitored; wrap it in a watchdog bracket "
+                "(with self._wd(kind): ...) or justify with a pragma"
+            )
+        resolved = imports.resolve(func) or ""
+        if resolved in _FETCH_RESOLVED:
+            return (
+                f"{resolved}() blocks the host unmonitored; wrap it in a "
+                "watchdog bracket or justify with a pragma"
+            )
+        if func.attr == "block_until_ready" and not isinstance(
+            recv, ast.Constant
+        ):
+            return (
+                ".block_until_ready() blocks the host unmonitored; wrap "
+                "it in a watchdog bracket or justify with a pragma"
+            )
+        return None

@@ -97,6 +97,88 @@ class WorkerKillSwitch:
             self.on_kill()
 
 
+#: What each injectable device-fault mode raises/does when it fires.
+FAULT_MODES = ("hang", "xla_error", "oom")
+
+
+class DeviceFaultInjector:
+    """Seeded device-fault trigger for the fault-containment chaos legs.
+
+    Install as ``engine.on_dispatch`` (same attach point as
+    :class:`WorkerKillSwitch` — the hook runs ON the engine thread,
+    inside the watchdog bracket, which is exactly where a real device
+    fault surfaces). After a seeded-random number of dispatches matching
+    ``phase`` it fires exactly once:
+
+    - ``hang``: sleeps ``hang_s`` on the engine thread — the dispatch
+      boundary wedges, the watchdog (whose deadline must be below
+      ``hang_s``) trips from its side thread, and the bracket raises
+      ``HungDispatchError`` when the sleep returns.
+    - ``xla_error``: raises a runtime error carrying an
+      ``XlaRuntimeError`` signature, classifying as
+      ``xla_runtime_error``.
+    - ``oom``: raises a ``RESOURCE_EXHAUSTED`` allocation failure,
+      classifying as ``hbm_oom`` and driving the degradation ladder.
+
+    Deterministic for a given (phase, seed, after_range): runs replay
+    identically.
+    """
+
+    def __init__(
+        self,
+        phase: str,
+        mode: str,
+        *,
+        seed: int = 0,
+        after_range=(1, 5),
+        hang_s: float = 2.0,
+    ) -> None:
+        if phase not in PHASE_KINDS:
+            raise ValueError(
+                f"unknown fault phase {phase!r}; one of {sorted(PHASE_KINDS)}"
+            )
+        if mode not in FAULT_MODES:
+            raise ValueError(
+                f"unknown fault mode {mode!r}; one of {sorted(FAULT_MODES)}"
+            )
+        self.phase = phase
+        self.kinds = PHASE_KINDS[phase]
+        self.mode = mode
+        self.hang_s = hang_s
+        self.after = random.Random(seed).randint(*after_range)
+        self.matched = 0
+        self.fired = False
+
+    def __call__(self, kind: str) -> None:
+        if self.fired or kind not in self.kinds:
+            return
+        self.matched += 1
+        if self.matched < self.after:
+            return
+        self.fired = True
+        logger.info(
+            "chaos: injecting %s on %s dispatch #%d (phase=%s)",
+            self.mode,
+            kind,
+            self.matched,
+            self.phase,
+        )
+        if self.mode == "hang":
+            import time as _time
+
+            _time.sleep(self.hang_s)
+            return  # the watchdog bracket raises HungDispatchError
+        if self.mode == "oom":
+            raise RuntimeError(
+                "INJECTED XlaRuntimeError: RESOURCE_EXHAUSTED: out of "
+                "memory allocating device buffer (chaos)"
+            )
+        raise RuntimeError(
+            "INJECTED XlaRuntimeError: INTERNAL: device dispatch "
+            "failed (chaos)"
+        )
+
+
 class ChaosBroker(Broker):
     """Fault-injecting decorator over the transport named after ``chaos+``."""
 

@@ -71,6 +71,26 @@ AFFINITY_FRESH_S = STALE_AFTER_S
 RECLAIM_INTERVAL_S = 15.0
 
 
+def watchdog_reclaim_s() -> float:
+    """``LLMQ_WATCHDOG_RECLAIM``: treat a worker whose heartbeat reports
+    ``last_dispatch_ok_age_s`` at or beyond this many seconds as a reclaim
+    candidate even though it is still heartbeating — the wedged-engine
+    signature (the event loop beats, the device thread is stuck inside an
+    uninterruptible XLA call). Unset/empty/0 disables (the default): only
+    fully-silent workers reclaim, exactly the pre-watchdog behavior."""
+    import os
+
+    raw = os.environ.get("LLMQ_WATCHDOG_RECLAIM", "").strip()
+    if not raw:
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError as exc:
+        raise ValueError(
+            f"LLMQ_WATCHDOG_RECLAIM must be a number of seconds, got {raw!r}"
+        ) from exc
+
+
 def results_queue_name(queue: str) -> str:
     return queue if queue.endswith(RESULTS_SUFFIX) else queue + RESULTS_SUFFIX
 
@@ -364,8 +384,9 @@ class BrokerManager:
         seen = self._worker_seen.get(queue, {})
         now = time.time()
         reclaimed = 0
+        wedged = self._stale_dispatch_workers(beats)
         for wid, last in list(seen.items()):
-            if now - last <= STALE_AFTER_S:
+            if now - last <= STALE_AFTER_S and wid not in wedged:
                 continue
             aq = affinity_queue_name(queue, wid)
             # Re-publish whatever the dead worker's queue still holds onto
@@ -390,12 +411,32 @@ class BrokerManager:
             await self.broker.delete_queue(kv_fetch_queue_name(queue, wid))
             seen.pop(wid, None)
             logger.info(
-                "Reclaimed affinity queue %s (%d stranded messages)",
+                "Reclaimed affinity queue %s (%d stranded messages%s)",
                 aq,
                 reclaimed,
+                "; worker heartbeating but dispatch-wedged"
+                if wid in wedged
+                else "",
             )
         self.affinity_reclaimed += reclaimed
         return reclaimed
+
+    def _stale_dispatch_workers(
+        self, beats: Dict[str, WorkerHealth]
+    ) -> set:
+        """Workers whose heartbeat is live but whose engine thread has not
+        completed a device dispatch for at least ``LLMQ_WATCHDOG_RECLAIM``
+        seconds — wedged-but-heartbeating. Empty set when the knob is off
+        (the default) or no heartbeat carries the liveness field."""
+        limit = watchdog_reclaim_s()
+        if limit <= 0:
+            return set()
+        out = set()
+        for wid, health in beats.items():
+            age = health.last_dispatch_ok_age_s
+            if age is not None and age >= limit:
+                out.add(wid)
+        return out
 
     # --- deadline admission control ---------------------------------------
     async def _observed_fleet_rate(self, queue: str) -> Optional[float]:

@@ -312,10 +312,17 @@ def _selfheal_cell(es: dict) -> str:
         ("jobs_quarantined", "quar"),
         ("kv_fetch_failures", "kvf"),
         ("kv_serve_busy_rejects", "busy"),
+        ("engine_rebuilds", "rbld"),
+        ("watchdog_trips", "wdt"),
+        ("hbm_oom_events", "oom"),
     ):
         value = es.get(key)
         if value:
             parts.append(f"{tag}:{value}")
+    if es.get("wedged_dispatch"):
+        # A dispatch is in flight and past its watchdog deadline right
+        # now: wedged-but-heartbeating, not healthy idle.
+        parts.append(f"[red]WEDGED:{es['wedged_dispatch']}[/red]")
     if es.get("breaker_tripped"):
         parts.append("[red]BRK[/red]")
     return " ".join(parts) if parts else "-"

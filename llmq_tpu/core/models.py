@@ -182,6 +182,15 @@ class WorkerHealth(BaseModel):
         "worker that already has the pages; None for workers without "
         "prefix caching (or before their first templated job).",
     )
+    last_dispatch_ok_age_s: Optional[float] = Field(
+        None,
+        description="Seconds since the engine's dispatch watchdog last saw "
+        "a device call complete cleanly. Heartbeats run on the event loop "
+        "and keep flowing while the engine thread is wedged inside an "
+        "uninterruptible XLA call — a large value on a 'running' worker is "
+        "the wedge signature `monitor top` and the affinity janitor key "
+        "on. None when the watchdog is off (the default).",
+    )
 
 
 class ErrorInfo(BaseModel):
@@ -196,6 +205,8 @@ class ErrorInfo(BaseModel):
     failure_reason: Optional[str] = Field(
         None,
         description="Machine-readable failure class (engine_error, "
-        "deadline_exceeded, unparseable, ...) — the fingerprint the "
-        "poison-job quarantine keys on; None for pre-quarantine records.",
+        "deadline_exceeded, unparseable, or a device-fault class: "
+        "hung_dispatch, xla_runtime_error, hbm_oom, mesh_error) — the "
+        "fingerprint the poison-job quarantine keys on; None for "
+        "pre-quarantine records.",
     )

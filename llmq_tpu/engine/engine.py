@@ -75,9 +75,15 @@ except ImportError:  # jax 0.4.x: same pair, pre-rename names
     )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from llmq_tpu.core.faults import (
+    FAULT_OOM,
+    DeviceFaultError,
+    classify_failure,
+)
 from llmq_tpu.engine import sampling as sampling_mod
 from llmq_tpu.engine import snapshot as snapshot_mod
 from llmq_tpu.engine.prefix_store import PrefixStore
+from llmq_tpu.engine.watchdog import NO_GUARD, DispatchWatchdog
 from llmq_tpu.engine.snapshot import (
     KVRestore,
     RequestSnapshot,
@@ -250,6 +256,17 @@ class EngineConfig:
     # pool's stored dtype, so a host-restored greedy continuation is
     # bit-identical to cold prefill. LLMQ_PREFIX_HOST_GB pins over this.
     prefix_host_gb: float = 0.0
+    # Dispatch watchdog (0 = off): every device dispatch/fetch bracket
+    # gets a monotonic deadline of max(watchdog_min_s, p99(kind) *
+    # watchdog_mult) from the live per-kind dispatch histograms, and a
+    # side thread detects (not interrupts — nothing can) a call that
+    # overruns it. Off means no thread and no bracketing: the hot path
+    # is byte-identical. LLMQ_WATCHDOG_MULT pins over this.
+    watchdog_mult: float = 0.0
+    # Deadline floor in seconds: protects against tripping on cold-start
+    # compiles and empty histograms (a kind with no history uses the
+    # floor alone). LLMQ_WATCHDOG_MIN_S pins over this.
+    watchdog_min_s: float = 30.0
 
     def __post_init__(self):
         self.decode_block = int(self.decode_block)
@@ -286,6 +303,16 @@ class EngineConfig:
         if self.prefix_host_gb < 0:
             raise ValueError(
                 f"prefix_host_gb={self.prefix_host_gb} (want >= 0)"
+            )
+        self.watchdog_mult = float(self.watchdog_mult)
+        if self.watchdog_mult < 0:
+            raise ValueError(
+                f"watchdog_mult={self.watchdog_mult} (want >= 0)"
+            )
+        self.watchdog_min_s = float(self.watchdog_min_s)
+        if self.watchdog_min_s <= 0:
+            raise ValueError(
+                f"watchdog_min_s={self.watchdog_min_s} (want > 0)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -538,6 +565,31 @@ class EngineCore:
                 host_gb,
                 self.cfg.page_size,
             )
+        # Dispatch watchdog: env pins over config like the knobs above.
+        # Resolved here; the monitor itself starts at the end of __init__
+        # once the per-kind dispatch histograms (its deadline source)
+        # exist.
+        wd_mult = self.cfg.watchdog_mult
+        env_mult = os.environ.get("LLMQ_WATCHDOG_MULT", "").strip()
+        if env_mult:
+            try:
+                wd_mult = float(env_mult)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_WATCHDOG_MULT={env_mult!r} is not a number"
+                ) from None
+        wd_min = self.cfg.watchdog_min_s
+        env_min = os.environ.get("LLMQ_WATCHDOG_MIN_S", "").strip()
+        if env_min:
+            try:
+                wd_min = float(env_min)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_WATCHDOG_MIN_S={env_min!r} is not a number"
+                ) from None
+        self.watchdog_mult = wd_mult
+        self.watchdog_min_s = wd_min
+        self.watchdog: Optional[DispatchWatchdog] = None
         if self.mixed_step == "on" and not self.cfg.prefill_chunk_size:
             raise ValueError(
                 "mixed_step=on requires prefill_chunk_size: the fused "
@@ -554,7 +606,8 @@ class EngineCore:
         # nothing host→device).
         self._stop_capacity = self.cfg.stop_id_capacity
         E = self._stop_capacity
-        key_shape = np.asarray(make_base_key(0, 0)).shape
+        # Construction-time shape probe (no dispatch in flight yet).
+        key_shape = np.asarray(make_base_key(0, 0)).shape  # llmq: ignore[unguarded-device-fetch]
         self._h_tokens = np.zeros((S,), np.int32)
         self._h_ctx = np.zeros((S,), np.int32)
         self._h_bt = np.zeros((S, self._pages_per_seq), np.int32)
@@ -619,6 +672,14 @@ class EngineCore:
         self.prefix_chunks_ingested = 0  # shipped pages accepted
         self.deadline_expirations = 0  # sequences expired by the sweep
         self.swap_refused = 0  # captures the host-memory governor declined
+        self.hbm_oom_events = 0  # allocation faults the ladder absorbed
+        # HBM-OOM degradation ladder position (monotonic per engine: a
+        # pool that OOMed once stays degraded) and the rungs taken, in
+        # order, for stats/probes.
+        self._oom_rung = 0
+        # Bounded by construction: the rung counter is monotonic 0→3, so
+        # at most three entries are ever appended per engine lifetime.
+        self._oom_ladder_log: List[str] = []  # llmq: ignore[unbounded-host-buffer]
         # Flipped by the first deadline-carrying request so the per-step
         # sweep costs nothing on deadline-free deployments.
         self._deadlines_enabled = False
@@ -734,6 +795,44 @@ class EngineCore:
         self._resync()
         if os.environ.get("LLMQ_PARAM_AUTO_LAYOUT", "0") == "1":
             self._optimize_param_layouts()
+
+        # Dispatch watchdog (default off): deadlines read the per-kind
+        # histograms above, so it starts last. p99 comes from the live
+        # distribution; kinds with no history (cold start, snapshot
+        # gathers) fall back to the floor inside deadline_for.
+        if self.watchdog_mult > 0:
+            self.watchdog = DispatchWatchdog(
+                mult=self.watchdog_mult,
+                min_s=self.watchdog_min_s,
+                percentile_fn=self._dispatch_p99,
+            )
+            logger.info(
+                "dispatch watchdog: p99 x %.1f, floor %.1fs",
+                self.watchdog_mult,
+                self.watchdog_min_s,
+            )
+
+    def _dispatch_p99(self, kind: str) -> Optional[float]:
+        """Watchdog deadline source: live p99 of one dispatch kind, or
+        None (→ floor) before any dispatch of that kind landed. Reads a
+        histogram the engine thread appends to; bucket counts are ints,
+        so a torn read costs at most one stale observation."""
+        hist = self._dispatch_hists.get(kind)
+        if hist is None:
+            return None
+        return hist.percentile(0.99)
+
+    def _wd(self, kind: str):
+        """Watchdog bracket for one device dispatch/fetch boundary; the
+        shared no-op context when the watchdog is off (the default), so
+        the hot path stays allocation-free and byte-identical."""
+        wd = self.watchdog
+        return NO_GUARD if wd is None else wd.guard(kind)
+
+    def stop_watchdog(self) -> None:
+        """Stop the monitor thread (engine teardown / fault rebuild)."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
 
     # --- compilation ------------------------------------------------------
     def _build_steps(self) -> None:
@@ -1552,7 +1651,8 @@ class EngineCore:
             # before its final-segment iteration are padding zeros from
             # its inactive phase and must be skipped, not appended.
             block, starts = out
-            tokens = np.asarray(block)
+            with self._wd("mixed"):
+                tokens = np.asarray(block)
             for k in range(tokens.shape[0]):
                 for row, seq, epoch in snapshot:
                     if k < starts[row]:
@@ -1574,8 +1674,9 @@ class EngineCore:
             # page pressure is handled in device order, and each token
             # re-checks the row guards — a host-detected stop string at
             # candidate i must discard candidates i+1.. of the SAME row.
-            emit = np.asarray(out[0])
-            counts = np.asarray(out[1])
+            with self._wd("verify"):
+                emit = np.asarray(out[0])
+                counts = np.asarray(out[1])
             for k in range(emit.shape[0]):
                 for row, seq, epoch in snapshot:
                     n = int(counts[k, row])
@@ -1601,7 +1702,8 @@ class EngineCore:
                         )
             self._processed_idx = idx
             return
-        tokens = np.asarray(out)  # transfer started at dispatch; ~ready
+        with self._wd("decode_block" if kind == "decode" else "prefill"):
+            tokens = np.asarray(out)  # transfer started at dispatch; ~ready
         # Normalise to a [K, rows] block: prefill outputs and K=1 decode
         # steps are 1-D [rows]; fused decode blocks are already [K, S].
         # Iterating k-major reproduces exactly the per-step processing
@@ -1669,8 +1771,9 @@ class EngineCore:
         idx = jnp.asarray(pages[:n], jnp.int32)
         # np.asarray blocks until the gather lands, so the fresh host
         # buffers are safe against the pools' later donation.
-        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
-        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        with self._wd("snapshot_gather"):
+            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
 
@@ -1736,8 +1839,9 @@ class EngineCore:
         if not self._admit_swap_capture(n):
             return  # recompute fallback: re-admission re-prefills
         idx = jnp.asarray(seq.pages[:n], jnp.int32)
-        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
-        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        with self._wd("snapshot_gather"):
+            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
 
@@ -1753,8 +1857,9 @@ class EngineCore:
         if self.prefix_store is None:
             return
         idx = jnp.asarray([page], jnp.int32)
-        k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
-        v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+        with self._wd("snapshot_gather"):
+            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
+            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
         for h in hashes:
             self.prefix_store.put(h, k, v)
         self.prefix_demotes += 1
@@ -1778,7 +1883,8 @@ class EngineCore:
             if not hr:
                 continue
             seq.host_restore = None
-            idx = np.asarray([page for page, _, _ in hr], np.int32)
+            # Host list → numpy; no device value involved.
+            idx = np.asarray([page for page, _, _ in hr], np.int32)  # llmq: ignore[unguarded-device-fetch]
             k = np.concatenate([e.k for _, _, e in hr], axis=1)
             v = np.concatenate([e.v for _, _, e in hr], axis=1)
             self.k_pages = self._kv_insert_jit(
@@ -1823,12 +1929,13 @@ class EngineCore:
                 page = self.scheduler._prefix_cache.get(key)
                 if page is not None:
                     idx = jnp.asarray([page], jnp.int32)
-                    k = np.asarray(
-                        _dispatch.gather_kv_pages(self.k_pages, idx)
-                    )
-                    v = np.asarray(
-                        _dispatch.gather_kv_pages(self.v_pages, idx)
-                    )
+                    with self._wd("snapshot_gather"):
+                        k = np.asarray(
+                            _dispatch.gather_kv_pages(self.k_pages, idx)
+                        )
+                        v = np.asarray(
+                            _dispatch.gather_kv_pages(self.v_pages, idx)
+                        )
             if k is None:
                 continue
             blob = prefix_mod.chunk_to_bytes(
@@ -1943,7 +2050,8 @@ class EngineCore:
             self._h_temp[i] = p.temperature
             self._h_topk[i] = p.top_k
             self._h_topp[i] = p.top_p
-            self._h_keys[i] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))
+            # µs-scale PRNG-key fetch at admission, not a dispatch wait.
+            self._h_keys[i] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))  # llmq: ignore[unguarded-device-fetch]
             self._h_steps[i] = len(seq.output_ids)
             self._h_limits[i] = p.max_tokens
             self._h_mins[i] = p.min_tokens
@@ -2099,13 +2207,14 @@ class EngineCore:
                 for seq in rows:
                     if seq.t_prefill_start == 0.0:
                         seq.t_prefill_start = t0
-                out, self.k_pages, self.v_pages, self._dev_state = (
-                    self._chunkfill_jits[chunk_mode](
-                        self.params, self.k_pages, self.v_pages,
-                        *chunk_args, *inv, self._dev_state,
+                with self._wd("prefill"):
+                    out, self.k_pages, self.v_pages, self._dev_state = (
+                        self._chunkfill_jits[chunk_mode](
+                            self.params, self.k_pages, self.v_pages,
+                            *chunk_args, *inv, self._dev_state,
+                        )
                     )
-                )
-                self._record_dispatch("prefill", time.monotonic() - t0)
+                    self._record_dispatch("prefill", time.monotonic() - t0)
                 if snapshot:  # rows whose prompt finished in this chunk
                     for _, seq in snapshot:
                         seq.prefilled = True
@@ -2160,7 +2269,8 @@ class EngineCore:
             cur = seq.prefix_len  # cached prefix pages already hold KV
             seq_mode = sampling_mod.required_mode(seq.params)
             inv_arrays = (
-                np.asarray([n], np.int32),
+                # Host int → numpy; no device value involved.
+                np.asarray([n], np.int32),  # llmq: ignore[unguarded-device-fetch]
                 *self._pack_sampling_rows([seq], 1),
             )
             if self.cfg.spec_tokens > 0:
@@ -2245,13 +2355,14 @@ class EngineCore:
                 t0 = time.monotonic()
                 if seq.t_prefill_start == 0.0:
                     seq.t_prefill_start = t0
-                out, self.k_pages, self.v_pages, self._dev_state = (
-                    self._mixedfill_jits[mode](
-                        self.params, self.k_pages, self.v_pages,
-                        *seg_args, *inv, self._dev_state,
+                with self._wd("mixed"):
+                    out, self.k_pages, self.v_pages, self._dev_state = (
+                        self._mixedfill_jits[mode](
+                            self.params, self.k_pages, self.v_pages,
+                            *seg_args, *inv, self._dev_state,
+                        )
                     )
-                )
-                self._record_dispatch("mixed", time.monotonic() - t0)
+                    self._record_dispatch("mixed", time.monotonic() - t0)
                 self.mixed_steps += 1
                 self.mixed_prefill_tokens += sum(t for _, t in segs)
                 self.prefill_tokens += sum(t for _, t in segs)
@@ -2299,7 +2410,8 @@ class EngineCore:
         for r, seq in enumerate(rows):
             p = seq.params
             slots[r] = seq.slot
-            keys[r] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))
+            # µs-scale PRNG-key fetch while packing, not a dispatch wait.
+            keys[r] = np.asarray(make_base_key(p.seed, request_tag(seq.rid)))  # llmq: ignore[unguarded-device-fetch]
             steps[r] = len(seq.output_ids)
             temps[r] = p.temperature
             topks[r] = p.top_k
@@ -2342,10 +2454,14 @@ class EngineCore:
         for seq in chunk:
             if seq.t_prefill_start == 0.0:
                 seq.t_prefill_start = t0
-        out, self.k_pages, self.v_pages, self._dev_state = self._prefill_jits[
-            chunk_mode
-        ](self.params, self.k_pages, self.v_pages, *args, self._dev_state)
-        self._record_dispatch("prefill", time.monotonic() - t0)
+        with self._wd("prefill"):
+            out, self.k_pages, self.v_pages, self._dev_state = (
+                self._prefill_jits[chunk_mode](
+                    self.params, self.k_pages, self.v_pages, *args,
+                    self._dev_state,
+                )
+            )
+            self._record_dispatch("prefill", time.monotonic() - t0)
         for seq in chunk:
             seq.prefilled = True
             self.prefill_tokens += seq.num_tokens
@@ -2481,13 +2597,14 @@ class EngineCore:
         if not self._ensure_decode_pages(finished):
             return
         t0 = time.monotonic()
-        out, self.k_pages, self.v_pages, self._dev_state = self._decode_jits[
-            self._mode
-        ](self.params, self.k_pages, self.v_pages, self._dev_state)
-        self._record_dispatch(
-            "verify" if self.cfg.spec_tokens > 0 else "decode_block",
-            time.monotonic() - t0,
-        )
+        kind = "verify" if self.cfg.spec_tokens > 0 else "decode_block"
+        with self._wd(kind):
+            out, self.k_pages, self.v_pages, self._dev_state = (
+                self._decode_jits[self._mode](
+                    self.params, self.k_pages, self.v_pages, self._dev_state
+                )
+            )
+            self._record_dispatch(kind, time.monotonic() - t0)
         self.decode_steps += self.cfg.decode_block
         self.decode_dispatches += 1
         self._push_pending(
@@ -2757,12 +2874,13 @@ class EngineCore:
             n = snapshot_mod.pages_for(kv_valid, self.cfg.page_size)
             if 0 < n <= len(seq.pages):
                 idx = jnp.asarray(seq.pages[:n], jnp.int32)
-                kv_k = np.asarray(
-                    _dispatch.gather_kv_pages(self.k_pages, idx)
-                )
-                kv_v = np.asarray(
-                    _dispatch.gather_kv_pages(self.v_pages, idx)
-                )
+                with self._wd("snapshot_gather"):
+                    kv_k = np.asarray(
+                        _dispatch.gather_kv_pages(self.k_pages, idx)
+                    )
+                    kv_v = np.asarray(
+                        _dispatch.gather_kv_pages(self.v_pages, idx)
+                    )
             else:
                 kv_valid = 0
         return RequestSnapshot(
@@ -2772,7 +2890,9 @@ class EngineCore:
             prompt_ids=list(seq.prompt_ids),
             output_ids=list(seq.output_ids),
             params=dataclasses.replace(p),
-            key_data=np.asarray(
+            # µs-scale PRNG-key fetch; the snapshot's KV gathers above
+            # are the heavy reads and run bracketed.
+            key_data=np.asarray(  # llmq: ignore[unguarded-device-fetch]
                 make_base_key(p.seed, request_tag(seq.rid)), np.uint32
             ),
             epoch=seq.epoch,
@@ -2844,6 +2964,137 @@ class EngineCore:
             self.snapshots_extracted += 1
         return snaps
 
+    # --- fault recovery ---------------------------------------------------
+    def discard_pending(self, *, reuse_pool: bool = False) -> None:
+        """Drop every in-flight dispatch result without fetching it.
+        Fault recovery only: after a device fault the pending outputs
+        are unreadable (wedged or poisoned), while the sequences' host
+        state — ``output_ids`` up to the last *processed* step — is
+        still consistent. Re-inserting their snapshots recomputes the
+        lost iterations deterministically (same key chain, same step
+        counts), so greedy output is token-identical to a fault-free
+        run; only a little progress is repaid.
+
+        ``reuse_pool`` is the in-place (same backend) restore flavor:
+        deferred pages go back to the allocator instead of being
+        abandoned — safe because any in-flight writes to them are
+        device-stream-ordered before whatever reuses them next."""
+        self._pending.clear()
+        self._pending_decodes = 0
+        # Dropped swap captures fall back to re-prefill on re-admission:
+        # always correct, just repays the preempted prefix.
+        self._pending_swaps.clear()
+        self._processed_idx = self._dispatch_idx
+        if reuse_pool:
+            for _, pages, cacheable in self._deferred_pages:
+                self.scheduler.release_pages(pages, cacheable)
+        # Otherwise deferred pages would now be past their watermark, but
+        # the pool they'd return to is being abandoned with the faulted
+        # backend; just drop the bookkeeping.
+        self._deferred_pages.clear()
+        self._dirty = True
+
+    def extract_for_rebuild(
+        self, *, reuse_pool: bool = False
+    ) -> Tuple[List[Tuple[RequestSnapshot, Optional[float]]], List[str]]:
+        """Best-effort snapshot of every in-flight request after a
+        device fault, for re-insertion into a rebuilt engine. In-flight
+        dispatch results are discarded first (see
+        :meth:`discard_pending`), then each sequence snapshots
+        *independently* — per-request isolation, unlike
+        :meth:`extract_all`, because a gather from a wedged or poisoned
+        backend can itself fault. Returns ``(snapshots_with_deadlines,
+        lost_rids)``: rows whose snapshot failed (wedged in the faulted
+        dispatch) go in the second list and recover via the worker's
+        requeue path instead."""
+        self.discard_pending(reuse_pool=reuse_pool)
+        snaps: List[Tuple[RequestSnapshot, Optional[float]]] = []
+        lost: List[str] = []
+        for seq in list(self.scheduler.running.values()) + list(
+            self.scheduler.waiting
+        ):
+            if seq.finish_reason is not None:
+                continue
+            try:
+                snap = self._snapshot_seq(seq)
+            except Exception:  # noqa: BLE001 — per-row isolation
+                logger.exception(
+                    "fault recovery: snapshot of %s failed; the request "
+                    "will requeue instead",
+                    seq.rid,
+                )
+                lost.append(seq.rid)
+                # Still remove it: in the same-backend (reuse_pool)
+                # restore a row left behind would keep generating against
+                # a future already resolved as a requeue — a duplicate.
+                self._remove_extracted(seq)
+                continue
+            snaps.append((snap, seq.deadline_at))
+            self._remove_extracted(seq)
+            self.snapshots_extracted += 1
+        return snaps, lost
+
+    def degrade_for_oom(self) -> Optional[str]:
+        """One rung of the HBM-OOM degradation ladder per call, in
+        order: (1) demote refcount-0 prefix device pages to the host
+        cold tier, (2) halve the run-ahead pipeline depth (fewer
+        in-flight result buffers resident in HBM), (3) preempt one
+        victim with swap-to-host. Returns the rung taken, or None when
+        the ladder is dry — the caller then falls through to fault
+        recovery (rebuild / dead-letter). Rungs never reset: a pool
+        that OOMed stays degraded for the life of this engine."""
+        self.hbm_oom_events += 1
+        while self._oom_rung < 3:
+            rung = self._oom_rung
+            self._oom_rung += 1
+            if rung == 0:
+                if self.prefix_store is not None:
+                    dropped = self.flush_prefix_to_host()
+                    if dropped > 0:
+                        self._oom_ladder_log.append("demote_prefix")
+                        logger.warning(
+                            "hbm_oom ladder: demoted %d prefix pages to "
+                            "the host tier",
+                            dropped,
+                        )
+                        return "demote_prefix"
+            elif rung == 1:
+                if self.cfg.runahead > 1:
+                    self.cfg.runahead = max(1, self.cfg.runahead // 2)
+                    self._oom_ladder_log.append("shrink_runahead")
+                    logger.warning(
+                        "hbm_oom ladder: run-ahead shrunk to %d",
+                        self.cfg.runahead,
+                    )
+                    return "shrink_runahead"
+            else:
+                victim = next(
+                    (
+                        s
+                        for s in reversed(
+                            list(self.scheduler.running.values())
+                        )
+                        if s.prefilled and s.finish_reason is None
+                    ),
+                    None,
+                )
+                if victim is not None:
+                    # Force the swap flavor for this one preemption: the
+                    # point of the rung is freeing HBM *without* paying a
+                    # re-prefill on top of an already-starved device.
+                    prev = self.preempt_mode
+                    self.preempt_mode = "swap"
+                    try:
+                        self._self_preempt_deferred(victim)
+                    finally:
+                        self.preempt_mode = prev
+                    self._oom_ladder_log.append("preempt_swap")
+                    logger.warning(
+                        "hbm_oom ladder: swap-preempted %s", victim.rid
+                    )
+                    return "preempt_swap"
+        return None
+
     def insert_request(
         self,
         snap: RequestSnapshot,
@@ -2869,10 +3120,12 @@ class EngineCore:
                 f"request {snap.rid!r} is already in flight on this engine"
             )
         params = dataclasses.replace(snap.params)
-        expect = np.asarray(
+        # µs-scale PRNG-key fetch at insert time, not a dispatch wait.
+        expect = np.asarray(  # llmq: ignore[unguarded-device-fetch]
             make_base_key(params.seed, request_tag(snap.rid)), np.uint32
         )
-        got = np.asarray(snap.key_data, np.uint32)
+        # Snapshot payload is already host bytes.
+        got = np.asarray(snap.key_data, np.uint32)  # llmq: ignore[unguarded-device-fetch]
         if got.shape != expect.shape or not np.array_equal(got, expect):
             raise SnapshotCompatError(
                 "sampling-key chain mismatch: the snapshot's base key does "
@@ -2938,7 +3191,8 @@ class EngineCore:
             # admit() allocated pages for num_tokens+1 positions, which
             # always covers the ceil(valid/page) pages of data.
             assert n <= len(seq.pages), (n, len(seq.pages))
-            idx = np.asarray(seq.pages[:n], np.int32)
+            # Host page-index list → numpy; no device value involved.
+            idx = np.asarray(seq.pages[:n], np.int32)  # llmq: ignore[unguarded-device-fetch]
             self.k_pages = self._kv_insert_jit(
                 self.k_pages, idx, np.ascontiguousarray(r.k)
             )
@@ -2958,7 +3212,10 @@ class EngineCore:
         half-updated batch forever."""
         if self._pending:
             try:  # wait out in-flight steps; discard their results
-                np.asarray(self._pending[-1][2])
+                # Deliberately unbracketed: abort_all runs on the failure
+                # path where the watchdog may have already tripped — a
+                # second trip here would shadow the original fault.
+                np.asarray(self._pending[-1][2])  # llmq: ignore[unguarded-device-fetch]
             except Exception:  # noqa: BLE001 — the step itself failed
                 pass
             self._processed_idx = self._pending[-1][0]
@@ -3103,6 +3360,23 @@ class EngineCore:
             s["deadline_expirations"] = self.deadline_expirations
         if self.swap_refused:
             s["swap_refused"] = self.swap_refused
+        # Device-fault containment (superset-only: the watchdog block
+        # appears only when the watchdog is on, the OOM block only after
+        # an allocation fault — defaults publish neither).
+        if self.watchdog is not None:
+            s["watchdog_trips"] = self.watchdog.trips
+            s["last_dispatch_ok_age_s"] = round(
+                self.watchdog.last_ok_age_s(), 3
+            )
+            wedged = self.watchdog.wedged_kind()
+            if wedged is not None:
+                # A dispatch is in flight AND past its deadline right now
+                # — the signature that separates a wedged engine from a
+                # healthy idle one (whose ok-age also grows, jobless).
+                s["wedged_dispatch"] = wedged
+        if self.hbm_oom_events:
+            s["hbm_oom_events"] = self.hbm_oom_events
+            s["oom_degradations"] = list(self._oom_ladder_log)
         gov = get_governor()
         if gov.enabled:
             s["host_mem"] = gov.stats()
@@ -3122,6 +3396,24 @@ class HandoffOutput:
     emitted: int = 0
 
 
+#: Hard ceiling on one in-process fault recovery (extract + rebuild +
+#: re-insert). A device wedged badly enough that the *recovery* blocks
+#: past this is unrecoverable in-process: the backstop hard-exits so the
+#: orphan janitor reclaims the worker's affinity queue and its jobs
+#: requeue, instead of a zombie holding them forever.
+REBUILD_HARD_EXIT_S = 180.0
+
+
+def _hard_exit_wedged(reason: str) -> None:
+    logger.critical(
+        "engine rebuild after %s exceeded %.0fs — process is wedged "
+        "beyond in-process recovery; hard-exiting for janitor reclaim",
+        reason,
+        REBUILD_HARD_EXIT_S,
+    )
+    os._exit(86)
+
+
 class AsyncEngine:
     """Async facade: step loop on a dedicated thread, asyncio-awaitable
     results (the surface the reference consumed from AsyncLLMEngine)."""
@@ -3136,6 +3428,24 @@ class AsyncEngine:
         self._handoff_requested = False
         self._handoff_event: Optional[threading.Event] = None
         self._handoff_results: List[HandoffOutput] = []
+        # Device-fault containment (all optional; workers wire them):
+        # rebuild_core() returns a fresh EngineCore in a fresh backend —
+        # when set, a classified device fault rebuilds in-process and
+        # re-inserts every restorable request instead of failing the
+        # batch. on_device_fault(reason) is the worker's breaker
+        # notification (called on the engine thread; must be cheap /
+        # thread-safe).
+        self.rebuild_core: Optional[Any] = None
+        self.on_device_fault: Optional[Any] = None
+        self.engine_rebuilds = 0
+        self.last_fault_reason: Optional[str] = None
+        # Trips recorded by watchdogs of cores already rebuilt away;
+        # stats() adds them so the counter never moves backwards.
+        self._prior_watchdog_trips = 0
+        # rid -> [(event_name, t_mono, fields)] recorded during fault
+        # recovery; workers pop these into the request trace.
+        self._fault_events: Dict[str, List[Tuple[str, float, Dict[str, Any]]]] = {}
+        self._fault_lock = threading.Lock()
         # Closures marshalled onto the engine thread (prefix-tier export/
         # ingest touch the device pools, which the step loop donates).
         self._calls: "queue.Queue[Tuple[Any, Future]]" = queue.Queue()
@@ -3233,7 +3543,18 @@ class AsyncEngine:
         return self._handoff_results
 
     def stats(self) -> Dict[str, Any]:
-        return self.core.stats()
+        s = self.core.stats()
+        if self._prior_watchdog_trips and "watchdog_trips" in s:
+            # Trips survive rebuilds: the faulted core's watchdog died
+            # with it, but the count is a worker-lifetime monotonic.
+            s["watchdog_trips"] += self._prior_watchdog_trips
+        return s
+
+    @property
+    def watchdog_trips(self) -> int:
+        """Worker-lifetime watchdog trip count, across engine rebuilds."""
+        wd = getattr(self.core, "watchdog", None)
+        return self._prior_watchdog_trips + (wd.trips if wd else 0)
 
     def call_on_engine(self, fn, timeout: float = 30.0):
         """Run ``fn()`` on the engine thread and return its result.
@@ -3284,6 +3605,187 @@ class AsyncEngine:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=30)
+
+    # --- fault recovery ---------------------------------------------------
+    def pop_fault_events(self, rid: str) -> List[Tuple[str, float, Dict[str, Any]]]:
+        """Take (and clear) the fault-recovery events recorded for one
+        request: ``(name, t_mono, fields)`` tuples the worker projects
+        onto the request trace. Thread-safe; [] when none."""
+        with self._fault_lock:
+            return self._fault_events.pop(rid, [])
+
+    def _record_fault_event(
+        self, rids: List[str], name: str, **fields: Any
+    ) -> None:
+        t = time.monotonic()
+        with self._fault_lock:
+            for rid in rids:
+                self._fault_events.setdefault(rid, []).append(
+                    (name, t, dict(fields))
+                )
+
+    def _degrade_and_restore(self, exc: Exception) -> bool:
+        """Absorb one HBM-OOM fault on the SAME backend: take one rung of
+        the degradation ladder, then restore every in-flight request from
+        its host-truth snapshot. The faulted step may already have
+        advanced device state past the last *processed* result (the
+        dispatched block's outputs are lost with the exception), so a
+        blind re-step would silently skip those tokens — restoring from
+        snapshots recomputes them deterministically instead (same key
+        chain, same step counts: greedy output stays token-identical).
+        Returns False when the ladder is dry or the restore itself
+        faults; the caller then falls through to the rebuild hammer."""
+        rung = None
+        try:
+            rung = self.core.degrade_for_oom()
+        except Exception:  # noqa: BLE001 — ladder best-effort
+            logger.exception("hbm_oom degradation failed")
+        if rung is None:
+            return False
+        affected = [
+            rid for rid, fut in list(self._futures.items()) if not fut.done()
+        ]
+        self._record_fault_event(affected, "device_fault", reason=FAULT_OOM)
+        for rid in affected:
+            emit_trace_event(rid, "device_fault", reason=FAULT_OOM)
+        try:
+            snaps, lost = self.core.extract_for_rebuild(reuse_pool=True)
+        except Exception:  # noqa: BLE001 — fall through to rebuild
+            logger.exception(
+                "hbm_oom restore: extraction failed; falling through to "
+                "engine rebuild"
+            )
+            return False
+        lost_set = set(lost)
+        restored = 0
+        for snap, deadline_at in snaps:
+            try:
+                self.core.insert_request(snap, deadline_at=deadline_at)
+                restored += 1
+            except Exception:  # noqa: BLE001 — per-row isolation
+                logger.exception(
+                    "hbm_oom restore: re-insert of %s failed; requeueing",
+                    snap.rid,
+                )
+                lost_set.add(snap.rid)
+        for rid in lost_set:
+            fut = self._futures.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_result(HandoffOutput(rid=rid, snapshot=None, emitted=0))
+        self._record_fault_event(
+            affected,
+            "oom_degraded",
+            rung=rung,
+            restored=restored,
+            requeued=len(lost_set),
+        )
+        logger.warning(
+            "hbm_oom (%s) absorbed by degradation ladder (%s): %d "
+            "request(s) restored in place, %d requeued",
+            exc,
+            rung,
+            restored,
+            len(lost_set),
+        )
+        return True
+
+    def _rebuild_after_fault(self, reason: str, exc: Exception) -> bool:
+        """On the engine thread: contain a classified device fault by
+        rebuilding the EngineCore in a fresh backend in-process. Every
+        restorable request re-inserts from its snapshot and resumes
+        (greedy token-identical — same key chain, same step counts);
+        rows wedged in the faulted dispatch resolve with a snapshot-less
+        HandoffOutput so the worker requeues them whole. Returns False
+        to fall through to the batch-abort path (rebuild unavailable or
+        itself failed). A recovery that *hangs* — the device wedged so
+        hard that even extraction or the rebuild blocks forever — trips
+        the hard-exit backstop, and the orphan janitor reclaims this
+        worker's queue."""
+        logger.error(
+            "device fault (%s): %s — attempting in-process engine rebuild",
+            reason,
+            exc,
+        )
+        self.last_fault_reason = reason
+        if self.on_device_fault is not None:
+            try:
+                self.on_device_fault(reason)
+            except Exception:  # noqa: BLE001 — observer must not block us
+                logger.exception("on_device_fault callback failed")
+        affected = [
+            rid for rid, fut in list(self._futures.items()) if not fut.done()
+        ]
+        self._record_fault_event(affected, "device_fault", reason=reason)
+        for rid in affected:
+            emit_trace_event(rid, "device_fault", reason=reason)
+        timer = threading.Timer(
+            REBUILD_HARD_EXIT_S, _hard_exit_wedged, args=(reason,)
+        )
+        timer.daemon = True
+        timer.start()
+        try:
+            old = self.core
+            try:
+                snaps, lost = old.extract_for_rebuild()
+            except Exception:  # noqa: BLE001 — extraction is best-effort
+                logger.exception(
+                    "fault recovery: extraction failed outright; every "
+                    "in-flight request will requeue"
+                )
+                snaps, lost = [], list(affected)
+            old.stop_watchdog()
+            old_wd = getattr(old, "watchdog", None)
+            if old_wd is not None:
+                self._prior_watchdog_trips += old_wd.trips
+            try:
+                new_core = self.rebuild_core()
+            except Exception:  # noqa: BLE001 — fall back to batch abort
+                logger.exception(
+                    "fault recovery: rebuild failed; aborting the batch"
+                )
+                return False
+            self.core = new_core
+            del old  # free the faulted backend's buffers before stepping
+            self.engine_rebuilds += 1
+            lost_set = set(lost)
+            restored = 0
+            for snap, deadline_at in snaps:
+                try:
+                    new_core.insert_request(snap, deadline_at=deadline_at)
+                    restored += 1
+                except Exception:  # noqa: BLE001 — per-row isolation
+                    logger.exception(
+                        "fault recovery: re-insert of %s failed; requeueing",
+                        snap.rid,
+                    )
+                    lost_set.add(snap.rid)
+            # Wedged / unsnapshottable rows recover via the worker's
+            # existing republish path: a snapshot-less HandoffOutput is
+            # a plain requeue.
+            for rid in lost_set:
+                fut = self._futures.get(rid)
+                if fut is not None and not fut.done():
+                    fut.set_result(
+                        HandoffOutput(rid=rid, snapshot=None, emitted=0)
+                    )
+            self._record_fault_event(
+                affected,
+                "engine_rebuilt",
+                restored=restored,
+                requeued=len(lost_set),
+            )
+            for rid in affected:
+                emit_trace_event(rid, "engine_rebuilt")
+            logger.warning(
+                "engine rebuilt in-process after %s: %d request(s) "
+                "restored, %d requeued",
+                reason,
+                restored,
+                len(lost_set),
+            )
+            return True
+        finally:
+            timer.cancel()
 
     # --- engine thread ----------------------------------------------------
     def _run_handoff(self) -> None:
@@ -3383,7 +3885,13 @@ class AsyncEngine:
                     fut = self._futures.get(out.rid)
                     if fut is not None and not fut.done():
                         fut.set_result(out)
-            except Exception:  # noqa: BLE001 — keep the loop alive
+            except Exception as exc:  # noqa: BLE001 — keep the loop alive
+                reason = classify_failure(exc)
+                if reason == FAULT_OOM and self._degrade_and_restore(exc):
+                    continue
+                if reason is not None and self.rebuild_core is not None:
+                    if self._rebuild_after_fault(reason, exc):
+                        continue
                 logger.exception("engine step failed")
                 # Fail all in-flight requests AND clear the core's batch:
                 # re-stepping a half-updated batch would loop hot on the
@@ -3397,9 +3905,18 @@ class AsyncEngine:
                         self._intake.get_nowait()
                     except queue.Empty:
                         break
+                # Classified device faults keep their class on the way
+                # out so the worker dead-letters with a precise
+                # x-failure-reason; everything else is byte-identical to
+                # the pre-containment path.
+                failure: Exception = (
+                    DeviceFaultError(reason, f"engine step failed: {exc}")
+                    if reason is not None
+                    else RuntimeError("engine step failed")
+                )
                 for fut in list(self._futures.values()):
                     if not fut.done():
-                        fut.set_exception(RuntimeError("engine step failed"))
+                        fut.set_exception(failure)
         # Loop exit (shutdown): catch the host up so in-flight steps are
         # processed and deferred pages release — the last futures resolve
         # several iterations before the run-ahead pipeline fully lands,
@@ -3408,3 +3925,4 @@ class AsyncEngine:
             self.core._drain([])
         except Exception:  # noqa: BLE001 — best-effort cleanup
             logger.exception("drain on shutdown failed")
+        self.core.stop_watchdog()

@@ -1,0 +1,175 @@
+"""Dispatch watchdog: detect wedged device calls from a side thread.
+
+A wedged XLA dispatch (device hang, stuck DMA, driver deadlock) blocks
+the engine thread inside an uninterruptible C++ call — no Python-level
+timeout above it can fire, which is exactly how the reference stack
+loses workers. The watchdog does not try to interrupt the call (nothing
+can, short of killing the process); it *detects* the overrun from a side
+thread so the rest of the process — the worker's event loop, heartbeats,
+the recovery ladder — can act: advertise the wedge in
+``last_dispatch_ok_age_s``, raise :class:`HungDispatchError` once the
+call finally returns, or let the janitor reclaim the worker.
+
+Deadlines are derived from the live per-kind dispatch histograms:
+``deadline = max(min_s, p99(kind) * mult)``. Until a kind has history
+(or for kinds that never get a histogram, like snapshot gathers) the
+deadline is the floor alone. The whole feature defaults off
+(``mult <= 0``): no thread is started and the engine's bracketing helper
+returns a shared no-op context, so the hot path is byte-identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from llmq_tpu.core.faults import HungDispatchError
+
+logger = logging.getLogger("llmq_tpu.watchdog")
+
+# Shared no-op bracket for the default-off path: stateless, reusable,
+# allocation-free at the call sites.
+NO_GUARD = contextlib.nullcontext()
+
+
+class DispatchWatchdog:
+    """Monotonic-deadline monitor for device dispatch/fetch brackets.
+
+    One bracket is active at a time (the engine thread is the only
+    dispatcher); the monitor thread polls it and records a trip when the
+    deadline passes. The trip is surfaced twice: immediately via
+    ``on_trip`` (for logging / external alarms, called on the monitor
+    thread) and — if the wedged call eventually returns — as a
+    :class:`HungDispatchError` raised from the bracket's ``__exit__`` on
+    the engine thread, where the normal fault-recovery ladder handles it.
+    """
+
+    def __init__(
+        self,
+        *,
+        mult: float,
+        min_s: float,
+        percentile_fn: Callable[[str], Optional[float]],
+        on_trip: Optional[Callable[[str, float, float], None]] = None,
+        poll_s: float = 0.05,
+    ) -> None:
+        self.mult = float(mult)
+        self.min_s = float(min_s)
+        self._percentile = percentile_fn
+        self._on_trip = on_trip
+        self._poll_s = poll_s
+        self._lock = threading.Lock()
+        # (kind, started_monotonic, deadline_seconds) of the bracket in
+        # flight, or None between brackets.
+        self._current: Optional[Tuple[str, float, float]] = None
+        # (kind, elapsed, deadline) recorded by the monitor for the
+        # current bracket; cleared on bracket exit.
+        self._tripped: Optional[Tuple[str, float, float]] = None
+        self.trips = 0
+        self._last_ok = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="llmq-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    # --- deadline math ----------------------------------------------------
+    def deadline_for(self, kind: str) -> float:
+        """``max(min_s, p99 * mult)``; the floor alone without history."""
+        try:
+            p99 = self._percentile(kind)
+        except Exception:  # noqa: BLE001 — deadline math must never raise
+            p99 = None
+        if p99 is None:
+            return self.min_s
+        return max(self.min_s, float(p99) * self.mult)
+
+    # --- bracketing -------------------------------------------------------
+    def guard(self, kind: str) -> "_Guard":
+        return _Guard(self, kind)
+
+    # --- liveness surface -------------------------------------------------
+    def last_ok_age_s(self) -> float:
+        """Seconds since a bracketed device call last completed cleanly.
+        Grows without bound while a call is wedged (the heartbeat keeps
+        publishing it from the event loop — that asymmetry is the whole
+        point)."""
+        return time.monotonic() - self._last_ok
+
+    def wedged_kind(self) -> Optional[str]:
+        """Kind of the currently-overdue in-flight bracket, or None."""
+        with self._lock:
+            cur, tripped = self._current, self._tripped
+        if cur is not None and tripped is not None:
+            return cur[0]
+        return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    # --- monitor thread ---------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                cur, tripped = self._current, self._tripped
+            if cur is None or tripped is not None:
+                continue
+            kind, started, deadline = cur
+            elapsed = time.monotonic() - started
+            if elapsed <= deadline:
+                continue
+            with self._lock:
+                # Re-check under the lock: the bracket may have exited
+                # (or a new one started) while we computed elapsed.
+                if self._current is not cur or self._tripped is not None:
+                    continue
+                self._tripped = (kind, elapsed, deadline)
+                self.trips += 1
+            logger.error(
+                "watchdog trip: %s dispatch wedged for %.2fs "
+                "(deadline %.2fs); engine thread cannot be interrupted",
+                kind,
+                elapsed,
+                deadline,
+            )
+            if self._on_trip is not None:
+                try:
+                    self._on_trip(kind, elapsed, deadline)
+                except Exception:  # noqa: BLE001 — observer must not kill us
+                    logger.exception("watchdog on_trip callback failed")
+
+
+class _Guard:
+    """One dispatch/fetch bracket. Raises :class:`HungDispatchError` on
+    clean exit if the monitor tripped while the call was in flight; an
+    exception already propagating out of the call takes precedence."""
+
+    __slots__ = ("_wd", "_kind")
+
+    def __init__(self, wd: DispatchWatchdog, kind: str) -> None:
+        self._wd = wd
+        self._kind = kind
+
+    def __enter__(self) -> "_Guard":
+        wd = self._wd
+        deadline = wd.deadline_for(self._kind)
+        with wd._lock:
+            wd._current = (self._kind, time.monotonic(), deadline)
+            wd._tripped = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        wd = self._wd
+        with wd._lock:
+            tripped = wd._tripped
+            wd._current = None
+            wd._tripped = None
+        if exc_type is None:
+            if tripped is not None:
+                raise HungDispatchError(*tripped)
+            wd._last_ok = time.monotonic()
+        return False

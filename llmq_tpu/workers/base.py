@@ -47,6 +47,7 @@ from llmq_tpu.broker.manager import (
     kv_fetch_queue_name,
 )
 from llmq_tpu.core.config import Config, get_config
+from llmq_tpu.core.faults import DeviceFaultError
 from llmq_tpu.core.models import Job, Result, WorkerHealth, utcnow
 from llmq_tpu.core.pipeline import PipelineConfig
 from llmq_tpu.obs import (
@@ -576,6 +577,34 @@ class BaseWorker(abc.ABC):
                 job.id, "dropped", worker_id=self.worker_id, reason=str(exc)
             )
             await message.ack()
+        except DeviceFaultError as exc:
+            # Classified device fault the engine could not absorb
+            # in-process (rebuild unavailable/failed, OOM ladder dry).
+            # Same requeue/quarantine ladder as a generic engine error,
+            # but the machine-readable class (hung_dispatch, hbm_oom, ...)
+            # rides the dead-letter / quarantine headers so `monitor
+            # errors` distinguishes a wedged chip from a bad job.
+            self.logger.warning(
+                "Job %s hit device fault %s (delivery %d), requeueing: %s",
+                job.id,
+                exc.failure_reason,
+                message.delivery_count,
+                exc,
+                extra={"job_id": job.id},
+            )
+            self.jobs_failed += 1
+            reason = exc.failure_reason
+            self._remember_failure(job.id, reason)
+            self._note_engine_failure(reason)
+            if await self._maybe_quarantine(job, message, trace, reason=reason):
+                return
+            emit_trace_event(
+                job.id, "requeued", worker_id=self.worker_id, reason=reason
+            )
+            self._note_retry_exhausted(
+                job, message.delivery_count, trace, reason=reason
+            )
+            await message.reject(requeue=True)
         except Exception as exc:  # noqa: BLE001 — transient: requeue
             self.logger.warning(
                 "Job %s failed (delivery %d), requeueing: %s",
@@ -818,17 +847,33 @@ class BaseWorker(abc.ABC):
             reconnects=stats.reconnects if stats is not None else None,
             metrics=get_registry().summary() or None,
             prefix_chains=self._prefix_chains(),
+            last_dispatch_ok_age_s=self._dispatch_ok_age(),
         )
         try:
+            # The liveness field is excluded (not serialized as null) when
+            # the watchdog is off, so default-config heartbeat payloads
+            # stay byte-identical to pre-watchdog workers.
             await self.broker.broker.publish(
                 self.queue + HEALTH_SUFFIX,
-                health.model_dump_json().encode("utf-8"),
+                health.model_dump_json(
+                    exclude=(
+                        {"last_dispatch_ok_age_s"}
+                        if health.last_dispatch_ok_age_s is None
+                        else None
+                    )
+                ).encode("utf-8"),
             )
         except Exception:  # noqa: BLE001 — heartbeats are best-effort
             self.logger.debug("Heartbeat publish failed", exc_info=True)
 
     def _engine_stats(self) -> Optional[dict]:
         """Subclasses may surface engine metrics (batch occupancy etc.)."""
+        return None
+
+    def _dispatch_ok_age(self) -> Optional[float]:
+        """Seconds since the engine's last clean device dispatch, or None
+        when no watchdog is running (the default — the heartbeat field is
+        then omitted entirely)."""
         return None
 
     def _stats_with_robustness(self) -> Optional[dict]:

@@ -175,6 +175,13 @@ class TPUWorker(BaseWorker):
         await loop.run_in_executor(None, self._autotune_kernel)
         await loop.run_in_executor(None, self._autotune_tp_overlap)
         self.engine = await loop.run_in_executor(None, self._build_engine)
+        # The fault callback fires on the engine thread mid-recovery;
+        # breaker accounting belongs on the event loop.
+        self.engine.on_device_fault = (
+            lambda reason: loop.call_soon_threadsafe(
+                self._note_device_fault, reason
+            )
+        )
         self.logger.info("Engine ready: %s", self.engine.stats())
 
     def _model_config_host(self):
@@ -265,10 +272,14 @@ class TPUWorker(BaseWorker):
             return names.get(str(kv).lower(), "bfloat16")
         return "float32" if self._dtype == "float32" else "bfloat16"
 
-    def _build_engine(self):
+    def _build_core(self):
+        """Construct a fresh EngineCore (mesh, params, compiled programs)
+        — the unit the device-fault recovery path rebuilds in-process.
+        First build and post-fault rebuilds share this exact code so a
+        recovered engine is configured identically to the original."""
         import jax.numpy as jnp
 
-        from llmq_tpu.engine.engine import AsyncEngine, EngineConfig, EngineCore
+        from llmq_tpu.engine.engine import EngineConfig, EngineCore
         from llmq_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer
         from llmq_tpu.models.transformer import init_params
         from llmq_tpu.parallel import make_mesh
@@ -388,14 +399,43 @@ class TPUWorker(BaseWorker):
             kv_dtype=dtype if kv in (None, "", "auto") else kv,
             **overrides,
         )
-        core = EngineCore(
+        return EngineCore(
             model_config,
             params,
             tokenizer,
             mesh=mesh,
             engine_config=engine_config,
         )
-        return AsyncEngine(core)
+
+    def _build_engine(self):
+        from llmq_tpu.engine.engine import AsyncEngine
+
+        engine = AsyncEngine(self._build_core())
+        # Device-fault containment wiring: the engine thread calls
+        # rebuild_core() to replace a faulted EngineCore in-process.
+        # on_device_fault feeds the circuit breaker from the event loop
+        # (set in _initialize_processor, where the loop is known).
+        engine.rebuild_core = self._rebuild_core
+        return engine
+
+    def _rebuild_core(self):
+        """Called on the engine thread by the fault-recovery path: drop
+        the compiled programs referencing the faulted backend, then build
+        a fresh EngineCore through the same path as startup."""
+        import jax
+
+        try:
+            jax.clear_caches()
+        except Exception:  # noqa: BLE001 — stale cache entries are inert
+            self.logger.debug("jax.clear_caches failed", exc_info=True)
+        return self._build_core()
+
+    def _note_device_fault(self, reason: str) -> None:
+        """Event-loop side of a device fault: count it against the
+        circuit breaker so repeated rebuilds self-drain this worker even
+        when every individual recovery succeeds."""
+        self.logger.error("Engine reported device fault: %s", reason)
+        self._note_engine_failure(reason)
 
     async def _handoff_in_flight(self) -> None:
         """SIGTERM drain-with-handoff: extract every unfinished request
@@ -809,6 +849,10 @@ class TPUWorker(BaseWorker):
                     params=params,
                     **gen_kw,
                 )
+        # Project any fault-recovery events the engine recorded for this
+        # request (device_fault → engine_rebuilt) onto its trace, whether
+        # it completed after a restore or comes back as a handoff below.
+        self._trace_fault_events(job.id)
         if getattr(out, "finish_reason", None) == "deadline_exceeded":
             # The engine's sweep expired the request between decode
             # blocks: terminal dead-letter, not a (truncated) result.
@@ -828,6 +872,20 @@ class TPUWorker(BaseWorker):
         }
         self._trace_engine_timing(job.id, out)
         return out.text
+
+    def _trace_fault_events(self, job_id: str) -> None:
+        """Move the engine's per-request fault-recovery events onto the
+        request trace at their original monotonic stamps."""
+        if self.engine is None:
+            return
+        events = self.engine.pop_fault_events(job_id)
+        if not events:
+            return
+        trace = self._job_traces.get(job_id)
+        if trace is None:
+            return
+        for name, t_mono, fields in events:
+            trace_event_at(trace, name, t_mono, **fields)
 
     def _trace_engine_timing(self, job_id: str, out) -> None:
         """Backfill the engine's monotonic lifecycle stamps into the
@@ -865,10 +923,23 @@ class TPUWorker(BaseWorker):
             result.usage = usage
         return result
 
+    def _dispatch_ok_age(self):
+        if self.engine is None:
+            return None
+        watchdog = getattr(self.engine.core, "watchdog", None)
+        if watchdog is None:
+            return None
+        return round(watchdog.last_ok_age_s(), 3)
+
     def _engine_stats(self):
         if self.engine is None:
             return None
         stats = self.engine.stats()
+        # Superset-only: rebuild accounting appears once a fault happened.
+        if self.engine.engine_rebuilds:
+            stats["engine_rebuilds"] = self.engine.engine_rebuilds
+            if self.engine.last_fault_reason:
+                stats["last_fault_reason"] = self.engine.last_fault_reason
         if self.config.prefix_affinity:
             stats = {
                 **stats,

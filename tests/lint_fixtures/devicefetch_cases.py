@@ -1,0 +1,55 @@
+"""Fixture for the unguarded-device-fetch checker.
+
+A class that uses watchdog brackets (``with self._wd(...)`` /
+``.guard(...)``) has adopted the fetch discipline: every host-blocking
+device read in it must sit under a bracket or carry a justified pragma.
+Classes without brackets are exempt.
+"""
+
+import contextlib
+
+import numpy as np
+
+
+class GuardedEngine:
+    """Bracket-disciplined: contains ``with self._wd(...)`` blocks."""
+
+    def _wd(self, kind):
+        return contextlib.nullcontext()
+
+    def dispatch(self, out):
+        with self._wd("decode_block"):
+            tokens = np.asarray(out)  # bracketed: monitored, fine
+        return tokens
+
+    def explicit_guard(self, wd, out):
+        with wd.guard("prefill"):
+            return np.asarray(out)  # bracketed via .guard(): fine
+
+    def fetch_unguarded(self, out):
+        return np.asarray(out)  # EXPECT[unguarded-device-fetch]
+
+    def fetch_array(self, out):
+        return np.array(out)  # EXPECT[unguarded-device-fetch]
+
+    def fetch_device_get(self, out):
+        import jax
+
+        return jax.device_get(out)  # EXPECT[unguarded-device-fetch]
+
+    def fetch_blocking(self, out):
+        out.block_until_ready()  # EXPECT[unguarded-device-fetch]
+        with self._wd("verify"):
+            out.block_until_ready()  # bracketed: fine
+
+    def fetch_host_only(self, probe):
+        # Host-side shape probe on a freshly-built numpy input — a
+        # legitimate unbracketed read, justified at the call site.
+        return np.asarray(probe).shape  # llmq: ignore[unguarded-device-fetch]
+
+
+class HostOnlyHelper:
+    """No brackets anywhere: discipline not adopted, reads are exempt."""
+
+    def collect(self, buf):
+        return np.asarray(buf)

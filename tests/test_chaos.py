@@ -14,7 +14,7 @@ import time
 
 import pytest
 
-from llmq_tpu.broker.chaos import ChaosBroker, WorkerKillSwitch
+from llmq_tpu.broker.chaos import ChaosBroker, DeviceFaultInjector, WorkerKillSwitch
 from llmq_tpu.broker.manager import (
     HEALTH_SUFFIX,
     BrokerManager,
@@ -461,6 +461,164 @@ class TestChaosTrace:
         assert claimed["delivery_count"] == 1
         walls = [e["t_wall"] for e in trace["events"]]
         assert walls == sorted(walls)
+
+
+class TestDeviceFaults:
+    """Device-fault containment invariant: a device fault mid-run (hung
+    dispatch, XLA runtime error, HBM OOM past the degradation ladder)
+    costs exactly one in-process engine rebuild — every job still ends as
+    exactly one result, greedy token-identical to a fault-free baseline,
+    and the affected requests' traces carry ``device_fault`` →
+    ``engine_rebuilt``."""
+
+    # (mode, seed). All inject on a decode dispatch; each mode exercises
+    # a different classification + detection path:
+    #   hang      — watchdog trip → HungDispatchError on the engine thread
+    #   xla_error — classified xla_runtime_error straight from the raise
+    #   oom       — RESOURCE_EXHAUSTED with the ladder already at its
+    #               floor (rung pre-exhausted), so recovery must rebuild
+    LEGS = [("hang", 21), ("xla_error", 22), ("oom", 23)]
+
+    @pytest.mark.parametrize("mode, seed", LEGS, ids=[leg[0] for leg in LEGS])
+    async def test_fault_one_rebuild_exactly_one_identical_result(
+        self, mem_ns, mode, seed, monkeypatch
+    ):
+        from llmq_tpu.obs import trace_from_payload
+
+        jobs = _kill_jobs()
+        want_ids = {j.id for j in jobs}
+        # Baseline runs with the watchdog off (env set below, after).
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, {})
+        assert set(baseline) == want_ids
+
+        if mode == "hang":
+            # Floor must clear cold-start compiles (~0.7 s per program on
+            # CPU, more on loaded CI) yet sit far below the injected
+            # 9 s hang so the trip is unambiguous.
+            monkeypatch.setenv("LLMQ_WATCHDOG_MULT", "5.0")
+            monkeypatch.setenv("LLMQ_WATCHDOG_MIN_S", "4.0")
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("dfq")
+            for j in jobs:
+                await mgr.publish_job("dfq", j)
+
+            w1 = _tpu_worker(mem_ns, "dfq")
+            injector = DeviceFaultInjector(
+                "decode", mode, seed=seed, after_range=(2, 4), hang_s=9.0
+            )
+            orig_build = w1._build_engine
+
+            def build_with_injector():
+                engine = orig_build()
+                engine.core.on_dispatch = injector
+                if mode == "oom":
+                    # Ladder at its floor: every rung already taken, so
+                    # the injected allocation fault must rebuild instead
+                    # of degrading once more.
+                    engine.core._oom_rung = 3
+                return engine
+
+            w1._build_engine = build_with_injector
+            t1 = asyncio.ensure_future(w1.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "dfq.results", want_ids
+                )
+                assert injector.fired, "no decode dispatch matched"
+                rebuilds = w1.engine.engine_rebuilds
+                fault_reason = w1.engine.last_fault_reason
+                trips = w1.engine.watchdog_trips
+            finally:
+                w1.request_shutdown()
+                await asyncio.wait_for(t1, timeout=120.0)
+
+        assert rebuilds == 1, f"expected exactly one rebuild, got {rebuilds}"
+        expected_reason = {
+            "hang": "hung_dispatch",
+            "xla_error": "xla_runtime_error",
+            "oom": "hbm_oom",
+        }[mode]
+        assert fault_reason == expected_reason
+        if mode == "hang":
+            assert trips == 1, f"watchdog_trips={trips}, want exactly 1"
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged from fault-free run under {mode}"
+            )
+        # Affected requests' traces must carry the recovery timeline, in
+        # order: the fault, then the rebuild that restored them.
+        fault_traced = 0
+        for p in payloads:
+            trace = trace_from_payload(p)
+            if trace is None:
+                continue
+            names = [e["name"] for e in trace["events"]]
+            if "device_fault" in names:
+                fault_traced += 1
+                assert "engine_rebuilt" in names, names
+                assert names.index("device_fault") < names.index(
+                    "engine_rebuilt"
+                ), names
+        assert fault_traced >= 1, "no trace recorded the device fault"
+
+    async def test_oom_ladder_absorbs_first_fault_without_rebuild(
+        self, mem_ns
+    ):
+        """A fresh engine's first HBM OOM degrades (ladder) instead of
+        rebuilding: the retried step succeeds, no request is disturbed,
+        and stats record the rung taken — hbm_oom_events / a
+        shrink_runahead degradation — with engine_rebuilds absent."""
+        jobs = _kill_jobs()
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, {})
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("olq")
+            for j in jobs:
+                await mgr.publish_job("olq", j)
+            w1 = _tpu_worker(mem_ns, "olq")
+            injector = DeviceFaultInjector(
+                "decode", "oom", seed=31, after_range=(2, 4)
+            )
+            orig_build = w1._build_engine
+
+            def build_with_injector():
+                engine = orig_build()
+                engine.core.on_dispatch = injector
+                return engine
+
+            w1._build_engine = build_with_injector
+            t1 = asyncio.ensure_future(w1.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "olq.results", want_ids
+                )
+                assert injector.fired
+                stats = w1.engine.stats()
+                rebuilds = w1.engine.engine_rebuilds
+            finally:
+                w1.request_shutdown()
+                await asyncio.wait_for(t1, timeout=120.0)
+
+        assert rebuilds == 0, "ladder-absorbed OOM must not rebuild"
+        assert stats.get("hbm_oom_events") == 1
+        # With no prefix cold tier configured, the first live rung is the
+        # run-ahead shrink.
+        assert stats.get("oom_degradations") == ["shrink_runahead"]
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged across the OOM degradation"
+            )
 
 
 # ≥256 chars so text_prefix_chain yields a digest — jobs sharing it look

@@ -38,6 +38,7 @@ CASES = [
     ("wallclock_cases.py", {"wallclock-duration"}),
     ("pickle_cases.py", {"pickle-snapshot"}),
     ("hostbuffer_cases.py", {"unbounded-host-buffer"}),
+    ("devicefetch_cases.py", {"unguarded-device-fetch"}),
 ]
 
 

@@ -171,12 +171,13 @@ def test_ladders_parse():
     """Both runbooks yield their full command ladders (a parser that
     silently matches nothing would make every other test vacuous)."""
     names = [name for name, _, _ in all_steps()]
-    assert sum(n.startswith("hardware_session") for n in names) >= 9
-    assert sum(n.startswith("chip_watch") for n in names) >= 16
+    assert sum(n.startswith("hardware_session") for n in names) >= 10
+    assert sum(n.startswith("chip_watch") for n in names) >= 17
     joined = " ".join(names)
     assert "kernel_v123" in joined and "queue_drain_tpu" in joined
     assert "metrics_probe" in joined
     assert "fleet_chaos_probe" in joined
+    assert "engine_fault_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -328,6 +329,24 @@ def test_fleet_chaos_probe_runs():
     assert "shed leg ok" in proc.stdout
     assert "governor leg ok" in proc.stdout
     assert "metric: fleet_chaos_probe_ok" in proc.stdout
+
+
+def test_engine_fault_probe_runs():
+    """The device-fault containment rung runs end to end on CPU: a
+    wedged dispatch trips the watchdog and rebuilds the engine
+    in-process with token parity, the HBM-OOM ladder absorbs a first
+    fault without a rebuild (and degrades in order when driven dry),
+    and a classified XLA error recovers every request from snapshots."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/engine_fault_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:engine_fault_probe", proc)
+    assert "hang leg ok" in proc.stdout
+    assert "oom-ladder leg ok" in proc.stdout
+    assert "xla-error leg ok" in proc.stdout
+    assert "metric: engine_fault_probe_ok" in proc.stdout
 
 
 def test_bench_tiny_int4_runs():

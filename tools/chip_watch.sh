@@ -45,6 +45,10 @@ run 900 prefix_probe python tools/prefix_cache_probe.py
 #     memory governor ladder (host-side only; cheap, stays ahead of the
 #     long benches).
 run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
+# 1f. Device-fault containment: watchdog trip -> in-process rebuild,
+#     OOM degradation ladder order, XLA-error snapshot recovery — all
+#     with fault-free token parity (dispatch hooks on the real chip).
+run 900 engine_fault_probe python tools/engine_fault_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

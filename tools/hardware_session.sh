@@ -56,6 +56,11 @@ run 900 prefix_probe python tools/prefix_cache_probe.py
 # (broker + host-side bookkeeping; cheap, keeps the robustness plane
 # honest on the same image the benches run on).
 run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
+# Device-fault containment: watchdog hang detection + in-process engine
+# rebuild, the HBM-OOM degradation ladder, and classified XLA errors —
+# each with token parity against a fault-free run (the dispatch hooks
+# run against the real chip here).
+run 900 engine_fault_probe python tools/engine_fault_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
