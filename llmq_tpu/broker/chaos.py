@@ -179,6 +179,157 @@ class DeviceFaultInjector:
         )
 
 
+#: What a BitFlipInjector can corrupt.
+BITFLIP_TARGETS = ("weight", "kv", "logit")
+
+
+class BitFlipInjector:
+    """Seeded silent-data-corruption trigger for the integrity chaos legs.
+
+    Unlike :class:`DeviceFaultInjector`, nothing raises when this fires:
+    the corruption is *silent* — flipped bytes in a weight leaf, a KV
+    page, or the logit projection — exactly the "mercurial core" /
+    HBM-bit-flip failure mode that passes every crash-shaped check. The
+    tests assert the numerics-integrity plane (logit guards, weight
+    audits, canaries) detects it, classifies it, and recovers.
+
+    Targets:
+
+    - ``weight``: corrupt one element of a seeded-random mid-stack
+      parameter leaf (``leaf`` substring-filters the candidates).
+    - ``logit``: same mechanics pinned to the logit projection
+      (``lm_head``, falling back to ``embed`` for tied embeddings), so
+      the damage shows up in the very next dispatch's logits.
+    - ``kv``: overwrite one page of the K pool (``page`` selects it),
+      poisoning every sequence whose context includes it.
+
+    Modes: ``nan`` plants a NaN (float leaves; guard-visible within one
+    dispatch), ``flip`` flips bits to a *finite* wrong value (silent to
+    the guard's nonfinite lane — the weight audit / canary must catch
+    it). Int8/packed-int4 leaves always bit-flip (no NaN encoding).
+
+    Attach with :meth:`bind` (sets ``core.on_dispatch``); it fires once
+    after a seeded-random number of dispatches. A ``sticky`` injector
+    re-arms on every (re-)bind — bind it to each rebuilt core and the
+    corruption reappears, which is how the tests model a job/chip whose
+    fault deterministically recurs (the poison verdict); non-sticky is
+    the transient: the rebuilt core loads pristine weights and the
+    re-run passes (the device-blame verdict).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        mode: str = "nan",
+        seed: int = 0,
+        after_range=(1, 5),
+        sticky: bool = False,
+        leaf: Optional[str] = None,
+        page: int = 1,
+    ) -> None:
+        if target not in BITFLIP_TARGETS:
+            raise ValueError(
+                f"unknown bitflip target {target!r}; "
+                f"one of {sorted(BITFLIP_TARGETS)}"
+            )
+        if mode not in ("nan", "flip"):
+            raise ValueError(f"unknown bitflip mode {mode!r}")
+        self.target = target
+        self.mode = mode
+        self.sticky = sticky
+        self.leaf = leaf
+        self.page = page
+        self._rng = random.Random(seed)
+        self.after = self._rng.randint(*after_range)
+        self.matched = 0
+        self.fired = 0
+        # Bounded by firings: one entry per arming (sticky re-arms once
+        # per rebuild), and injectors live only for a test/probe run.
+        self.corrupted: list = []  # llmq: ignore[unbounded-host-buffer]
+        self._core = None
+        self._armed = True
+
+    def bind(self, core) -> "BitFlipInjector":
+        """Install on an EngineCore; a sticky injector re-arms so the
+        corruption recurs on the rebuilt core."""
+        self._core = core
+        core.on_dispatch = self
+        if self.sticky:
+            self._armed = True
+            self.matched = 0
+        return self
+
+    def __call__(self, kind: str) -> None:
+        if not self._armed or self._core is None:
+            return
+        self.matched += 1
+        if self.matched < self.after:
+            return
+        self._armed = False
+        self.fired += 1
+        logger.info(
+            "chaos: bit-flip (%s/%s) on %s dispatch #%d",
+            self.target,
+            self.mode,
+            kind,
+            self.matched,
+        )
+        if self.target == "kv":
+            self._corrupt_kv()
+        else:
+            self._corrupt_param()
+
+    # --- corruption mechanics (engine thread, like a real flip would) ---
+    def _corrupt_kv(self) -> None:
+        import jax.numpy as jnp
+
+        core = self._core
+        val = float("nan") if self.mode == "nan" else 7.0
+        core.k_pages = core.k_pages.at[:, self.page].set(
+            jnp.asarray(val, core.k_pages.dtype)
+        )
+        self.corrupted.append(f"k:page{self.page}")
+
+    def _corrupt_param(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        core = self._core
+        leaves = jax.tree_util.tree_flatten_with_path(core.params)[0]
+        want = self.leaf
+        if want is None and self.target == "logit":
+            names = {jax.tree_util.keystr(p) for p, _ in leaves}
+            want = "lm_head" if any("lm_head" in n for n in names) else "embed"
+        cands = sorted(
+            (
+                (jax.tree_util.keystr(path), path, arr)
+                for path, arr in leaves
+                if getattr(arr, "ndim", 0) >= 2
+                and (want is None or want in jax.tree_util.keystr(path))
+            ),
+            key=lambda c: c[0],
+        )
+        if not cands:
+            raise ValueError(f"no corruptible leaf matches {want!r}")
+        name, path, arr = cands[self._rng.randrange(len(cands))]
+        idx = (0,) * arr.ndim
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            if self.mode == "nan":
+                bad = jnp.asarray(jnp.nan, arr.dtype)
+            else:
+                # Finite flip: a wrong value the guard's nonfinite lane
+                # cannot see — only a value-level audit catches it.
+                bad = jnp.asarray(-1.0, arr.dtype) - arr[idx] * 3
+        else:
+            bad = arr[idx] ^ jnp.asarray(0x55, arr.dtype)
+        node = core.params
+        for entry in path[:-1]:
+            node = node[entry.key]
+        node[path[-1].key] = arr.at[idx].set(bad)
+        self.corrupted.append(name)
+
+
 class ChaosBroker(Broker):
     """Fault-injecting decorator over the transport named after ``chaos+``."""
 

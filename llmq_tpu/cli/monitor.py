@@ -328,6 +328,28 @@ def _selfheal_cell(es: dict) -> str:
     return " ".join(parts) if parts else "-"
 
 
+def _integrity_cell(health: WorkerHealth, es: dict) -> str:
+    """Compact numerics-integrity summary: heartbeat verdict plus the
+    corruption counters. Superset-only like the self-heal cell — every
+    field is absent until an integrity knob is on, so a default-config
+    fleet renders "-" and the dashboard stays byte-identical."""
+    parts = []
+    if health.integrity == "suspect":
+        parts.append("[red]SUSPECT[/red]")
+    elif health.integrity == "ok":
+        parts.append("[green]ok[/green]")
+    for key, tag in (
+        ("guard_trips", "grd"),
+        ("weight_audit_mismatches", "wam"),
+        ("canary_failures", "cnr"),
+        ("result_digest_mismatches", "rdm"),
+    ):
+        value = es.get(key)
+        if value:
+            parts.append(f"{tag}:{value}")
+    return " ".join(parts) if parts else "-"
+
+
 def _render_top(
     queue: str,
     beats: Dict[str, WorkerHealth],
@@ -370,6 +392,13 @@ def _render_top(
     show_selfheal = any(
         _selfheal_cell(h.engine_stats or {}) != "-" for h in beats.values()
     )
+    # Same superset discipline for the integrity column: it appears only
+    # once some worker runs with an integrity knob on (or reports a
+    # corruption counter), never for a default-config fleet.
+    show_integrity = any(
+        _integrity_cell(h, h.engine_stats or {}) != "-"
+        for h in beats.values()
+    )
     table = Table(title=f"Worker heartbeats (last {_stale_window_text()})")
     cols = [
         "worker",
@@ -383,6 +412,8 @@ def _render_top(
         "reconnects",
         "last seen",
     ]
+    if show_integrity:
+        cols.insert(8, "integrity")
     if show_selfheal:
         cols.insert(8, "self-heal")
     for col in cols:
@@ -407,6 +438,8 @@ def _render_top(
             str(health.reconnects) if health.reconnects is not None else "-",
             health.last_seen.strftime("%H:%M:%S"),
         ]
+        if show_integrity:
+            cells.insert(8, _integrity_cell(health, es))
         if show_selfheal:
             cells.insert(8, _selfheal_cell(es))
         table.add_row(*cells)

@@ -35,6 +35,7 @@ class ResultReceiver:
         self.is_pipeline_results = is_pipeline_results
         self.broker = BrokerManager(get_config())
         self.received = 0
+        self.digest_mismatches = 0
         self._last_at = time.monotonic()
         self._done = asyncio.Event()
 
@@ -80,6 +81,20 @@ class ResultReceiver:
             result = Result.model_validate_json(message.body)
         except Exception as exc:  # noqa: BLE001 — malformed: drop, don't loop
             logger.error("Dropping malformed result: %s", exc)
+            await message.reject(requeue=False)
+            return
+        # Payload-integrity check: a digest-stamped result whose token
+        # ids no longer hash to their digest was corrupted somewhere
+        # between the worker and here — dead-letter it (requeueing would
+        # redeliver the same corrupt bytes) instead of emitting garbage.
+        if result.verify_token_digest() is False:
+            self.digest_mismatches += 1
+            logger.error(
+                "Result %s failed its token-digest check (%d so far); "
+                "dead-lettering corrupt payload",
+                result.id,
+                self.digest_mismatches,
+            )
             await message.reject(requeue=False)
             return
         sys.stdout.write(result.model_dump_json() + "\n")

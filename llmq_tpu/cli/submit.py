@@ -184,6 +184,7 @@ class JobSubmitter:
         self.stream_idle_timeout = stream_idle_timeout
         self.submitted = 0
         self.received = 0
+        self.digest_mismatches = 0
         self._last_result_at = 0.0
         self._progress: Optional[_SubmitProgress] = None
 
@@ -262,6 +263,18 @@ class JobSubmitter:
         try:
             result = Result.model_validate_json(message.body)
         except Exception:  # noqa: BLE001
+            await message.reject(requeue=False)
+            return
+        # Digest-stamped results that no longer hash clean were corrupted
+        # in flight — dead-letter, count, and keep streaming the rest.
+        if result.verify_token_digest() is False:
+            self.digest_mismatches += 1
+            logger.error(
+                "Result %s failed its token-digest check (%d so far); "
+                "dead-lettering corrupt payload",
+                result.id,
+                self.digest_mismatches,
+            )
             await message.reject(requeue=False)
             return
         sys.stdout.write(result.model_dump_json() + "\n")
@@ -376,12 +389,22 @@ class PipelineSubmitter:
 class _PipelineResultPrinter:
     def __init__(self) -> None:
         self.count = 0
+        self.digest_mismatches = 0
         self.last_at = 0.0
 
     async def on_result(self, message) -> None:
         try:
             result = Result.model_validate_json(message.body)
         except Exception:  # noqa: BLE001
+            await message.reject(requeue=False)
+            return
+        if result.verify_token_digest() is False:
+            self.digest_mismatches += 1
+            logger.error(
+                "Result %s failed its token-digest check; dead-lettering "
+                "corrupt payload",
+                result.id,
+            )
             await message.reject(requeue=False)
             return
         sys.stdout.write(result.model_dump_json() + "\n")

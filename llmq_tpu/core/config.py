@@ -197,6 +197,16 @@ class Config(BaseModel):
         "dispatches. Requires prefill_chunk_size.",
     )
 
+    result_digest: bool = Field(
+        default_factory=lambda: (_env("LLMQ_RESULT_DIGEST") or "").lower()
+        in ("1", "true", "yes", "on"),
+        description="Result-payload integrity: workers attach the emitted "
+        "token_ids plus a blake2b-16 token_digest to every result, and "
+        "the receive/collect paths recompute it — wire/storage corruption "
+        "of a result becomes a counted, dead-letterable event. Off by "
+        "default: result JSON stays byte-identical.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

@@ -18,10 +18,17 @@ FAULT_HUNG = "hung_dispatch"
 FAULT_XLA = "xla_runtime_error"
 FAULT_OOM = "hbm_oom"
 FAULT_MESH = "mesh_error"
+FAULT_NUMERICAL = "numerical_fault"
 
 # Every class above is recoverable by an in-process engine rebuild; the
 # tuple exists so callers can gate on membership rather than string sets.
-DEVICE_FAULT_REASONS = (FAULT_HUNG, FAULT_XLA, FAULT_OOM, FAULT_MESH)
+DEVICE_FAULT_REASONS = (
+    FAULT_HUNG,
+    FAULT_XLA,
+    FAULT_OOM,
+    FAULT_MESH,
+    FAULT_NUMERICAL,
+)
 
 
 class HungDispatchError(RuntimeError):
@@ -45,6 +52,34 @@ class HungDispatchError(RuntimeError):
         self.deadline = deadline
 
 
+class LogitGuardError(RuntimeError):
+    """An on-device numerics guard flagged the logits of a dispatch
+    (non-finite values, out-of-bound magnitude, or an entropy collapse).
+
+    Raised on the engine thread when the guard word fetched alongside a
+    dispatch's tokens trips a threshold. Carries enough context for
+    blame attribution: which check fired, the dispatch kind, and the
+    request ids that were riding the flagged dispatch (``suspects``) —
+    the recovery path re-runs exactly those on a rebuilt core to decide
+    job-poison vs device-fault.
+    """
+
+    def __init__(
+        self,
+        check: str,
+        detail: str,
+        suspects: tuple = (),
+        kind: str = "",
+    ):
+        super().__init__(
+            f"logit guard tripped [{check}] on {kind or 'dispatch'}: {detail}"
+        )
+        self.check = check
+        self.detail = detail
+        self.suspects = tuple(suspects)
+        self.kind = kind
+
+
 class DeviceFaultError(RuntimeError):
     """A classified device fault the engine could not recover from
     in-process (rebuild unavailable, rebuild failed, or the OOM
@@ -65,6 +100,8 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     jaxlib here just to isinstance-check it."""
     if isinstance(exc, HungDispatchError):
         return FAULT_HUNG
+    if isinstance(exc, LogitGuardError):
+        return FAULT_NUMERICAL
     if isinstance(exc, DeviceFaultError):
         return exc.failure_reason
     text = f"{type(exc).__name__}: {exc}".lower()

@@ -133,8 +133,31 @@ class Result(BaseModel):
     usage: Optional[Dict[str, int]] = Field(
         None, description="Token accounting: prompt_tokens/completion_tokens"
     )
+    token_ids: Optional[List[int]] = Field(
+        None,
+        description="Emitted token ids (LLMQ_RESULT_DIGEST=on workers "
+        "only): the payload the integrity digest covers, and the "
+        "bit-exact record parity checks compare.",
+    )
+    token_digest: Optional[str] = Field(
+        None,
+        description="blake2b-16 hex over token_ids (engine/integrity."
+        "token_fold). Receivers recompute it so wire/storage corruption "
+        "of a result becomes a counted, dead-letterable event instead "
+        "of silently delivered garbage. None = worker didn't opt in.",
+    )
 
     model_config = ConfigDict(extra="allow")
+
+    def verify_token_digest(self) -> Optional[bool]:
+        """Recompute the payload digest. ``None`` when the producing
+        worker didn't attach one (pre-integrity workers — nothing to
+        verify), else whether the digest matches the token ids."""
+        if self.token_digest is None or self.token_ids is None:
+            return None
+        from llmq_tpu.utils.hashing import token_fold
+
+        return token_fold(self.token_ids) == self.token_digest
 
 
 class QueueStats(BaseModel):
@@ -191,6 +214,14 @@ class WorkerHealth(BaseModel):
         "the wedge signature `monitor top` and the affinity janitor key "
         "on. None when the watchdog is off (the default).",
     )
+    integrity: Optional[str] = Field(
+        None,
+        description="Numerics-integrity verdict: 'ok' while the guards/"
+        "audits/canaries are clean, 'suspect' once any of them caught "
+        "value-level corruption (the affinity janitor reclaims the queue "
+        "of a worker that keeps failing canaries). None when every "
+        "integrity knob is off (the default).",
+    )
 
 
 class ErrorInfo(BaseModel):
@@ -206,7 +237,7 @@ class ErrorInfo(BaseModel):
         None,
         description="Machine-readable failure class (engine_error, "
         "deadline_exceeded, unparseable, or a device-fault class: "
-        "hung_dispatch, xla_runtime_error, hbm_oom, mesh_error) — the "
-        "fingerprint the poison-job quarantine keys on; None for "
-        "pre-quarantine records.",
+        "hung_dispatch, xla_runtime_error, hbm_oom, mesh_error, "
+        "numerical_fault) — the fingerprint the poison-job quarantine "
+        "keys on; None for pre-quarantine records.",
     )

@@ -76,8 +76,10 @@ except ImportError:  # jax 0.4.x: same pair, pre-rename names
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmq_tpu.core.faults import (
+    FAULT_NUMERICAL,
     FAULT_OOM,
     DeviceFaultError,
+    LogitGuardError,
     classify_failure,
 )
 from llmq_tpu.engine import sampling as sampling_mod
@@ -267,6 +269,41 @@ class EngineConfig:
     # compiles and empty histograms (a kind with no history uses the
     # floor alone). LLMQ_WATCHDOG_MIN_S pins over this.
     watchdog_min_s: float = 30.0
+    # On-device logit guards: "on" folds cheap silent-corruption
+    # reductions (any-NaN/Inf count, max |logit|, min row entropy) into
+    # every decode/prefill/mixed/verify dispatch and ships the verdict
+    # home alongside the sampled tokens — zero extra host syncs. A trip
+    # raises the new ``numerical_fault`` class, and blame attribution
+    # (re-run the suspects once on a rebuilt core) decides job-poison vs
+    # device-fault. "off" (default) traces the literal pre-existing
+    # programs. LLMQ_LOGIT_GUARD pins over this.
+    logit_guard: str = "off"
+    # Guard threshold: any finite logit magnitude above this trips the
+    # "logit_max" check. 0 disables the magnitude check (the guard then
+    # watches non-finites, plus entropy if enabled). Trace-time constant
+    # — changing it retraces. LLMQ_GUARD_LOGIT_MAX pins over this.
+    guard_logit_max: float = 0.0
+    # Guard threshold: a masked row whose softmax entropy falls below
+    # this many nats trips the "entropy_collapse" check (a corrupted
+    # lm_head row or a stuck accumulator collapses the distribution to
+    # near-determinism at positions where healthy models stay broad).
+    # 0 disables. LLMQ_GUARD_ENTROPY_MIN pins over this.
+    guard_entropy_min: float = 0.0
+    # Background weight-audit cadence in seconds (0 = off): the engine
+    # digests every parameter leaf on device at build, then re-digests
+    # during idle steps at this cadence (and on demand after any guard
+    # trip); a changed leaf means the HBM copy of the weights rotted,
+    # distinguishing persistent corruption from a transient compute
+    # error. LLMQ_WEIGHT_AUDIT_EVERY pins over this.
+    weight_audit_every: float = 0.0
+    # Canary self-test cadence in seconds (0 = off): a deterministic
+    # golden prompt is generated greedily at engine build and replayed
+    # during idle steps at this cadence (and after any suspicion);
+    # anything but a bit-exact token match counts a canary failure,
+    # which the worker advertises in its heartbeat so the janitor can
+    # reclaim a chip that keeps failing. LLMQ_CANARY_EVERY pins over
+    # this.
+    canary_every: float = 0.0
 
     def __post_init__(self):
         self.decode_block = int(self.decode_block)
@@ -313,6 +350,31 @@ class EngineConfig:
         if self.watchdog_min_s <= 0:
             raise ValueError(
                 f"watchdog_min_s={self.watchdog_min_s} (want > 0)"
+            )
+        self.logit_guard = str(self.logit_guard).lower()
+        if self.logit_guard not in ("off", "on"):
+            raise ValueError(
+                f"logit_guard={self.logit_guard!r} (want off|on)"
+            )
+        self.guard_logit_max = float(self.guard_logit_max)
+        if self.guard_logit_max < 0:
+            raise ValueError(
+                f"guard_logit_max={self.guard_logit_max} (want >= 0)"
+            )
+        self.guard_entropy_min = float(self.guard_entropy_min)
+        if self.guard_entropy_min < 0:
+            raise ValueError(
+                f"guard_entropy_min={self.guard_entropy_min} (want >= 0)"
+            )
+        self.weight_audit_every = float(self.weight_audit_every)
+        if self.weight_audit_every < 0:
+            raise ValueError(
+                f"weight_audit_every={self.weight_audit_every} (want >= 0)"
+            )
+        self.canary_every = float(self.canary_every)
+        if self.canary_every < 0:
+            raise ValueError(
+                f"canary_every={self.canary_every} (want >= 0)"
             )
         if isinstance(self.kv_dtype, str):
             names = {
@@ -363,8 +425,9 @@ def _prefill_buckets(cfg: EngineConfig, sp: int = 1) -> List[int]:
 # Pipeline entry: (dispatch index, kind "prefill"|"decode", device
 #                  out-token array — or a (candidates, accept-counts)
 #                  pair under speculative decoding —,
-#                  [(row-in-out, Sequence), ...] snapshot)
-_Pending = Tuple[int, str, Any, List[Tuple[int, Sequence]]]
+#                  [(row-in-out, Sequence), ...] snapshot,
+#                  guard (stats, bad-rows) device pair or None)
+_Pending = Tuple[int, str, Any, List[Tuple[int, Sequence]], Any]
 
 
 class EngineCore:
@@ -590,6 +653,51 @@ class EngineCore:
         self.watchdog_mult = wd_mult
         self.watchdog_min_s = wd_min
         self.watchdog: Optional[DispatchWatchdog] = None
+        # Numerics-integrity knobs: env pins over config like the knobs
+        # above. The guard flag and its thresholds are resolved before
+        # _build_steps because they are trace-time constants — "off"
+        # traces the literal pre-existing programs.
+        guard = os.environ.get("LLMQ_LOGIT_GUARD", "").lower()
+        if guard in ("on", "off"):
+            self.logit_guard = guard
+        else:
+            self.logit_guard = self.cfg.logit_guard
+        self.guard_logit_max = self.cfg.guard_logit_max
+        env_gmax = os.environ.get("LLMQ_GUARD_LOGIT_MAX", "").strip()
+        if env_gmax:
+            try:
+                self.guard_logit_max = float(env_gmax)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_GUARD_LOGIT_MAX={env_gmax!r} is not a number"
+                ) from None
+        self.guard_entropy_min = self.cfg.guard_entropy_min
+        env_gent = os.environ.get("LLMQ_GUARD_ENTROPY_MIN", "").strip()
+        if env_gent:
+            try:
+                self.guard_entropy_min = float(env_gent)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_GUARD_ENTROPY_MIN={env_gent!r} is not a number"
+                ) from None
+        self.weight_audit_every = self.cfg.weight_audit_every
+        env_audit = os.environ.get("LLMQ_WEIGHT_AUDIT_EVERY", "").strip()
+        if env_audit:
+            try:
+                self.weight_audit_every = float(env_audit)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_WEIGHT_AUDIT_EVERY={env_audit!r} is not a number"
+                ) from None
+        self.canary_every = self.cfg.canary_every
+        env_canary = os.environ.get("LLMQ_CANARY_EVERY", "").strip()
+        if env_canary:
+            try:
+                self.canary_every = float(env_canary)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_CANARY_EVERY={env_canary!r} is not a number"
+                ) from None
         if self.mixed_step == "on" and not self.cfg.prefill_chunk_size:
             raise ValueError(
                 "mixed_step=on requires prefill_chunk_size: the fused "
@@ -673,6 +781,21 @@ class EngineCore:
         self.deadline_expirations = 0  # sequences expired by the sweep
         self.swap_refused = 0  # captures the host-memory governor declined
         self.hbm_oom_events = 0  # allocation faults the ladder absorbed
+        # Numerics-integrity counters (superset-only in stats: all stay
+        # at zero — and their stats keys absent — with the knobs off).
+        self.guard_trips = 0  # dispatches whose on-device guard fired
+        self.weight_audits = 0  # background/on-demand digest sweeps run
+        self.weight_audit_mismatches = 0  # leaves whose HBM digest changed
+        self.kv_spot_checks = 0  # KV page read-stability samples
+        self.canary_runs = 0  # golden-prompt replays
+        self.canary_failures = 0  # replays that were not bit-exact
+        # Leaf paths from the most recent failed audit (bounded: replaced
+        # wholesale per audit, never appended across audits).
+        self._last_audit_mismatch: List[str] = []
+        self._weight_baseline: Optional[Dict[str, Tuple[int, int]]] = None
+        self._canary_golden: Optional[List[int]] = None
+        self._next_weight_audit = 0.0
+        self._next_canary = 0.0
         # HBM-OOM degradation ladder position (monotonic per engine: a
         # pool that OOMed once stays degraded) and the rungs taken, in
         # order, for stats/probes.
@@ -789,6 +912,22 @@ class EngineCore:
                     len(self.prefix_store) if self.prefix_store else 0
                 ),
             ),
+            Gauge(
+                "llmq_integrity_guard_trips",
+                "Dispatches whose on-device logit guard fired",
+                fn=lambda: self.guard_trips,
+            ),
+            Gauge(
+                "llmq_integrity_weight_audit_mismatches",
+                "Parameter leaves whose HBM digest diverged from the "
+                "build-time baseline",
+                fn=lambda: self.weight_audit_mismatches,
+            ),
+            Gauge(
+                "llmq_integrity_canary_failures",
+                "Golden-prompt canary replays that were not bit-exact",
+                fn=lambda: self.canary_failures,
+            ),
         ):
             reg.register(metric)
 
@@ -810,6 +949,32 @@ class EngineCore:
                 "dispatch watchdog: p99 x %.1f, floor %.1fs",
                 self.watchdog_mult,
                 self.watchdog_min_s,
+            )
+
+        # Integrity baselines, recorded last so they see the final
+        # (possibly re-laid-out) parameters and a fully working engine.
+        if self.weight_audit_every > 0:
+            from llmq_tpu.engine import integrity as integrity_mod
+
+            with self._wd("weight_audit"):
+                self._weight_baseline = integrity_mod.digest_params(
+                    self.params
+                )
+            self._next_weight_audit = (
+                time.monotonic() + self.weight_audit_every
+            )
+            logger.info(
+                "weight audit: %d leaves digested, sweeping every %.1fs",
+                len(self._weight_baseline),
+                self.weight_audit_every,
+            )
+        if self.canary_every > 0:
+            self._canary_golden = self._generate_canary()
+            self._next_canary = time.monotonic() + self.canary_every
+            logger.info(
+                "canary self-test: %d golden tokens, replaying every %.1fs",
+                len(self._canary_golden),
+                self.canary_every,
             )
 
     def _dispatch_p99(self, kind: str) -> Optional[float]:
@@ -839,6 +1004,17 @@ class EngineCore:
         model = self.model
         S = self.cfg.max_num_seqs
         spec = self.cfg.spec_tokens > 0
+        # On-device logit guard (default off → every closure below traces
+        # the literal pre-existing program). When on, each step also
+        # returns (stats f32[3], bad bool[rows]) folded from its logits;
+        # thresholds are trace-time constants.
+        guard = self.logit_guard == "on"
+        g_max, g_ent = self.guard_logit_max, self.guard_entropy_min
+
+        def guard_stats(logits, mask):
+            return _dispatch.logit_guard_stats(
+                logits, mask, max_abs=g_max, min_entropy=g_ent
+            )
 
         # Device decode-state layout (leaf order is load-bearing):
         # 0 tokens[S]  1 ctx[S]    2 bt[S,pps]  3 active[S]  4 keys[S,kd]
@@ -901,12 +1077,18 @@ class EngineCore:
             (tokens, ctx, bt, active, keys, steps, temps, topks,
              topps, _limits, mins, stop_ids) = st
             logits, kp, vp = model.decode(params, tokens, ctx, kp, vp, bt, active)
+            # Guard reads the raw model logits: suppress_stops writes
+            # NEG_INF sentinels that would false-trip the magnitude lane.
+            g = guard_stats(logits, active) if guard else None
             logits = suppress_stops(logits, stop_ids, steps, mins)
             next_tokens = sample_tokens(
                 logits, keys, steps, temps, topks, topps, mode=mode
             )
             out = jnp.where(active, next_tokens, 0)
-            return out, kp, vp, advance_state(st, out, active)
+            new_st = advance_state(st, out, active)
+            if guard:
+                return (out, g), kp, vp, new_st
+            return out, kp, vp, new_st
 
         def decode_block_step(params, kp, vp, st, *, mode):
             """``decode_block`` fused decode iterations in ONE XLA
@@ -1003,6 +1185,15 @@ class EngineCore:
             )
             logits, kp, vp = model.verify(params, qtok, qpos, kp, vp, bt)
             V = logits.shape[-1]
+            if guard:
+                # Raw logits (pre suppress_stops sentinels); per-row
+                # verdict folds the Q candidate positions of each slot.
+                g_stats, g_bad = guard_stats(
+                    logits.reshape(S * Q, V), jnp.repeat(active, Q)
+                )
+                g = (g_stats, g_bad.reshape(S, Q).any(axis=1))
+            else:
+                g = None
             steps_grid = steps[:, None] + jnp.arange(Q)[None, :]
             flat = suppress_stops(
                 logits.reshape(S * Q, V),
@@ -1061,7 +1252,10 @@ class EngineCore:
                 stop_ids,
                 history.at[rows, hist_pos].set(emit, mode="drop"),
             )
-            return (jnp.where(emitted, emit, 0), count), kp, vp, st
+            ys = (jnp.where(emitted, emit, 0), count)
+            if guard:
+                return (ys, g), kp, vp, st
+            return ys, kp, vp, st
 
         def verify_block_step(params, kp, vp, st, *, mode):
             """decode_block fused verify iterations in one XLA
@@ -1137,11 +1331,14 @@ class EngineCore:
             logits, kp, vp = model.prefill(
                 params, p_tokens, p_lengths, kp, vp, p_bt
             )
+            g = guard_stats(logits, p_slots >= 0) if guard else None
             out, st = sample_and_scatter(
                 logits, p_slots >= 0, p_lengths, p_bt, p_slots, p_keys,
                 p_steps, p_temps, p_topks, p_topps, p_limits, p_mins,
                 p_stopids, st, mode=mode, p_history=p_history,
             )
+            if guard:
+                return (out, g), kp, vp, st
             return out, kp, vp, st
 
         def chunkfill_step(params, kp, vp, c_tokens, c_positions, c_bt,
@@ -1156,12 +1353,18 @@ class EngineCore:
             logits, kp, vp = model.prefill_chunk(
                 params, c_tokens, c_positions, kp, vp, c_bt, c_last
             )
+            # Guard watches every valid row's chunk logits (non-final
+            # rows too: mid-prompt logits are real model outputs, so
+            # corruption surfaces chunks before the first sample).
+            g = guard_stats(logits, c_slots >= 0) if guard else None
             out, st = sample_and_scatter(
                 logits, jnp.logical_and(c_slots >= 0, c_final), c_lengths,
                 c_bt, c_slots, c_keys, c_steps, c_temps, c_topks, c_topps,
                 c_limits, c_mins, c_stopids, st, mode=mode,
                 p_history=c_history,
             )
+            if guard:
+                return (out, g), kp, vp, st
             return out, kp, vp, st
 
         def mixedfill_step(params, kp, vp, m_tokens, m_positions, m_final,
@@ -1210,6 +1413,19 @@ class EngineCore:
                 logits, kp, vp = model.mixed(
                     params, qtok, qpos, kp, vp, bt_used, gather
                 )
+                if guard:
+                    # Active decode rows, plus the piggy's slot row on
+                    # the iteration whose segment samples its first
+                    # token (earlier segments gather pad positions).
+                    g_mask = jnp.logical_or(
+                        active,
+                        (jnp.arange(S) == slot)
+                        & seg_final
+                        & (m_slots[0] >= 0),
+                    )
+                    g = guard_stats(logits, g_mask)
+                else:
+                    g = None
                 # Decode tail — identical math to decode_step for the
                 # active rows (the chunk row is inactive, emits 0 here).
                 d_logits = suppress_stops(logits, stop_ids, steps, mins)
@@ -1244,6 +1460,8 @@ class EngineCore:
                 emit = jnp.where(
                     (jnp.arange(S) == slot) & seg_final, out1[0], out
                 )
+                if guard:
+                    return (kp, vp, st), (emit, g)
                 return (kp, vp, st), emit
 
             (kp, vp, st), outs = jax.lax.scan(
@@ -1293,6 +1511,15 @@ class EngineCore:
             fn, out0 = self._decode_block_fn, self._block1
         else:
             fn, out0 = self._decode_fn, slot1
+        # Logit guard on: every step's token output pairs with the tiny
+        # (stats, bad-rows) guard fold — replicated, it rides the same
+        # async fetch as the tokens. Off: the out specs (and programs)
+        # are untouched.
+        g_on = self.logit_guard == "on"
+        guard_sh = (repl, repl)
+        if g_on:
+            out0 = (out0, guard_sh)
+        p_out = (repl, guard_sh) if g_on else repl
         self._decode_jits = {
             mode: jax.jit(
                 partial(fn, mode=mode),
@@ -1309,7 +1536,7 @@ class EngineCore:
             mode: jax.jit(
                 partial(self._prefill_fn, mode=mode),
                 in_shardings=(param_spec, kv, kv) + (repl,) * nP + (st_sh,),
-                out_shardings=(repl, kv, kv, st_sh),
+                out_shardings=(p_out, kv, kv, st_sh),
                 donate_argnums=(1, 2, 3 + nP),
             )
             for mode in ("greedy", "stochastic", "filtered")
@@ -1319,7 +1546,7 @@ class EngineCore:
             mode: jax.jit(
                 partial(self._chunkfill_fn, mode=mode),
                 in_shardings=(param_spec, kv, kv) + (repl,) * nC + (st_sh,),
-                out_shardings=(repl, kv, kv, st_sh),
+                out_shardings=(p_out, kv, kv, st_sh),
                 donate_argnums=(1, 2, 3 + nC),
             )
             for mode in ("greedy", "stochastic", "filtered")
@@ -1348,7 +1575,12 @@ class EngineCore:
                     in_shardings=(param_spec, kv, kv)
                     + (repl,) * nM
                     + (st_sh,),
-                    out_shardings=(self._block1, kv, kv, st_sh),
+                    out_shardings=(
+                        (self._block1, guard_sh) if g_on else self._block1,
+                        kv,
+                        kv,
+                        st_sh,
+                    ),
                     donate_argnums=(1, 2, 3 + nM),
                 )
                 for mode in ("greedy", "stochastic", "filtered")
@@ -1375,6 +1607,8 @@ class EngineCore:
             fn, out0 = self._decode_block_fn, self._block1
         else:
             fn, out0 = self._decode_fn, self._slot1
+        if self.logit_guard == "on":
+            out0 = (out0, (self._repl, self._repl))
         probe = jax.jit(
             partial(fn, mode="greedy"),
             in_shardings=(auto_ps, kv, kv, self._st_shardings),
@@ -1642,9 +1876,15 @@ class EngineCore:
         self._flush_deferred()
 
     def _process_oldest(self, finished: List[RequestOutput]) -> None:
-        idx, kind, out, snapshot = self._pending.popleft()
+        idx, kind, out, snapshot, g = self._pending.popleft()
         if kind in ("decode", "mixed"):
             self._pending_decodes -= 1
+        if g is not None:
+            # Evaluate the guard verdict BEFORE appending any of this
+            # dispatch's tokens: a tripped dispatch's outputs are suspect
+            # and must not reach user-visible sequences. The raise routes
+            # into the numerical-fault recovery (blame attribution).
+            self._eval_guard(kind, g, snapshot)
         if kind == "mixed":
             # Mixed dispatch: ([K, S] token block, per-row first-valid
             # iteration). Decode rows start at 0; the piggy row's tokens
@@ -1726,6 +1966,59 @@ class EngineCore:
                     continue
                 self._append_and_check(seq, int(k_tokens[row]), finished)
         self._processed_idx = idx
+
+    def _eval_guard(
+        self,
+        kind: str,
+        guard: tuple,
+        snapshot: List[Tuple[int, Sequence, int]],
+    ) -> None:
+        """Fetch one dispatch's on-device guard fold and raise a
+        classifiable :class:`LogitGuardError` if any check tripped.
+
+        The fetch rides the same async copy as the tokens (started at
+        dispatch), so by drain time it is host-resident. Fused blocks
+        ship per-iteration folds [K, ...]; they are combined here —
+        trivial host arithmetic on a [K, 3] + [K, S] pair."""
+        with self._wd("guard"):
+            stats = np.asarray(guard[0])
+            bad = np.asarray(guard[1])
+        if stats.ndim == 2:  # stacked per-scan-iteration folds
+            # Host-side combine of the already-fetched [K, 3] fold (the
+            # bracket above did the device fetch) — no device value here.
+            stats = np.array(  # llmq: ignore[unguarded-device-fetch]
+                [stats[:, 0].sum(), stats[:, 1].max(), stats[:, 2].min()]
+            )
+        if bad.ndim == 2:
+            bad = bad.any(axis=0)
+        if not bad.any():
+            return
+        checks = []
+        if stats[0] > 0:
+            checks.append("nonfinite")
+        if self.guard_logit_max > 0 and stats[1] > self.guard_logit_max:
+            checks.append("logit_max")
+        if (
+            self.guard_entropy_min > 0
+            and np.isfinite(stats[2])
+            and stats[2] < self.guard_entropy_min
+        ):
+            checks.append("entropy_collapse")
+        suspects = tuple(
+            seq.rid
+            for row, seq, _epoch in snapshot
+            if row < bad.shape[0] and bad[row]
+        )
+        self.guard_trips += 1
+        raise LogitGuardError(
+            check="+".join(checks) or "guard",
+            detail=(
+                f"nonfinite={stats[0]:.0f} max|logit|={stats[1]:.4g} "
+                f"min_entropy={stats[2]:.4g} rows={int(bad.sum())}"
+            ),
+            suspects=suspects,
+            kind=kind,
+        )
 
     def _flush_deferred(self) -> None:
         # Swap-to-host captures first: a swap entry shares its watermark
@@ -2006,10 +2299,27 @@ class EngineCore:
                     break
         return out
 
+    def _split_guard(self, out):
+        """Split a jitted step's token output from its guard fold.
+
+        With the guard on every step returns ``(tokens, (stats, bad))``;
+        off, the output is the pre-existing structure and the guard slot
+        is ``None`` — callers stay shape-agnostic either way."""
+        if self.logit_guard == "on":
+            return out
+        return out, None
+
     def _push_pending(
-        self, kind: str, out: jax.Array, snapshot: List[Tuple[int, Sequence]]
+        self,
+        kind: str,
+        out: jax.Array,
+        snapshot: List[Tuple[int, Sequence]],
+        guard: Optional[tuple] = None,
     ) -> None:
-        for arr in out if isinstance(out, tuple) else (out,):
+        arrs = list(out) if isinstance(out, tuple) else [out]
+        if guard is not None:
+            arrs.extend(guard)
+        for arr in arrs:
             try:
                 arr.copy_to_host_async()
             except Exception:  # noqa: BLE001 — numpy leaves / no support
@@ -2022,7 +2332,7 @@ class EngineCore:
         # the sequence is re-admitted (its token came from abandoned
         # device state).
         stamped = [(row, seq, seq.epoch) for row, seq in snapshot]
-        self._pending.append((self._dispatch_idx, kind, out, stamped))
+        self._pending.append((self._dispatch_idx, kind, out, stamped, guard))
 
     def _resync(self) -> None:
         """Rebuild the device decode state from scheduler truth. Only valid
@@ -2215,15 +2525,21 @@ class EngineCore:
                         )
                     )
                     self._record_dispatch("prefill", time.monotonic() - t0)
+                out, g = self._split_guard(out)
                 if snapshot:  # rows whose prompt finished in this chunk
                     for _, seq in snapshot:
                         seq.prefilled = True
                         self.scheduler.register_prefix(seq)
                     self.prefills += len(snapshot)
-                    self._push_pending("prefill", out, snapshot)
+                    self._push_pending("prefill", out, snapshot, g)
                     self._mode = sampling_mod.join_modes(
                         (self._mode, chunk_mode)
                     )
+                elif g is not None:
+                    # No row finished in this chunk, but the guard fold
+                    # still needs its drain-time verdict: ride the
+                    # pipeline with an empty row snapshot.
+                    self._push_pending("prefill", out, [], g)
                 # Interleave: let pre-wave sequences advance while the
                 # next chunk queues behind this one on the device stream
                 # (an idle engine's long first prompt must not pay an
@@ -2379,6 +2695,7 @@ class EngineCore:
                 starts = np.zeros((self.cfg.max_num_seqs,), np.int32)
                 if final_k is not None:
                     starts[seq.slot] = final_k
+                out, g = self._split_guard(out)
                 self._push_pending(
                     "mixed",
                     (out, starts),
@@ -2387,6 +2704,7 @@ class EngineCore:
                         for i, s in enumerate(self.scheduler.slots)
                         if s is not None and s.prefilled
                     ],
+                    g,
                 )
                 while len(self._pending) > self.cfg.runahead:
                     self._process_oldest(finished)
@@ -2466,7 +2784,8 @@ class EngineCore:
             seq.prefilled = True
             self.prefill_tokens += seq.num_tokens
         self.prefills += len(chunk)
-        self._push_pending("prefill", out, list(enumerate(chunk)))
+        out, g = self._split_guard(out)
+        self._push_pending("prefill", out, list(enumerate(chunk)), g)
         # The new rows' sampler mode must be honored from the next decode.
         self._mode = sampling_mod.join_modes((self._mode, chunk_mode))
 
@@ -2607,6 +2926,7 @@ class EngineCore:
             self._record_dispatch(kind, time.monotonic() - t0)
         self.decode_steps += self.cfg.decode_block
         self.decode_dispatches += 1
+        out, g = self._split_guard(out)
         self._push_pending(
             "decode",
             out,
@@ -2615,6 +2935,7 @@ class EngineCore:
                 for i, seq in enumerate(self.scheduler.slots)
                 if seq is not None and seq.prefilled
             ],
+            g,
         )
         while len(self._pending) > self.cfg.runahead:
             self._process_oldest(finished)
@@ -3206,6 +3527,188 @@ class EngineCore:
             self.kv_restores += 1
         self._dirty = True
 
+    # --- numerics-integrity plane ----------------------------------------
+    def _canary_generate(self) -> List[int]:
+        """Run the deterministic golden prompt to completion on an idle
+        core and return the greedy token ids. The prompt is fixed small
+        ids (valid in any vocab), temperature 0, EOS ignored — the only
+        sources of variance left are the weights and the compute, which
+        is exactly what the canary is meant to witness."""
+        v = self.model_config.vocab_size
+        prompt = [(i * 7 + 1) % v for i in range(8)]
+        self.add_request(
+            "__canary__",
+            prompt_ids=prompt,
+            params=SamplingParams(
+                temperature=0.0, max_tokens=8, ignore_eos=True
+            ),
+        )
+        tokens: List[int] = []
+        for _ in range(256):  # bounded: 8 tokens needs far fewer steps
+            for out in self.step():
+                if out.rid == "__canary__":
+                    tokens = list(out.token_ids)
+            if not self.has_work:
+                break
+        return tokens
+
+    def _generate_canary(self) -> List[int]:
+        """Record the golden canary tokens at engine build (idle core,
+        fresh weights — by construction the trusted reference)."""
+        from llmq_tpu.engine import integrity as integrity_mod
+
+        golden = self._canary_generate()
+        logger.info(
+            "canary golden recorded: %d token(s), fold=%s",
+            len(golden),
+            integrity_mod.token_fold(golden),
+        )
+        return golden
+
+    def run_canary(self) -> bool:
+        """Replay the golden prompt and compare greedy tokens bit-exactly
+        against the build-time recording. Only meaningful on an idle core
+        (skipped otherwise — a busy core replays on the next idle sweep).
+        A mismatch (or a guard trip during the replay) counts as a canary
+        failure; the caller decides escalation."""
+        if self._canary_golden is None:
+            return True
+        if self.has_work:
+            return True
+        self.canary_runs += 1
+        try:
+            got = self._canary_generate()
+        except Exception as exc:  # noqa: BLE001 — a trip IS a failure
+            self.canary_failures += 1
+            # The failed replay may have left the canary sequence and its
+            # pipeline entries behind; clear them so the core is reusable.
+            self.abort_all("canary_failed")
+            logger.error("canary replay raised: %s", exc)
+            raise
+        if got == self._canary_golden:
+            return True
+        from llmq_tpu.engine import integrity as integrity_mod
+
+        self.canary_failures += 1
+        logger.error(
+            "canary FAILURE: got %s (fold=%s) want %s (fold=%s)",
+            got,
+            integrity_mod.token_fold(got),
+            self._canary_golden,
+            integrity_mod.token_fold(self._canary_golden),
+        )
+        return False
+
+    def audit_weights(self) -> List[str]:
+        """Re-digest every parameter leaf on device and diff against the
+        build-time baseline. A non-empty return names the leaves whose
+        HBM bytes changed since load — weight corruption, as opposed to
+        the transient compute errors the logit guard catches. Two reads
+        of intact HBM always agree, so false positives are impossible;
+        the digest is associative, so sharded leaves fold identically."""
+        if self._weight_baseline is None:
+            return []
+        from llmq_tpu.engine import integrity as integrity_mod
+
+        self.weight_audits += 1
+        with self._wd("weight_audit"):
+            current = integrity_mod.digest_params(self.params)
+        mismatched = integrity_mod.diff_digests(
+            self._weight_baseline, current
+        )
+        if mismatched:
+            self.weight_audit_mismatches += len(mismatched)
+            self._last_audit_mismatch = list(mismatched)
+            logger.error(
+                "weight audit: %d leaf/leaves changed in HBM since load: %s",
+                len(mismatched),
+                mismatched[:8],
+            )
+        return mismatched
+
+    def kv_spot_check(self, max_pages: int = 4) -> List[str]:
+        """Read-stability spot check of the paged KV cache: gather a
+        deterministic sample of in-use pages twice and compare blake2b
+        digests. Unlike the weight audit there is no load-time baseline
+        (KV churns constantly), so the check detects pages that do not
+        read back consistently — the HBM-corruption signature that
+        poisons every sequence sharing the page."""
+        in_use = sorted(
+            {
+                p
+                for s in self.scheduler.running.values()
+                for p in s.pages
+            }
+        )
+        if not in_use:
+            return []
+        from llmq_tpu.engine import integrity as integrity_mod
+
+        stride = max(1, len(in_use) // max_pages)
+        sample = in_use[::stride][:max_pages]
+        # Host page-index list → numpy; no device value involved.
+        idx = np.asarray(sample, np.int32)  # llmq: ignore[unguarded-device-fetch]
+        self.kv_spot_checks += 1
+        mismatched: List[str] = []
+        for name, pool in (("k", self.k_pages), ("v", self.v_pages)):
+            with self._wd("kv_spot"):
+                first = np.asarray(_dispatch.gather_kv_pages(pool, idx))
+                second = np.asarray(_dispatch.gather_kv_pages(pool, idx))
+            # gather returns [L, n, page, kv, d]; digest per sampled page.
+            da = integrity_mod.page_digests(np.moveaxis(first, 1, 0))
+            db = integrity_mod.page_digests(np.moveaxis(second, 1, 0))
+            mismatched.extend(
+                f"{name}:page{p}"
+                for p, x, y in zip(sample, da, db)
+                if x != y
+            )
+        if mismatched:
+            logger.error(
+                "kv spot check: %d page read(s) unstable: %s",
+                len(mismatched),
+                mismatched,
+            )
+        return mismatched
+
+    def maybe_idle_integrity(self) -> Optional[str]:
+        """Idle-step background sweep (engine thread, between batches):
+        run whichever integrity checks have hit their cadence. Returns a
+        failure detail string when something is wrong — the caller (the
+        async loop) raises it into the device-fault containment path —
+        or None when clean / nothing due."""
+        now = time.monotonic()
+        if (
+            self._weight_baseline is not None
+            and self.weight_audit_every > 0
+            and now >= self._next_weight_audit
+        ):
+            self._next_weight_audit = now + self.weight_audit_every
+            bad = self.audit_weights()
+            bad.extend(self.kv_spot_check())
+            if bad:
+                return f"weight/KV audit mismatch: {bad[:8]}"
+        if (
+            self._canary_golden is not None
+            and self.canary_every > 0
+            and now >= self._next_canary
+            and not self.has_work
+        ):
+            self._next_canary = now + self.canary_every
+            if not self.run_canary():
+                return "canary replay diverged from golden tokens"
+        return None
+
+    def integrity_status(self) -> str:
+        """One-word integrity verdict for heartbeats: ``ok`` until any
+        audit/canary evidence of corruption, then ``suspect``."""
+        if (
+            self.weight_audit_mismatches
+            or self.canary_failures
+            or self._last_audit_mismatch
+        ):
+            return "suspect"
+        return "ok"
+
     def abort_all(self, note: str = "aborted") -> None:
         """Drop every running/waiting sequence and release their pages —
         recovery hook after a failed step, so the loop doesn't re-step a
@@ -3377,6 +3880,26 @@ class EngineCore:
         if self.hbm_oom_events:
             s["hbm_oom_events"] = self.hbm_oom_events
             s["oom_degradations"] = list(self._oom_ladder_log)
+        # Numerics-integrity plane (superset-only: each block appears
+        # once its knob is on / its counter moved — default-off
+        # heartbeats stay byte-identical to pre-integrity builds).
+        if self.guard_trips:
+            s["guard_trips"] = self.guard_trips
+        if self.weight_audits:
+            s["weight_audits"] = self.weight_audits
+            s["weight_audit_mismatches"] = self.weight_audit_mismatches
+            s["kv_spot_checks"] = self.kv_spot_checks
+            if self._last_audit_mismatch:
+                s["last_audit_mismatch"] = list(self._last_audit_mismatch)
+        if self.canary_runs:
+            s["canary_runs"] = self.canary_runs
+            s["canary_failures"] = self.canary_failures
+        if (
+            self.logit_guard == "on"
+            or self.weight_audit_every > 0
+            or self.canary_every > 0
+        ):
+            s["integrity"] = self.integrity_status()
         gov = get_governor()
         if gov.enabled:
             s["host_mem"] = gov.stats()
@@ -3442,6 +3965,12 @@ class AsyncEngine:
         # Trips recorded by watchdogs of cores already rebuilt away;
         # stats() adds them so the counter never moves backwards.
         self._prior_watchdog_trips = 0
+        # Blame attribution for numerical faults: rid -> trip count.
+        # First trip re-runs the request on a rebuilt core (device
+        # blamed); a second trip classifies the job as poison. Entries
+        # pop on clean completion or on the poison verdict, so the map
+        # never outlives its requests.
+        self._numerical_probation: Dict[str, int] = {}
         # rid -> [(event_name, t_mono, fields)] recorded during fault
         # recovery; workers pop these into the request trace.
         self._fault_events: Dict[str, List[Tuple[str, float, Dict[str, Any]]]] = {}
@@ -3689,7 +4218,66 @@ class AsyncEngine:
         )
         return True
 
-    def _rebuild_after_fault(self, reason: str, exc: Exception) -> bool:
+    def _recover_numerical(self, exc: Exception) -> bool:
+        """Blame-attributed recovery for a numerical fault (logit-guard
+        trip, failed weight/KV audit, or canary divergence). First trip
+        for a request presumes the DEVICE is at fault: rebuild the core
+        in a fresh backend (weights re-streamed from the trusted source),
+        re-insert the suspects from their snapshots, and let greedy
+        determinism replay them token-identically. A request whose
+        re-run trips AGAIN is poison — its input deterministically
+        breaks the numerics — so its future fails with a classified
+        DeviceFaultError (the worker ladder quarantines it with
+        ``x-failure-reason=numerical_fault``) instead of hot-looping
+        rebuilds forever. Returns False when no rebuild path is wired
+        (fall through to the batch-abort path)."""
+        if self.rebuild_core is None:
+            return False
+        suspects = tuple(getattr(exc, "suspects", ()) or ())
+        poison = [r for r in suspects if r in self._numerical_probation]
+        fresh = [r for r in suspects if r not in self._numerical_probation]
+        for rid in fresh:
+            self._numerical_probation[rid] = 1
+        if poison:
+            logger.error(
+                "numerical fault re-tripped by %s — poison job(s); "
+                "quarantining instead of rebuilding again",
+                poison,
+            )
+        if not self._rebuild_after_fault(
+            FAULT_NUMERICAL, exc, drop=frozenset(poison)
+        ):
+            return False
+        failure = DeviceFaultError(
+            FAULT_NUMERICAL,
+            f"request re-tripped the numerics guard after a rebuild: {exc}",
+        )
+        for rid in poison:
+            self._numerical_probation.pop(rid, None)
+            self._record_fault_event([rid], "poison_numerical")
+            emit_trace_event(rid, "poison_numerical")
+            fut = self._futures.get(rid)
+            if fut is not None and not fut.done():
+                fut.set_exception(failure)
+        # Device-blamed path: before the rebuilt core takes traffic, it
+        # re-verifies its weights and replays the canary (both recorded
+        # fresh by its own build) — a chip that is still corrupting
+        # fails here instead of on user requests.
+        try:
+            if self.core._weight_baseline is not None:
+                self.core.audit_weights()
+            if self.core._canary_golden is not None:
+                self.core.run_canary()
+        except Exception:  # noqa: BLE001 — re-verify is best-effort
+            logger.exception("post-rebuild integrity re-verify failed")
+        return True
+
+    def _rebuild_after_fault(
+        self,
+        reason: str,
+        exc: Exception,
+        drop: frozenset = frozenset(),
+    ) -> bool:
         """On the engine thread: contain a classified device fault by
         rebuilding the EngineCore in a fresh backend in-process. Every
         restorable request re-inserts from its snapshot and resumes
@@ -3700,7 +4288,9 @@ class AsyncEngine:
         itself failed). A recovery that *hangs* — the device wedged so
         hard that even extraction or the rebuild blocks forever — trips
         the hard-exit backstop, and the orphan janitor reclaims this
-        worker's queue."""
+        worker's queue. Requests named in ``drop`` are neither
+        re-inserted nor requeued — the caller has already decided their
+        fate (poison verdicts fail their futures directly)."""
         logger.error(
             "device fault (%s): %s — attempting in-process engine rebuild",
             reason,
@@ -3747,9 +4337,11 @@ class AsyncEngine:
             self.core = new_core
             del old  # free the faulted backend's buffers before stepping
             self.engine_rebuilds += 1
-            lost_set = set(lost)
+            lost_set = set(lost) - drop
             restored = 0
             for snap, deadline_at in snaps:
+                if snap.rid in drop:
+                    continue
                 try:
                     new_core.insert_request(snap, deadline_at=deadline_at)
                     restored += 1
@@ -3877,17 +4469,36 @@ class AsyncEngine:
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
             if not self.core.has_work and not drained:
+                # Idle integrity sweep (weight audit / KV spot-check /
+                # canary replay on their cadences; no-op at defaults).
+                # Evidence of corruption routes into the same numerical
+                # containment path a guard trip takes.
+                try:
+                    suspicion = self.core.maybe_idle_integrity()
+                except Exception as idle_exc:  # noqa: BLE001 — replay tripped
+                    suspicion = f"canary replay raised: {idle_exc}"
+                if suspicion is not None:
+                    if not self._recover_numerical(
+                        DeviceFaultError(FAULT_NUMERICAL, suspicion)
+                    ):
+                        logger.error(
+                            "numerical fault with no rebuild path: %s",
+                            suspicion,
+                        )
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
                 continue
             try:
                 for out in self.core.step():
+                    self._numerical_probation.pop(out.rid, None)
                     fut = self._futures.get(out.rid)
                     if fut is not None and not fut.done():
                         fut.set_result(out)
             except Exception as exc:  # noqa: BLE001 — keep the loop alive
                 reason = classify_failure(exc)
                 if reason == FAULT_OOM and self._degrade_and_restore(exc):
+                    continue
+                if reason == FAULT_NUMERICAL and self._recover_numerical(exc):
                     continue
                 if reason is not None and self.rebuild_core is not None:
                     if self._rebuild_after_fault(reason, exc):

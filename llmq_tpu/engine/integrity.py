@@ -1,0 +1,134 @@
+"""Numerics-integrity primitives: weight digests, KV spot-checks, canaries.
+
+Silent data corruption — a flipped bit in an HBM weight shard, a KV page
+that reads back differently than it was written, a core that computes
+wrong values without raising — passes every crash-shaped check the
+fault-containment layer (watchdog, classifier, rebuild) runs. This
+module supplies the *value-level* checks the engine folds on top:
+
+- :func:`digest_params` — a single jitted pass that folds every
+  parameter leaf's raw bits into a position-salted ``uint32[2]``
+  (xor lane + wraparound-sum lane). Cheap enough to sweep a whole model
+  during idle steps, dtype-agnostic (int8 / packed-int4 leaves are
+  plain ``uint8``/``int8`` arrays and hash as bytes), and
+  permutation-sensitive thanks to the index salt. Two reads of an
+  intact HBM buffer always agree, so a baseline-vs-now mismatch names
+  the corrupted leaf.
+- :func:`diff_digests` — compare two digest maps, returning the leaf
+  paths that changed.
+- :func:`page_digests` — host-side blake2b over gathered KV pages
+  (the same 16-byte blake2b discipline ``snapshot.py`` uses on the
+  wire), for read-stability spot checks of the paged cache.
+- :func:`token_fold` — blake2b over a token-id sequence, shared by the
+  canary self-test and the result-payload digests.
+
+Everything here is read-only over device state and safe to call from
+the engine thread between dispatches; nothing is imported by default
+paths unless an integrity knob is switched on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Digest width for host-side blake2b folds — matches snapshot.py's wire
+#: digest so operators see one familiar length everywhere.
+DIGEST_SIZE = 16
+
+#: Knuth's multiplicative-hash constant; salts each element with its
+#: flat index so transpositions (which plain xor/sum folds cannot see)
+#: change the digest.
+_SALT = np.uint32(2654435761)
+
+
+def _fold_leaf(x: jax.Array) -> jax.Array:
+    """Fold one array's raw bits into ``uint32[2]`` = [xor, sum].
+
+    Bit-exact over the stored representation: the leaf is bitcast to
+    bytes (never value-converted), widened, index-salted, then reduced.
+    Both lanes are order-independent per element, but the salt makes
+    the combined fold position-sensitive. Associativity means the same
+    fold computed shard-by-shard or block-by-block agrees with the
+    whole-array fold, so GSPMD partial reduces compose correctly.
+    """
+    if x.dtype == jnp.bool_:
+        bytes_ = x.astype(jnp.uint8)
+    else:
+        bytes_ = jax.lax.bitcast_convert_type(x, jnp.uint8)
+    flat = bytes_.reshape(-1).astype(jnp.uint32)
+    idx = jax.lax.iota(jnp.uint32, flat.shape[0])
+    salted = flat ^ (idx * _SALT)
+    xor = jax.lax.reduce(
+        salted, jnp.uint32(0), jax.lax.bitwise_xor, (0,)
+    )
+    total = jnp.sum(salted, dtype=jnp.uint32)
+    return jnp.stack([xor, total])
+
+
+@jax.jit
+def _digest_tree(tree):
+    return jax.tree.map(_fold_leaf, tree)
+
+
+def digest_params(params) -> Dict[str, Tuple[int, int]]:
+    """Digest every leaf of a parameter pytree on device.
+
+    One compiled pass over the tree; the tiny per-leaf ``uint32[2]``
+    results come back in a single transfer. Returns
+    ``{leaf_path: (xor, sum)}`` with jax's keystr paths (stable across
+    calls for the same tree structure).
+    """
+    dig = _digest_tree(params)
+    host = jax.device_get(dig)
+    leaves = jax.tree_util.tree_flatten_with_path(host)[0]
+    return {
+        jax.tree_util.keystr(path): (int(v[0]), int(v[1]))
+        for path, v in leaves
+    }
+
+
+def diff_digests(
+    baseline: Dict[str, Tuple[int, int]],
+    current: Dict[str, Tuple[int, int]],
+) -> List[str]:
+    """Leaf paths whose digest changed (or appeared/vanished) since
+    ``baseline``. Empty list == clean audit."""
+    changed = [
+        path
+        for path, val in current.items()
+        if baseline.get(path) != val
+    ]
+    changed.extend(path for path in baseline if path not in current)
+    return sorted(set(changed))
+
+
+def page_digests(pages: np.ndarray) -> List[str]:
+    """blake2b-16 hex digest of each leading-axis page of a host array.
+
+    The caller gathers the pages (``ops.dispatch.gather_kv_pages``) and
+    fetches them under a watchdog bracket; this only touches host bytes.
+    """
+    arr = np.ascontiguousarray(pages)
+    return [
+        hashlib.blake2b(arr[i].tobytes(), digest_size=DIGEST_SIZE).hexdigest()
+        for i in range(arr.shape[0])
+    ]
+
+
+# Canonical home is the dependency-free hashing module (the wire side —
+# result stamping and receive-path verification — must not import jax);
+# re-exported here so engine code has one integrity namespace.
+from llmq_tpu.utils.hashing import token_fold  # noqa: E402
+
+__all__ = [
+    "DIGEST_SIZE",
+    "digest_params",
+    "diff_digests",
+    "page_digests",
+    "token_fold",
+]

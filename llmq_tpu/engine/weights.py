@@ -35,6 +35,7 @@ Name mapping (HF → ours):
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 from functools import partial
@@ -150,11 +151,24 @@ def _write_block_at(buf: jnp.ndarray, block: jnp.ndarray, starts, *, ndim: int):
 
 
 class _Streamer:
-    """Allocates device buffers and fills them block-by-block in place."""
+    """Allocates device buffers and fills them block-by-block in place.
 
-    def __init__(self, mesh: Optional[Mesh], specs: Optional[Params]) -> None:
+    With a ``ledger`` dict, every streamed buffer also folds its
+    device-bound host bytes (post conversion / quantization — the exact
+    representation written to HBM) into a blake2b recorded under the
+    stream name. The hash rides the existing per-block loop, so the
+    bounded-RSS property is untouched: one block is hashed, shipped,
+    and dropped before the next is read."""
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh],
+        specs: Optional[Params],
+        ledger: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.mesh = mesh
         self.specs = specs
+        self.ledger = ledger
 
     def _sharding(self, name: str) -> Optional[NamedSharding]:
         if self.mesh is None or self.specs is None:
@@ -197,10 +211,17 @@ class _Streamer:
         sharding = self._sharding(name)
         buf = self._alloc(shape, dtype, sharding)
         bsh_cache: dict = {}
+        fold = (
+            hashlib.blake2b(digest_size=16)
+            if self.ledger is not None
+            else None
+        )
         for start, block in blocks:
             host = np.ascontiguousarray(block).astype(
                 _np_dtype(dtype), copy=False
             )
+            if fold is not None:
+                fold.update(np.ascontiguousarray(host).tobytes())
             axes = tuple(range(len(start))) if isinstance(start, tuple) else (axis,)
             if axes not in bsh_cache:
                 bsh_cache[axes] = self._block_sharding(sharding, axes)
@@ -214,6 +235,8 @@ class _Streamer:
                 buf = _write_block_at(buf, dev, start, ndim=len(start))
             else:
                 buf = _write_block(buf, dev, start, axis=axis)
+        if fold is not None:
+            self.ledger[name] = fold.hexdigest()
         return buf
 
 
@@ -224,6 +247,7 @@ def load_checkpoint(
     dtype=jnp.bfloat16,
     mesh: Optional[Mesh] = None,
     quantize: bool | str = False,
+    checksum_ledger: Optional[Dict[str, str]] = None,
 ) -> Params:
     """Load an HF checkpoint directory into the stacked param layout.
 
@@ -244,6 +268,16 @@ def load_checkpoint(
     embedding table and LM head stay int8 (the logit end is the
     precision-sensitive one). ``dtype`` remains the compute/scale
     dtype. See ``models/quant.py``.
+
+    ``checksum_ledger`` (integrity plane): a dict the load fills with
+    ``{stream_name: blake2b-16 hex}`` over each streamed buffer's
+    device-bound bytes — computed once, per block, while the data is in
+    flight anyway (all dtypes: bf16, int8, packed int4 all hash as
+    their stored bytes). The ledger is the load-time provenance record
+    two loads of the same checkpoint at the same dtype compare by; the
+    engine's *device-side* baseline (``engine/integrity.py``) is what
+    idle audits re-verify, since post-load layout optimization
+    relocates buffers without changing their logical bytes.
     """
     from llmq_tpu.models import quant as qm
 
@@ -264,7 +298,7 @@ def load_checkpoint(
         from llmq_tpu.parallel.sharding import param_pspecs
 
         specs = param_pspecs(config, int(mesh.shape.get(TP_AXIS, 1)))
-    streamer = _Streamer(mesh, specs)
+    streamer = _Streamer(mesh, specs, ledger=checksum_ledger)
 
     def _finish_quant(buf, scales: np.ndarray, name: str, *, row_wise: bool):
         """Pair an int8 device buffer with its host-accumulated scales.
@@ -573,4 +607,9 @@ def load_checkpoint(
     logger.info(
         "Loaded %s: %.2fB params as %s", model_path, n_params / 1e9, dtype
     )
+    if checksum_ledger is not None:
+        logger.info(
+            "load checksums recorded for %d streamed tensor(s)",
+            len(checksum_ledger),
+        )
     return params

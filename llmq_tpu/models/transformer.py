@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional, Tuple
+import os
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,62 @@ from llmq_tpu.ops import collective_matmul as cm
 from llmq_tpu.ops import dispatch as attn_dispatch
 
 Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Activation-stat taps (LLMQ_ACT_STATS) — numerics bisection instrumentation
+# ---------------------------------------------------------------------------
+
+#: Sink for (op, layer, mean|x|, max|x|) records emitted by the debug
+#: callbacks below; drained by :func:`pop_act_stats`.
+_ACT_STATS: List[Tuple[str, int, float, float]] = []
+
+
+def act_stats_enabled() -> bool:
+    """Whether the per-op activation taps are armed (LLMQ_ACT_STATS).
+
+    Checked at TRACE time: with the flag off (the default) :func:`_tap`
+    is `return x` and every compiled program is byte-identical to an
+    uninstrumented build. Flip the env var before the first dispatch to
+    get per-layer/per-op magnitude stats for divergence bisection."""
+    return (os.environ.get("LLMQ_ACT_STATS") or "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    )
+
+
+def pop_act_stats() -> List[Tuple[str, int, float, float]]:
+    """Drain and return the recorded (op, layer, mean|x|, max|x|) rows.
+
+    Callbacks are unordered across devices, so consumers should key on
+    the explicit (op, layer) labels, not arrival order."""
+    out = list(_ACT_STATS)
+    _ACT_STATS.clear()
+    return out
+
+
+def _record_stat(layer, mean_abs, max_abs, *, name: str) -> None:
+    _ACT_STATS.append(
+        (name, int(layer), float(mean_abs), float(max_abs))
+    )
+
+
+def _tap(x: jnp.ndarray, name: str, layer=-1) -> jnp.ndarray:
+    """Record magnitude stats of ``x`` under ``name`` when the taps are
+    armed; identity (and trace-invisible) otherwise. ``layer`` may be a
+    traced scan index — it rides to the host inside the callback."""
+    if not act_stats_enabled():
+        return x
+    x32 = jnp.abs(x.astype(jnp.float32))
+    jax.debug.callback(
+        lambda li, mn, mx: _record_stat(li, mn, mx, name=name),
+        jnp.asarray(layer, jnp.int32),
+        jnp.mean(x32),
+        jnp.max(x32),
+    )
+    return x
 
 
 # ---------------------------------------------------------------------------
@@ -101,9 +158,10 @@ def _mlp(
     lp: Params,
     activation: str,
     plan: "cm.TpRingPlan | None" = None,
+    layer=-1,
 ) -> jnp.ndarray:
-    gate = qm.matmul(h, lp["gate_proj"])
-    up = qm.matmul(h, lp["up_proj"])
+    gate = _tap(qm.matmul(h, lp["gate_proj"]), "mlp.gate", layer)
+    up = _tap(qm.matmul(h, lp["up_proj"]), "mlp.up", layer)
     if activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
@@ -111,7 +169,11 @@ def _mlp(
     # down_proj is the row-parallel projection GSPMD follows with a
     # blocking all-reduce; with a tp-overlap plan it runs as the chunked
     # ppermute ring instead (plan=None is the literal qm.matmul).
-    return cm.row_parallel_matmul(act * up, lp["down_proj"], plan)
+    return _tap(
+        cm.row_parallel_matmul(act * up, lp["down_proj"], plan),
+        "mlp.down",
+        layer,
+    )
 
 
 def _moe_mlp(
@@ -119,6 +181,7 @@ def _moe_mlp(
     lp: Params,
     config: ModelConfig,
     plan: "cm.TpRingPlan | None" = None,
+    layer=-1,
 ) -> jnp.ndarray:
     """Sparse mixture-of-experts MLP (qwen2_moe/qwen3_moe semantics),
     TPU-first: tokens are sorted by routed expert and each expert's group
@@ -135,7 +198,9 @@ def _moe_mlp(
     E = config.num_experts
     k = config.num_experts_per_tok
 
-    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
+    router_logits = _tap(
+        (x @ lp["router"]).astype(jnp.float32), "moe.router", layer
+    )  # [N, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     top_w, top_e = jax.lax.top_k(probs, k)  # [N, k]
     if config.norm_topk_prob:
@@ -146,14 +211,18 @@ def _moe_mlp(
     flat_e = top_e.reshape(-1)  # [N*k]
     order = jnp.argsort(flat_e)  # stable: ties keep token order
     token_of = order // k  # source token per sorted row
-    xs = x[token_of]  # [N*k, H] gathered, grouped by expert
+    xs = _tap(x[token_of], "moe.gathered", layer)  # [N*k, H] grouped
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
 
     # ragged_dot takes a real array operand: int8 expert stacks are
     # dequantized per layer-scan step (a transient one-layer bf16 copy;
     # HBM-resident storage stays int8).
-    gate = jax.lax.ragged_dot(
-        xs, qm.dequantize(lp["expert_gate_proj"], x.dtype), group_sizes
+    gate = _tap(
+        jax.lax.ragged_dot(
+            xs, qm.dequantize(lp["expert_gate_proj"], x.dtype), group_sizes
+        ),
+        "moe.gate",
+        layer,
     )
     up = jax.lax.ragged_dot(
         xs, qm.dequantize(lp["expert_up_proj"], x.dtype), group_sizes
@@ -162,14 +231,22 @@ def _moe_mlp(
         act = jax.nn.gelu(gate, approximate=True) * up
     else:
         act = jax.nn.silu(gate) * up
-    down = cm.row_parallel_ragged_matmul(
-        act, lp["expert_down_proj"], group_sizes, x.dtype, plan
+    down = _tap(
+        cm.row_parallel_ragged_matmul(
+            act, lp["expert_down_proj"], group_sizes, x.dtype, plan
+        ),
+        "moe.down",
+        layer,
     )
 
     w_sorted = top_w.reshape(-1)[order].astype(down.dtype)  # [N*k]
-    out = jax.ops.segment_sum(
-        down * w_sorted[:, None], token_of, num_segments=N
-    ).astype(h.dtype)
+    out = _tap(
+        jax.ops.segment_sum(
+            down * w_sorted[:, None], token_of, num_segments=N
+        ).astype(h.dtype),
+        "moe.combine",
+        layer,
+    )
 
     if config.shared_expert_intermediate_size:
         shared = _mlp(
@@ -181,6 +258,7 @@ def _moe_mlp(
             },
             config.activation,
             plan,
+            layer,
         )
         out = out + jax.nn.sigmoid(x @ lp["shared_expert_gate"]) * shared
     return out.reshape(*lead, H)
@@ -216,11 +294,13 @@ class Transformer:
 
     # --- shared layer body -------------------------------------------------
     def _qkv(
-        self, lp: Params, h: jnp.ndarray, positions: jnp.ndarray, inv_freq
+        self, lp: Params, h: jnp.ndarray, positions: jnp.ndarray, inv_freq,
+        layer=-1,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         cfg = self.config
         d = cfg.head_dim_
         *lead, _ = h.shape
+        h = _tap(h, "ln1.out", layer)
         q = qm.matmul(h, lp["q_proj"])
         k = qm.matmul(h, lp["k_proj"])
         v = qm.matmul(h, lp["v_proj"])
@@ -234,19 +314,24 @@ class Transformer:
         if cfg.qk_norm:
             q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        return q, k, v
+        q = _tap(apply_rope(q, positions, inv_freq), "attn.q", layer)
+        k = _tap(apply_rope(k, positions, inv_freq), "attn.k", layer)
+        return q, k, _tap(v, "attn.v", layer)
 
     def _finish_layer(
-        self, lp: Params, h: jnp.ndarray, attn_out: jnp.ndarray
+        self, lp: Params, h: jnp.ndarray, attn_out: jnp.ndarray, layer=-1
     ) -> jnp.ndarray:
         cfg = self.config
         one_plus = cfg.model_type.startswith("gemma")
         plan = cm.ring_plan(self.mesh) if self.tp_overlap == "on" else None
         *lead, _, _ = attn_out.shape
         attn_flat = attn_out.reshape(*lead, cfg.num_heads * cfg.head_dim_)
-        attn_proj = cm.row_parallel_matmul(attn_flat, lp["o_proj"], plan)
+        attn_flat = _tap(attn_flat, "attn.out", layer)
+        attn_proj = _tap(
+            cm.row_parallel_matmul(attn_flat, lp["o_proj"], plan),
+            "attn.o_proj",
+            layer,
+        )
         if cfg.post_norms:
             attn_proj = rms_norm(
                 attn_proj, lp["post_attn_norm"], cfg.rms_norm_eps, one_plus=one_plus
@@ -254,15 +339,15 @@ class Transformer:
         h = h + attn_proj
         mlp_in = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, one_plus=one_plus)
         mlp_out = (
-            _moe_mlp(mlp_in, lp, cfg, plan)
+            _moe_mlp(mlp_in, lp, cfg, plan, layer)
             if cfg.num_experts
-            else _mlp(mlp_in, lp, cfg.activation, plan)
+            else _mlp(mlp_in, lp, cfg.activation, plan, layer)
         )
         if cfg.post_norms:
             mlp_out = rms_norm(
                 mlp_out, lp["post_mlp_norm"], cfg.rms_norm_eps, one_plus=one_plus
             )
-        return h + mlp_out
+        return _tap(h + mlp_out, "layer.out", layer)
 
     def _window_for_layers(self) -> jnp.ndarray:
         """Per-layer effective sliding window ([L]); 'disabled' = max ctx."""
@@ -298,7 +383,7 @@ class Transformer:
             logits = qm.matmul(h, head).astype(jnp.float32)
         if cfg.logit_softcap is not None:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
-        return logits
+        return _tap(logits, "lm_head.logits")
 
     # --- prefill -----------------------------------------------------------
     def prefill(
@@ -334,7 +419,7 @@ class Transformer:
             h, kps, vps = carry
             lp, window, li = xs
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
-            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            q, k, v = self._qkv(lp, x, positions, inv_freq, li)
             if page_aligned:
                 # Prompt positions are 0..T-1, so whole pages can be
                 # written in one block-scatter row each (~10 ms/chunk
@@ -357,7 +442,7 @@ class Transformer:
                 mesh=self.mesh,
                 backend=self.attn_backend,
             )
-            h = self._finish_layer(lp, h, attn_out)
+            h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
         layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -401,7 +486,7 @@ class Transformer:
             h, kps, vps = carry
             lp, window, li = xs
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
-            q, k, v = self._qkv(lp, x, positions, inv_freq)
+            q, k, v = self._qkv(lp, x, positions, inv_freq, li)
             kps, vps = attn_ops.write_kv_pages(
                 kps, vps, k, v, block_tables, positions, layer=li
             )
@@ -418,7 +503,7 @@ class Transformer:
                 backend=attn_backend,
                 layer=li,
             )
-            h = self._finish_layer(lp, h, attn_out)
+            h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
         layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -570,7 +655,7 @@ class Transformer:
             h, kps, vps = carry
             lp, window, li = xs
             x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, one_plus=one_plus)
-            q, k, v = self._qkv(lp, x[:, None, :], positions[:, None], inv_freq)
+            q, k, v = self._qkv(lp, x[:, None, :], positions[:, None], inv_freq, li)
             # q/k/v: [S, 1, heads, d]. The KV stack is written and read
             # in place via the layer index — see prefill's layer_fn.
             if fused_write:
@@ -600,7 +685,7 @@ class Transformer:
                     backend=self.attn_backend,
                     layer=li,
                 )
-            h = self._finish_layer(lp, h, attn_out)
+            h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
         layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)

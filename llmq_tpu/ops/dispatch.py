@@ -474,3 +474,62 @@ def insert_kv_pages(
     caller jits this with the pool donated and the pool's layout/sharding
     pinned on the output, mirroring the decode-step KV plumbing."""
     return pool.at[:, page_idx].set(pages.astype(pool.dtype))
+
+
+# --- numerics-integrity plane: on-device logit guards -----------------------
+
+
+def logit_guard_stats(
+    logits: jnp.ndarray,
+    mask: jnp.ndarray,
+    *,
+    max_abs: float,
+    min_entropy: float,
+):
+    """Fold the cheap silent-corruption checks over one dispatch's logits.
+
+    Returns ``(stats f32[3], bad bool[rows])`` where ``stats`` is
+    ``[nonfinite_count, max_abs_logit, min_row_entropy_nats]`` reduced
+    over the masked rows and ``bad`` flags each masked row that trips a
+    check (any non-finite value; ``|logit| > max_abs`` when
+    ``max_abs > 0``; softmax entropy below ``min_entropy`` nats when
+    ``min_entropy > 0``). Thresholds are trace-time constants, so the
+    whole guard is a handful of reductions fused into the step that
+    already produced the logits — the verdict rides home with the
+    sampled tokens at zero extra host syncs. Rows outside ``mask``
+    contribute count 0 / max 0 / entropy +inf and are never flagged.
+    """
+    z = logits.astype(jnp.float32)
+    row_mask = mask[:, None]
+    finite = jnp.isfinite(z)
+    nonfinite_rows = jnp.sum(
+        jnp.logical_and(~finite, row_mask), axis=1
+    ).astype(jnp.float32)
+    zf = jnp.where(finite, z, 0.0)
+    absmax_rows = jnp.max(jnp.where(row_mask, jnp.abs(zf), 0.0), axis=1)
+    # Stable softmax entropy per row over the finite entries:
+    # H = logsumexp(z) - sum(p * z). Non-finite entries get zero weight
+    # so a single NaN cannot also poison the entropy lane.
+    m = jnp.max(jnp.where(finite, zf, -jnp.inf), axis=1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    ez = jnp.where(finite, jnp.exp(zf - m), 0.0)
+    sz = jnp.maximum(jnp.sum(ez, axis=1), 1e-30)
+    ent = (jnp.log(sz) + m[:, 0]) - jnp.sum(ez * zf, axis=1) / sz
+    ent_masked = jnp.where(mask, ent, jnp.inf)
+    bad = jnp.logical_and(mask, nonfinite_rows > 0)
+    if max_abs > 0:
+        bad = jnp.logical_or(
+            bad, jnp.logical_and(mask, absmax_rows > max_abs)
+        )
+    if min_entropy > 0:
+        bad = jnp.logical_or(
+            bad, jnp.logical_and(mask, ent_masked < min_entropy)
+        )
+    stats = jnp.stack(
+        [
+            jnp.sum(nonfinite_rows),
+            jnp.max(jnp.where(mask, absmax_rows, 0.0)),
+            jnp.min(ent_masked),
+        ]
+    )
+    return stats, bad

@@ -92,3 +92,15 @@ def text_prefix_chain(
         h = dig.digest()
         chains.append(h.hex())
     return chains
+
+
+def token_fold(token_ids: Sequence[int]) -> str:
+    """blake2b-16 hex over a token-id sequence (4-byte little-endian
+    each) — the integrity plane's payload digest. Shared by the engine's
+    canary recording, the worker's result stamping, and the receive
+    path's verification, so a digest computed at any hop compares
+    directly against any other."""
+    dig = hashlib.blake2b(digest_size=CHAIN_DIGEST_SIZE)
+    for tid in token_ids:
+        dig.update(int(tid).to_bytes(4, "little", signed=True))
+    return dig.hexdigest()

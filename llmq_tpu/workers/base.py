@@ -848,20 +848,22 @@ class BaseWorker(abc.ABC):
             metrics=get_registry().summary() or None,
             prefix_chains=self._prefix_chains(),
             last_dispatch_ok_age_s=self._dispatch_ok_age(),
+            integrity=self._integrity_status(),
         )
         try:
-            # The liveness field is excluded (not serialized as null) when
-            # the watchdog is off, so default-config heartbeat payloads
-            # stay byte-identical to pre-watchdog workers.
+            # The liveness/integrity fields are excluded (not serialized
+            # as null) when their machinery is off, so default-config
+            # heartbeat payloads stay byte-identical to older workers.
+            unset = {
+                name
+                for name in ("last_dispatch_ok_age_s", "integrity")
+                if getattr(health, name) is None
+            }
             await self.broker.broker.publish(
                 self.queue + HEALTH_SUFFIX,
-                health.model_dump_json(
-                    exclude=(
-                        {"last_dispatch_ok_age_s"}
-                        if health.last_dispatch_ok_age_s is None
-                        else None
-                    )
-                ).encode("utf-8"),
+                health.model_dump_json(exclude=unset or None).encode(
+                    "utf-8"
+                ),
             )
         except Exception:  # noqa: BLE001 — heartbeats are best-effort
             self.logger.debug("Heartbeat publish failed", exc_info=True)
@@ -874,6 +876,13 @@ class BaseWorker(abc.ABC):
         """Seconds since the engine's last clean device dispatch, or None
         when no watchdog is running (the default — the heartbeat field is
         then omitted entirely)."""
+        return None
+
+    def _integrity_status(self) -> Optional[str]:
+        """Subclasses advertise the engine's numerics-integrity verdict
+        ('ok' / 'suspect') so the affinity janitor can reclaim a worker
+        whose device keeps failing canaries; None when every integrity
+        knob is off (the default — the field is omitted entirely)."""
         return None
 
     def _stats_with_robustness(self) -> Optional[dict]:

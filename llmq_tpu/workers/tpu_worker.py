@@ -33,7 +33,11 @@ from llmq_tpu.broker.manager import (
 )
 from llmq_tpu.core.models import Job
 from llmq_tpu.obs import emit_trace_event, trace_event, trace_event_at
-from llmq_tpu.utils.hashing import text_prefix_chain, token_prefix_chain
+from llmq_tpu.utils.hashing import (
+    text_prefix_chain,
+    token_fold,
+    token_prefix_chain,
+)
 from llmq_tpu.utils.host_mem import get_governor
 from llmq_tpu.workers.base import BaseWorker, DeadlineExceeded
 from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff
@@ -104,6 +108,13 @@ class TPUWorker(BaseWorker):
         self._mixed_step = mixed_step
         self.engine = None
         self._usage: dict = {}
+        # Result-payload integrity (LLMQ_RESULT_DIGEST): emitted token
+        # ids held between generate() and _build_result, which pops them
+        # onto the result with their blake2b digest.
+        self._result_tokens: dict = {}
+        # Checkpoint-load checksum ledger (weights.py streams it in);
+        # written once per _build_core, so bounded by the tensor count.
+        self._load_checksums: dict = {}
         # Prefix-affinity state: text-chain digest → times a processed job
         # walked that chunk (capped LRU; the top advertises in heartbeats),
         # the kv-fetch consumer tag, ship counters, and a lock serializing
@@ -323,12 +334,17 @@ class TPUWorker(BaseWorker):
             model_config = ModelConfig.from_pretrained(path)
             # mesh-aware streaming: each tensor lands on its shards
             # directly; host RSS stays ~one tensor (weights.py docstring).
+            # The ledger records what the checkpoint bytes hashed to at
+            # load — the provenance record a weight-audit mismatch is
+            # compared against when deciding load-vs-HBM corruption.
+            self._load_checksums = {}
             params = load_checkpoint(
                 path,
                 model_config,
                 dtype=dtype,
                 mesh=mesh,
                 quantize=quantize,
+                checksum_ledger=self._load_checksums,
             )
             tokenizer = HFTokenizer(spec)
 
@@ -870,6 +886,8 @@ class TPUWorker(BaseWorker):
             "prompt_tokens": out.prompt_tokens,
             "completion_tokens": out.completion_tokens,
         }
+        if self.config.result_digest:
+            self._result_tokens[job.id] = list(out.token_ids)
         self._trace_engine_timing(job.id, out)
         return out.text
 
@@ -921,6 +939,10 @@ class TPUWorker(BaseWorker):
         usage = self._usage.pop(job.id, None)
         if usage is not None:
             result.usage = usage
+        tokens = self._result_tokens.pop(job.id, None)
+        if tokens is not None:
+            result.token_ids = tokens
+            result.token_digest = token_fold(tokens)
         return result
 
     def _dispatch_ok_age(self):
@@ -930,6 +952,18 @@ class TPUWorker(BaseWorker):
         if watchdog is None:
             return None
         return round(watchdog.last_ok_age_s(), 3)
+
+    def _integrity_status(self):
+        if self.engine is None:
+            return None
+        core = self.engine.core
+        if (
+            core.logit_guard != "on"
+            and core.weight_audit_every <= 0
+            and core.canary_every <= 0
+        ):
+            return None
+        return core.integrity_status()
 
     def _engine_stats(self):
         if self.engine is None:

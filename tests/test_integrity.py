@@ -1,0 +1,270 @@
+"""Silent-data-corruption defense: unit tests for the integrity plane.
+
+The value-level checks layered over the crash-shaped fault containment:
+the position-salted device digest (weight audits), host-side page and
+token folds (KV spot checks, canaries, result payloads), the streamed
+load-time checksum ledger, the on-device logit guard's token parity at
+defaults and its trip classification, and the activation-stat taps'
+default no-op. The end-to-end detect→classify→recover story lives in
+``tests/test_chaos.py::TestSilentCorruption`` and
+``tools/integrity_probe.py``; this file pins the primitives.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from llmq_tpu.broker.chaos import BitFlipInjector  # noqa: E402
+from llmq_tpu.core.faults import (  # noqa: E402
+    FAULT_NUMERICAL,
+    LogitGuardError,
+    classify_failure,
+)
+from llmq_tpu.core.models import Result  # noqa: E402
+from llmq_tpu.engine.integrity import (  # noqa: E402
+    _fold_leaf,
+    diff_digests,
+    digest_params,
+    page_digests,
+    token_fold,
+)
+
+
+class TestDigests:
+    def test_fold_is_deterministic_across_reads(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((16, 8)), jnp.float32
+        )
+        a = np.asarray(_fold_leaf(x))
+        b = np.asarray(_fold_leaf(x))
+        np.testing.assert_array_equal(a, b)
+
+    def test_fold_sees_transpositions(self):
+        # Plain xor/sum folds are permutation-blind; the index salt must
+        # make swapping two (distinct) elements change the digest.
+        x = jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)
+        y = jnp.asarray([2.0, 1.0, 3.0, 4.0], jnp.float32)
+        assert np.asarray(_fold_leaf(x)).tolist() != (
+            np.asarray(_fold_leaf(y)).tolist()
+        )
+
+    def test_fold_hashes_stored_bits_not_values(self):
+        # int8 leaves (quantized weights) hash as bytes: a single flipped
+        # bit changes the digest even though no float conversion exists.
+        x = jnp.asarray(np.arange(32, dtype=np.int8))
+        y = x.at[5].set(x[5] ^ 0x55)
+        assert np.asarray(_fold_leaf(x)).tolist() != (
+            np.asarray(_fold_leaf(y)).tolist()
+        )
+
+    def test_diff_digests_names_exactly_the_corrupted_leaf(self):
+        rng = np.random.default_rng(1)
+        params = {
+            "embed": jnp.asarray(rng.standard_normal((8, 4)), jnp.float32),
+            "layers": {
+                "w1": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32),
+                "w2": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32),
+            },
+        }
+        baseline = digest_params(params)
+        assert diff_digests(baseline, digest_params(params)) == []
+        params["layers"]["w2"] = params["layers"]["w2"].at[0, 0].add(1.0)
+        changed = diff_digests(baseline, digest_params(params))
+        assert changed == ["['layers']['w2']"]
+
+    def test_diff_digests_flags_vanished_leaves(self):
+        base = {"a": (1, 2), "b": (3, 4)}
+        assert diff_digests(base, {"a": (1, 2)}) == ["b"]
+
+    def test_page_digests_localize_a_corrupted_page(self):
+        pages = np.random.default_rng(2).standard_normal((4, 8, 8))
+        base = page_digests(pages)
+        assert base == page_digests(pages.copy())
+        pages[2, 0, 0] += 1.0
+        now = page_digests(pages)
+        assert [i for i in range(4) if now[i] != base[i]] == [2]
+
+
+class TestTokenFold:
+    def test_matches_manual_blake2b(self):
+        ids = [3, 1, 4, 1, 5]
+        dig = hashlib.blake2b(digest_size=16)
+        for t in ids:
+            dig.update(int(t).to_bytes(4, "little", signed=True))
+        assert token_fold(ids) == dig.hexdigest()
+
+    def test_order_and_value_sensitive(self):
+        assert token_fold([1, 2, 3]) != token_fold([3, 2, 1])
+        assert token_fold([1, 2, 3]) != token_fold([1, 2, 4])
+        assert token_fold([]) == token_fold(())
+
+    def test_result_verify_token_digest(self):
+        base = dict(
+            id="r", prompt="p", result="x", worker_id="w", duration_ms=1.0
+        )
+        # Legacy payloads (no digest) verify as None — never False, so
+        # old results cannot dead-letter on a check they never carried.
+        assert Result(**base).verify_token_digest() is None
+        ids = [7, 8, 9]
+        good = Result(**base, token_ids=ids, token_digest=token_fold(ids))
+        assert good.verify_token_digest() is True
+        bad = Result(
+            **base, token_ids=ids, token_digest=token_fold([7, 8])
+        )
+        assert bad.verify_token_digest() is False
+
+
+class TestChecksumLedger:
+    def test_streamed_load_fills_a_deterministic_ledger(self, tmp_path):
+        pytest.importorskip("safetensors.numpy")
+        from llmq_tpu.engine.weights import load_checkpoint
+        from tests.test_weights_streaming import _synthetic_checkpoint
+
+        ckpt = _synthetic_checkpoint(tmp_path / "ck", seed=7)
+        first: dict = {}
+        second: dict = {}
+        load_checkpoint(ckpt, dtype=jnp.float32, checksum_ledger=first)
+        load_checkpoint(ckpt, dtype=jnp.float32, checksum_ledger=second)
+        assert first and first == second
+        other: dict = {}
+        load_checkpoint(
+            _synthetic_checkpoint(tmp_path / "ck2", seed=8),
+            dtype=jnp.float32,
+            checksum_ledger=other,
+        )
+        assert set(other) == set(first)
+        assert other != first
+
+
+# --- engine-level: guard parity at defaults + trip classification -------
+
+MAX_TOKENS = 12
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    from llmq_tpu.models.presets import get_preset
+    from llmq_tpu.models.transformer import init_params
+
+    config = get_preset("tiny")
+    params = init_params(config, jax.random.key(0), dtype=jnp.float32)
+    return config, params
+
+
+def _build_core(tiny_setup, **overrides):
+    from llmq_tpu.engine.engine import EngineConfig, EngineCore
+    from llmq_tpu.engine.tokenizer import ByteTokenizer
+    from llmq_tpu.parallel import make_mesh
+
+    config, params = tiny_setup
+    cfg = EngineConfig(
+        max_num_seqs=4,
+        max_model_len=64,
+        page_size=8,
+        num_pages=32,
+        kv_dtype=jnp.float32,
+        **overrides,
+    )
+    return EngineCore(
+        config,
+        params,
+        ByteTokenizer(),
+        mesh=make_mesh(tensor_parallel=1),
+        engine_config=cfg,
+    )
+
+
+def _run_all(core) -> dict:
+    from llmq_tpu.engine.sampling import SamplingParams
+
+    for i in range(3):
+        core.add_request(
+            f"g{i}",
+            prompt=f"integrity unit {i} " + "ab " * i,
+            params=SamplingParams(
+                max_tokens=MAX_TOKENS, temperature=0.0, ignore_eos=True
+            ),
+        )
+    outs = {}
+    while core.has_work:
+        for out in core.step():
+            outs[out.rid] = list(out.token_ids)
+    return outs
+
+
+class TestLogitGuard:
+    def test_guard_on_is_token_identical_to_guard_off(self, tiny_setup):
+        plain = _build_core(tiny_setup)
+        baseline = _run_all(plain)
+        plain.stop_watchdog()
+        assert baseline and all(v for v in baseline.values())
+
+        guarded = _build_core(tiny_setup, logit_guard="on")
+        try:
+            assert _run_all(guarded) == baseline
+            assert guarded.guard_trips == 0
+        finally:
+            guarded.stop_watchdog()
+
+    def test_nan_logits_trip_and_classify_as_numerical_fault(
+        self, tiny_setup
+    ):
+        from llmq_tpu.engine.sampling import SamplingParams
+
+        core = _build_core(tiny_setup, logit_guard="on")
+        BitFlipInjector(
+            "logit", mode="nan", seed=5, after_range=(1, 2)
+        ).bind(core)
+        core.add_request(
+            "t0",
+            prompt="trip me",
+            params=SamplingParams(
+                max_tokens=MAX_TOKENS, temperature=0.0, ignore_eos=True
+            ),
+        )
+        try:
+            with pytest.raises(LogitGuardError) as exc_info:
+                while core.has_work:
+                    core.step()
+            assert classify_failure(exc_info.value) == FAULT_NUMERICAL
+            assert "t0" in exc_info.value.suspects
+            assert core.guard_trips >= 1
+            # A guard trip alone does NOT mark the core suspect: blame is
+            # attributed by the recovery path (rebuild + replay), and the
+            # suspect verdict is reserved for audit/canary evidence that
+            # the DEVICE, not the batch, is corrupting.
+            assert core.integrity_status() == "ok"
+        finally:
+            core.stop_watchdog()
+
+
+class TestActStatTaps:
+    def test_taps_are_identity_no_ops_by_default(self, monkeypatch):
+        from llmq_tpu.models import transformer as tr
+
+        monkeypatch.delenv("LLMQ_ACT_STATS", raising=False)
+        x = jnp.ones((2, 2))
+        assert tr._tap(x, "unit.test") is x  # same object: nothing traced
+        assert tr.pop_act_stats() == []
+
+    def test_taps_record_under_jit_when_enabled(self, monkeypatch):
+        from llmq_tpu.models import transformer as tr
+
+        monkeypatch.setenv("LLMQ_ACT_STATS", "1")
+        tr.pop_act_stats()  # drop anything a prior test left behind
+
+        @jax.jit
+        def f(x):
+            return tr._tap(x * 2.0, "unit.jit", 3)
+
+        f(jnp.asarray([-1.0, 2.0])).block_until_ready()
+        jax.effects_barrier()
+        stats = tr.pop_act_stats()
+        assert ("unit.jit", 3, 3.0, 4.0) in [
+            (name, layer, mean, mx) for name, layer, mean, mx in stats
+        ]
+        assert tr.pop_act_stats() == []

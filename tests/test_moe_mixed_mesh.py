@@ -19,18 +19,44 @@ axis. Measured isolation matrix (CPU, 8 virtual devices, this commit):
 The divergence appears at the FIRST generated token (prefill logits),
 only for the MoE model (the dense flagship matches on every mesh), and
 (4,2,1) diverging on a short 2-page prompt rules out the ring-attention
-long-prompt path as the sole trigger. Prime suspect: ``_moe_mlp``'s
-global ``argsort``/``segment_sum`` over the flattened token axis — under
-GSPMD a token dimension sharded over sp×(dp|tp) repartitions the
-grouped-matmul reduction differently than any single-axis sharding,
-and the tiny random model's near-tied logits flip. Until the expert
-path is made shard-stable (or proven benign at real-model scale),
-cross-mesh snapshot migration must stay on the known-good meshes below.
+long-prompt path as the sole trigger.
+
+BISECTED (r14, LLMQ_ACT_STATS per-op taps on the first prefill
+dispatch, mesh (1,2,2) vs (1,1,1), noise floor from the known-good
+meshes (1,2,1)/(1,1,4) ≈ 1e-7 relative on mean|x|):
+
+    tap              layer 0 rel      verdict
+    ln1.out          0                clean
+    attn.q/k/v       ~1e-7            clean (noise floor)
+    attn.out         2.6e-4           <- divergence enters HERE
+    moe.combine      4.8e-3           downstream amplification
+    lm_head.logits   1.8e-2           flips the near-tied argmax
+
+The original prime suspect — ``_moe_mlp``'s ``argsort``/``segment_sum``
+combine — is EXONERATED as the entry point: its inputs already differ.
+The corruption enters inside the LAYER-0 sp-ring prefill attention
+(``ops/dispatch.prefill_attention``) while its q/k/v inputs are still
+bit-stable, and only when the program also contains the MoE block: the
+dense flagship on the identical (1,2,2) mesh holds attn.out at 7.7e-8.
+Every diverging mesh — (2,2,1), (1,2,2), (1,2,4) — produces the SAME
+corrupted stats bit-for-bit, so this is one deterministic alternative
+partitioning, not accumulation jitter. Conclusion: GSPMD sharding
+propagation from the MoE block's flattened-token-axis ops (gather /
+argsort / segment_sum) repartitions the upstream ring attention when
+sp is combined with any second mesh axis, and the re-partitioned
+softmax accumulates differently by O(1e-4) — enough to flip the tiny
+random model's near-tied logits. Candidate fixes: pin the attention
+input sharding with an explicit ``with_sharding_constraint`` on the
+token axis before the ring, or make the MoE combine shard-local
+(segment_sum per sp shard + all-gather). Until then cross-mesh
+snapshot migration must stay on the known-good meshes below.
 
 Repro: ``python -c "from __graft_entry__ import _engine_run;
 print(_engine_run(1,1,1,moe=True)[0]['long'],
 _engine_run(2,2,2,moe=True)[0]['long'])"`` with
 ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+Bisection harness: LLMQ_ACT_STATS=1, run one prefill, diff
+``models.transformer.pop_act_stats()`` between meshes per (op, layer).
 """
 
 import pytest
@@ -40,9 +66,11 @@ from __graft_entry__ import _engine_run
 
 @pytest.mark.skip(
     reason="KNOWN DIVERGENCE (pre-existing, pinned): MoE + sp>=2 combined "
-    "with any other mesh axis flips greedy tokens vs single-device — see "
-    "module docstring ticket. Remove this skip once _moe_mlp is "
-    "shard-stable; the body then asserts the fix."
+    "with any other mesh axis flips greedy tokens vs single-device. "
+    "Bisected (r14 act-stat taps) to the layer-0 sp-ring prefill "
+    "attention being repartitioned by the MoE block's token-axis ops — "
+    "see module docstring ticket. Remove this skip once the attention "
+    "input sharding is pinned; the body then asserts the fix."
 )
 def test_moe_mixed_mesh_greedy_parity():
     """The dryrun's failing assertion, as a test: MoE on dp=2 x sp=2 x

@@ -171,13 +171,14 @@ def test_ladders_parse():
     """Both runbooks yield their full command ladders (a parser that
     silently matches nothing would make every other test vacuous)."""
     names = [name for name, _, _ in all_steps()]
-    assert sum(n.startswith("hardware_session") for n in names) >= 10
-    assert sum(n.startswith("chip_watch") for n in names) >= 17
+    assert sum(n.startswith("hardware_session") for n in names) >= 11
+    assert sum(n.startswith("chip_watch") for n in names) >= 18
     joined = " ".join(names)
     assert "kernel_v123" in joined and "queue_drain_tpu" in joined
     assert "metrics_probe" in joined
     assert "fleet_chaos_probe" in joined
     assert "engine_fault_probe" in joined
+    assert "integrity_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -347,6 +348,24 @@ def test_engine_fault_probe_runs():
     assert "oom-ladder leg ok" in proc.stdout
     assert "xla-error leg ok" in proc.stdout
     assert "metric: engine_fault_probe_ok" in proc.stdout
+
+
+def test_integrity_probe_runs():
+    """The silent-data-corruption rung runs end to end on CPU: a NaN
+    logit flip trips the on-device guard and recovers with token
+    parity, a finite weight flip is named by the digest audit while
+    the KV spot-check stays clean, and the golden-prompt canary passes
+    clean then catches a corrupted replay."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/integrity_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:integrity_probe", proc)
+    assert "guard-trip leg ok" in proc.stdout
+    assert "weight-audit leg ok" in proc.stdout
+    assert "canary leg ok" in proc.stdout
+    assert "metric: integrity_probe_ok" in proc.stdout
 
 
 def test_bench_tiny_int4_runs():

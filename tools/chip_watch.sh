@@ -49,6 +49,10 @@ run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
 #     OOM degradation ladder order, XLA-error snapshot recovery — all
 #     with fault-free token parity (dispatch hooks on the real chip).
 run 900 engine_fault_probe python tools/engine_fault_probe.py
+# 1g. Silent-data-corruption defense: logit-guard trip with parity,
+#     weight-digest audit naming a flipped shard, golden-prompt canary
+#     round trip (value-level checks on the real chip).
+run 900 integrity_probe python tools/integrity_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

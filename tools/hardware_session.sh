@@ -61,6 +61,11 @@ run 900 fleet_chaos_probe python tools/fleet_chaos_probe.py
 # each with token parity against a fault-free run (the dispatch hooks
 # run against the real chip here).
 run 900 engine_fault_probe python tools/engine_fault_probe.py
+# Silent-data-corruption defense: logit-guard trip -> numerical_fault
+# rebuild with parity, weight-digest audit naming a flipped shard, and
+# the golden-prompt canary round trip — the value-level checks the
+# crash-shaped probes above can't see.
+run 900 integrity_probe python tools/integrity_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
