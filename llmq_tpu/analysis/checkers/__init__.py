@@ -9,7 +9,10 @@ from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
 from llmq_tpu.analysis.checkers.pickles import PickleSnapshotChecker
 from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
 from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
-from llmq_tpu.analysis.checkers.wallclock import WallclockDurationChecker
+from llmq_tpu.analysis.checkers.wallclock import (
+    RawClockReadChecker,
+    WallclockDurationChecker,
+)
 
 ALL_CHECKERS = (
     OrphanTaskChecker,
@@ -19,6 +22,7 @@ ALL_CHECKERS = (
     JaxHostSyncChecker,
     CollectiveAxisChecker,
     WallclockDurationChecker,
+    RawClockReadChecker,
     PickleSnapshotChecker,
     HostBufferChecker,
     DeviceFetchChecker,

@@ -1,4 +1,4 @@
-"""wallclock-duration: ``time.time()`` arithmetic used to measure durations.
+"""Clock-discipline rules: wallclock-duration and raw-clock-read.
 
 Wall-clock time jumps — NTP slews, suspend/resume, leap smearing — so a
 duration computed as the difference of two ``time.time()`` samples can come
@@ -16,6 +16,19 @@ where one operand is a persisted wall stamp from elsewhere — a message's
 ``enqueued_at``, a parameter, a config value — because cross-process ages
 *must* use wall time (monotonic clocks don't compare across hosts). That is
 exactly the broker's TTL arithmetic, which is correct as written.
+
+``raw-clock-read`` guards the fleet simulator's virtual clock: every
+scheduling-policy decision (janitor staleness, deadline budgets, heartbeat
+cadence, redelivery backoff, watchdog stamps) must read time through
+``llmq_tpu.utils.clock`` so the sim can replace it. A raw
+``time.time()``/``time.monotonic()``/``time.perf_counter()`` call inside a
+policy module bypasses injection and silently splits the timeline between
+real and virtual clocks. The rule fires only in the modules listed in
+``POLICY_MODULES`` (plus everything under ``llmq_tpu/sim/``);
+``utils/clock.py`` itself is the one blessed reader. Where a policy-module
+read genuinely wants *real* time (e.g. the sim harness reporting how many
+real seconds a virtual run took), suppress with
+``# llmq: ignore[raw-clock-read]``.
 """
 
 from __future__ import annotations
@@ -38,6 +51,43 @@ WALLCLOCK_DURATION = Rule(
     "warning",
     "duration computed from time.time() samples; use time.monotonic()",
 )
+
+RAW_CLOCK_READ = Rule(
+    "raw-clock-read",
+    "error",
+    "raw clock read in a scheduling-policy module; read time through "
+    "llmq_tpu.utils.clock so the fleet sim can inject a virtual clock",
+)
+
+#: Modules whose time reads drive scheduling policy and therefore must go
+#: through the injectable clock. Matched as path suffixes (posix-style);
+#: ``_POLICY_DIRS`` entries match any file under the directory.
+POLICY_MODULES = (
+    "llmq_tpu/broker/manager.py",
+    "llmq_tpu/broker/memory.py",
+    "llmq_tpu/broker/base.py",
+    "llmq_tpu/workers/base.py",
+    "llmq_tpu/engine/watchdog.py",
+    "llmq_tpu/core/models.py",
+    "llmq_tpu/obs/trace.py",
+)
+_POLICY_DIRS = ("llmq_tpu/sim/",)
+
+#: The one module allowed to touch the real clocks.
+_BLESSED = ("llmq_tpu/utils/clock.py",)
+
+_RAW_CLOCK_CALLS = frozenset(
+    {"time.time", "time.monotonic", "time.perf_counter"}
+)
+
+
+def _is_policy_module(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if any(norm.endswith(suffix) for suffix in _BLESSED):
+        return False
+    if any(norm.endswith(suffix) for suffix in POLICY_MODULES):
+        return True
+    return any(directory in norm for directory in _POLICY_DIRS)
 
 
 def _is_wallclock_call(node: ast.AST, imports: ImportMap) -> bool:
@@ -111,3 +161,32 @@ class WallclockDurationChecker(Checker):
                             "suspend/resume); use time.monotonic()"
                         ),
                     )
+
+
+class RawClockReadChecker(Checker):
+    rules = (RAW_CLOCK_READ,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        if not _is_policy_module(source.path):
+            return
+        imports = ImportMap(source.tree)
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = imports.resolve(node.func)
+            if full not in _RAW_CLOCK_CALLS:
+                continue
+            replacement = (
+                "clock.wall()" if full == "time.time" else "clock.monotonic()"
+            )
+            yield Violation(
+                rule=RAW_CLOCK_READ,
+                path=source.path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"{full}() read in a scheduling-policy module bypasses "
+                    f"clock injection (virtual-time sim would diverge); use "
+                    f"llmq_tpu.utils.{replacement}"
+                ),
+            )

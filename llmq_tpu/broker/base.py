@@ -22,12 +22,12 @@ from __future__ import annotations
 import abc
 import asyncio
 import json
-import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, Optional
 
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils import clock
 
 
 def new_message_id() -> str:
@@ -62,7 +62,9 @@ class StoredMessage:
     message_id: str = field(default_factory=new_message_id)
     headers: Dict[str, Any] = field(default_factory=dict)
     delivery_count: int = 0
-    enqueued_at: float = field(default_factory=time.time)
+    # Wall stamp (TTL ages must compare across processes; the injectable
+    # clock lets the sim age messages in virtual time).
+    enqueued_at: float = field(default_factory=clock.wall)
 
     def to_json(self) -> str:
         return json.dumps(
@@ -83,7 +85,7 @@ class StoredMessage:
             message_id=d["message_id"],
             headers=d.get("headers", {}),
             delivery_count=d.get("delivery_count", 0),
-            enqueued_at=d.get("enqueued_at", time.time()),
+            enqueued_at=d.get("enqueued_at", clock.wall()),
         )
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qsl
@@ -39,6 +40,25 @@ from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
 from llmq_tpu.core.models import QueueStats
 
 logger = logging.getLogger(__name__)
+
+
+def resolve_chaos_seed(seed: Optional[int] = None) -> int:
+    """Effective seed for a chaos scheme: an explicit value wins, else
+    ``LLMQ_CHAOS_SEED``, else 0.
+
+    Every scheme logs the value this returns at activation, so a failing
+    chaos run in CI can always be replayed: grab the seed from the log,
+    export ``LLMQ_CHAOS_SEED``, rerun.
+    """
+    if seed is not None:
+        return int(seed)
+    raw = os.environ.get("LLMQ_CHAOS_SEED", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning("ignoring non-integer LLMQ_CHAOS_SEED=%r", raw)
+    return 0
 
 
 #: Engine dispatch kinds (as reported to ``EngineCore.on_dispatch``) that
@@ -68,7 +88,7 @@ class WorkerKillSwitch:
         phase: str,
         on_kill,
         *,
-        seed: int = 0,
+        seed: Optional[int] = None,
         after_range=(1, 5),
     ) -> None:
         if phase not in PHASE_KINDS:
@@ -78,9 +98,16 @@ class WorkerKillSwitch:
         self.phase = phase
         self.kinds = PHASE_KINDS[phase]
         self.on_kill = on_kill
-        self.after = random.Random(seed).randint(*after_range)
+        self.seed = resolve_chaos_seed(seed)
+        self.after = random.Random(self.seed).randint(*after_range)
         self.matched = 0
         self.fired = False
+        logger.info(
+            "chaos: kill switch armed (phase=%s seed=%d after=%d)",
+            self.phase,
+            self.seed,
+            self.after,
+        )
 
     def __call__(self, kind: str) -> None:
         if self.fired or kind not in self.kinds:
@@ -129,7 +156,7 @@ class DeviceFaultInjector:
         phase: str,
         mode: str,
         *,
-        seed: int = 0,
+        seed: Optional[int] = None,
         after_range=(1, 5),
         hang_s: float = 2.0,
     ) -> None:
@@ -145,9 +172,17 @@ class DeviceFaultInjector:
         self.kinds = PHASE_KINDS[phase]
         self.mode = mode
         self.hang_s = hang_s
-        self.after = random.Random(seed).randint(*after_range)
+        self.seed = resolve_chaos_seed(seed)
+        self.after = random.Random(self.seed).randint(*after_range)
         self.matched = 0
         self.fired = False
+        logger.info(
+            "chaos: fault injector armed (phase=%s mode=%s seed=%d after=%d)",
+            self.phase,
+            self.mode,
+            self.seed,
+            self.after,
+        )
 
     def __call__(self, kind: str) -> None:
         if self.fired or kind not in self.kinds:
@@ -222,7 +257,7 @@ class BitFlipInjector:
         target: str,
         *,
         mode: str = "nan",
-        seed: int = 0,
+        seed: Optional[int] = None,
         after_range=(1, 5),
         sticky: bool = False,
         leaf: Optional[str] = None,
@@ -240,8 +275,18 @@ class BitFlipInjector:
         self.sticky = sticky
         self.leaf = leaf
         self.page = page
-        self._rng = random.Random(seed)
+        self.seed = resolve_chaos_seed(seed)
+        self._rng = random.Random(self.seed)
         self.after = self._rng.randint(*after_range)
+        logger.info(
+            "chaos: bit-flip injector armed "
+            "(target=%s mode=%s seed=%d after=%d sticky=%s)",
+            self.target,
+            self.mode,
+            self.seed,
+            self.after,
+            self.sticky,
+        )
         self.matched = 0
         self.fired = 0
         # Bounded by firings: one entry per arming (sticky re-arms once
@@ -348,7 +393,11 @@ class ChaosBroker(Broker):
         self.kill_every = int(params.get("kill_every", 0))
         self.dup_every = int(params.get("dup_every", 0))
         self.delay_ms = float(params.get("delay_ms", 0))
-        self.seed = int(params.get("seed", 0))
+        raw_seed = params.get("seed")
+        self.seed = resolve_chaos_seed(
+            int(raw_seed) if raw_seed is not None else None
+        )
+        self._seed_logged = False
         from llmq_tpu.broker.base import make_broker
 
         self.inner = make_broker(f"{inner_scheme}://{rest}")
@@ -368,6 +417,18 @@ class ChaosBroker(Broker):
         await self.inner.connect()
         self.inner.on_connection_lost = self._notify_connection_lost
         self._dead = False
+        if not self._seed_logged:
+            # Once per session, not per reconnect: the seed is the replay
+            # handle, and a kill-heavy run reconnects constantly.
+            self._seed_logged = True
+            logger.info(
+                "chaos: broker active (seed=%d kill_every=%d dup_every=%d "
+                "delay_ms=%g)",
+                self.seed,
+                self.kill_every,
+                self.dup_every,
+                self.delay_ms,
+            )
 
     async def close(self) -> None:
         self._dead = True

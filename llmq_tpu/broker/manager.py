@@ -22,10 +22,8 @@ match, stale heartbeat, or the flag off → the shared queue, unchanged.
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import json
 import logging
-import time
 from typing import Any, Dict, List, Optional
 
 from llmq_tpu.broker.base import Broker, DeliveredMessage, MessageHandler
@@ -41,8 +39,9 @@ from llmq_tpu.obs import (
     trace_event,
     trace_from_payload,
 )
+from llmq_tpu.utils import clock
 from llmq_tpu.utils.aio import reap_all, spawn
-from llmq_tpu.utils.hashing import text_prefix_chain
+from llmq_tpu.utils.hashing import rendezvous_pick, text_prefix_chain
 
 logger = logging.getLogger(__name__)
 
@@ -105,17 +104,9 @@ def kv_fetch_queue_name(queue: str, worker_id: str) -> str:
     return f"{queue}.kv.{worker_id}"
 
 
-def rendezvous_pick(digest: str, workers: List[str]) -> str:
-    """Deterministic owner among several workers advertising the same
-    digest (highest-random-weight hashing): every submitter picks the
-    same worker without coordination, and losing one advertiser only
-    remaps the chains it owned."""
-    return max(
-        workers,
-        key=lambda w: hashlib.blake2b(
-            (digest + "|" + w).encode("utf-8"), digest_size=8
-        ).digest(),
-    )
+# rendezvous_pick moved to llmq_tpu.utils.hashing (re-exported above for
+# existing importers): it is a content-hashing primitive the sim and the
+# affinity router both lean on, not broker plumbing.
 
 
 def job_affinity_text(job: Job) -> str:
@@ -276,7 +267,7 @@ class BrokerManager:
         """``{text-chain digest hex: [worker_id, ...]}`` built from fresh
         heartbeats, cached for ``AFFINITY_REFRESH_S`` so high-rate submit
         loops don't peek the health queue per job."""
-        now = time.monotonic()
+        now = clock.monotonic()
         if now - self._affinity_at.get(queue, float("-inf")) < AFFINITY_REFRESH_S:
             return self._affinity_map.get(queue, {})
         mapping: Dict[str, List[str]] = {}
@@ -318,7 +309,7 @@ class BrokerManager:
         up to AFFINITY_REFRESH_S old, so a worker can die inside the cache
         window and still look routable without this re-check."""
         seen = self._worker_seen.get(queue, {})
-        now = time.time()
+        now = clock.wall()
         return [w for w in workers if now - seen.get(w, 0.0) <= STALE_AFTER_S]
 
     async def _route_for_affinity(self, queue: str, job: Job) -> str:
@@ -382,7 +373,7 @@ class BrokerManager:
             beats = {}
         self._record_worker_seen(queue, beats)
         seen = self._worker_seen.get(queue, {})
-        now = time.time()
+        now = clock.wall()
         reclaimed = 0
         wedged = self._stale_dispatch_workers(beats)
         for wid, last in list(seen.items()):
@@ -444,7 +435,7 @@ class BrokerManager:
         avg_duration_ms — the PR 7 obs plane. None when no worker has
         reported a duration yet (then admission control stays out of the
         way: no data, no shedding). Cached like the affinity map."""
-        now = time.monotonic()
+        now = clock.monotonic()
         cached = self._fleet_rate.get(queue)
         if cached is not None and now - cached[0] < AFFINITY_REFRESH_S:
             return cached[1]
@@ -469,7 +460,7 @@ class BrokerManager:
         observed fleet service rate cannot meet this job's deadline, fail
         it NOW as a dead-letter instead of letting it queue, time out,
         and waste a worker slot discovering that."""
-        budget_s = deadline_at - time.time()
+        budget_s = deadline_at - clock.wall()
         if budget_s <= 0:
             return True  # already expired at submit
         rate = await self._observed_fleet_rate(queue)
@@ -514,7 +505,7 @@ class BrokerManager:
         if job.deadline_at is None:
             budget_ms = job.deadline_ms or self.config.deadline_ms or 0
             if budget_ms > 0:
-                job.deadline_at = time.time() + budget_ms / 1000.0
+                job.deadline_at = clock.wall() + budget_ms / 1000.0
         if job.deadline_at is not None:
             try:
                 shed = await self._should_shed(queue, job.deadline_at)

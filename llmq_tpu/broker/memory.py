@@ -13,7 +13,6 @@ test_integration.py pattern with a real RabbitMQ).
 from __future__ import annotations
 
 import asyncio
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, Optional, Tuple
@@ -26,6 +25,7 @@ from llmq_tpu.broker.base import (
     new_message_id,
 )
 from llmq_tpu.core.models import QueueStats
+from llmq_tpu.utils import clock
 from llmq_tpu.utils.aio import spawn
 
 DEFAULT_MAX_REDELIVERIES = 3
@@ -159,7 +159,7 @@ class BrokerCore:
         q = self.queues.get(queue)
         if q is None:
             return
-        now = time.time()
+        now = clock.wall()
         while q.ready:
             if q.expired(q.ready[0], now):
                 q.ready.popleft()
@@ -309,7 +309,7 @@ class BrokerCore:
         q = self.queues.get(queue)
         if q is None or not q.ready:
             return None
-        now = time.time()
+        now = clock.wall()
         while q.ready:
             msg = q.ready.popleft()
             if q.expired(msg, now):

@@ -222,7 +222,10 @@ def monitor() -> None:
               help="Refresh period in seconds")
 @click.option("--once", is_flag=True,
               help="Render one snapshot and exit (scripts/tests)")
-def monitor_top_cmd(queue, interval, once):
+@click.option("--top", "top_n", type=int, default=40, show_default=True,
+              help="Rows to render: the N busiest workers by occupancy "
+                   "(the summary line always covers the whole fleet)")
+def monitor_top_cmd(queue, interval, once, top_n):
     """Live fleet dashboard: tok/s, occupancy, TTFT/ITL percentiles,
     reconnects — aggregated from fresh worker heartbeats."""
     from llmq_tpu.cli.monitor import monitor_top
@@ -230,7 +233,8 @@ def monitor_top_cmd(queue, interval, once):
     try:
         asyncio.run(
             monitor_top(
-                queue, interval=interval, iterations=1 if once else None
+                queue, interval=interval,
+                iterations=1 if once else None, top=top_n,
             )
         )
     except KeyboardInterrupt:
@@ -463,6 +467,64 @@ def broker_serve(host: str, port: int, persist_dir: Optional[str],
         asyncio.run(server.serve_forever())
     except KeyboardInterrupt:
         click.echo("broker stopped")
+
+
+# ---------------------------------------------------------------------------
+# fleet simulation (virtual-clock discrete-event twin)
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def sim() -> None:
+    """Fleet twin: deterministic virtual-clock simulation of the worker
+    control plane with invariant checking and policy regressions."""
+
+
+@sim.command("run")
+@click.argument("name", required=False)
+@click.option("--file", "file_", default=None,
+              help="Load the scenario from a JSON file instead of NAME")
+@click.option("--seed", type=int, default=None, help="Override scenario seed")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the full report summary as JSON")
+def sim_run_cmd(name, file_, seed, as_json):
+    """Run a scenario and check invariants (exit 1 on violations)."""
+    from llmq_tpu.cli.sim import sim_run
+
+    sim_run(name, file_, seed, as_json)
+
+
+@sim.command("replay")
+@click.argument("name", required=False)
+@click.option("--file", "file_", default=None,
+              help="Load the scenario from a JSON file instead of NAME")
+@click.option("--seed", type=int, default=None, help="Override scenario seed")
+def sim_replay_cmd(name, file_, seed):
+    """Run a scenario twice; exit 1 unless the event streams are
+    digest-identical (determinism proof)."""
+    from llmq_tpu.cli.sim import sim_replay
+
+    sim_replay(name, file_, seed)
+
+
+@sim.command("regress")
+@click.argument("name", required=False)
+@click.option("--detuned", is_flag=True,
+              help="Prove teeth: run with the documented detune and "
+                   "require the baseline bounds to BREAK")
+def sim_regress_cmd(name, detuned):
+    """Run the policy regression suite against recorded baselines."""
+    from llmq_tpu.cli.sim import sim_regress
+
+    sim_regress(name, detuned)
+
+
+@sim.command("list")
+def sim_list_cmd():
+    """List named scenarios with their documented detunes."""
+    from llmq_tpu.cli.sim import sim_list
+
+    sim_list()
 
 
 def main() -> None:  # console-script entry point

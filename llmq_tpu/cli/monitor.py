@@ -355,9 +355,13 @@ def _render_top(
     beats: Dict[str, WorkerHealth],
     stats: QueueStats,
     quarantine_depth: Optional[int] = None,
+    top: int = 40,
 ):
     """One refresh frame: fleet summary line + per-worker table, built
-    from the freshest heartbeat per worker."""
+    from the freshest heartbeat per worker. At fleet scale (thousands of
+    heartbeats) only the ``top`` busiest rows render — sorted by batch
+    occupancy, the "who is actually loaded" axis — with a "+K more"
+    caption; the summary line always aggregates the whole fleet."""
     from rich.console import Group
 
     now = utcnow()
@@ -375,6 +379,9 @@ def _render_top(
         for h in fresh.values()
     ]
     occs = [o for o in occs if o is not None]
+    suspects = sum(
+        1 for h in beats.values() if h.integrity == "suspect"
+    )
     header = (
         f"queue [bold]{queue}[/bold] — {len(fresh)} fresh worker(s)"
         f", {len(beats) - len(fresh)} stale"
@@ -383,6 +390,10 @@ def _render_top(
     )
     if occs:
         header += f" | occupancy {sum(occs) / len(occs):.0%}"
+    if suspects:
+        # Superset-only, like the integrity column: a clean fleet's
+        # summary line is byte-identical to the pre-integrity one.
+        header += f" | [red]suspect {suspects}[/red]"
     if quarantine_depth:
         header += f" | [red]quarantined {quarantine_depth}[/red]"
     # The self-heal column is itself superset-only: it renders only when
@@ -418,8 +429,22 @@ def _render_top(
         cols.insert(8, "self-heal")
     for col in cols:
         table.add_column(col)
-    for wid in sorted(beats):
-        health = beats[wid]
+
+    def _occupancy_key(item):
+        wid, health = item
+        occ = (health.engine_stats or {}).get("batch_occupancy")
+        # Busiest first; occupancy ties (and workers not reporting it)
+        # fall back to worker id so the ordering is stable across frames.
+        return (-(occ if occ is not None else -1.0), wid)
+
+    ranked = sorted(beats.items(), key=_occupancy_key)
+    hidden = len(ranked) - top if top and len(ranked) > top else 0
+    if hidden:
+        ranked = ranked[:top]
+        table.caption = (
+            f"+{hidden} more worker(s) below the top {top} by occupancy"
+        )
+    for wid, health in ranked:
         es = health.engine_stats or {}
         is_stale = (now - health.last_seen).total_seconds() > STALE_AFTER_S
         occ = es.get("batch_occupancy")
@@ -451,6 +476,7 @@ async def monitor_top(
     *,
     interval: float = 2.0,
     iterations: Optional[int] = None,
+    top: int = 40,
 ) -> None:
     """`llmq-tpu monitor top`: live fleet dashboard over heartbeats —
     fleet tok/s, occupancy, TTFT/ITL percentiles, reconnects. Runs until
@@ -474,7 +500,10 @@ async def monitor_top(
                     else None
                 )
                 live.update(
-                    _render_top(queue, beats, stats, quarantine_depth=qdepth),
+                    _render_top(
+                        queue, beats, stats,
+                        quarantine_depth=qdepth, top=top,
+                    ),
                     refresh=True,
                 )
                 count += 1

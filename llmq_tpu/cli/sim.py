@@ -1,0 +1,144 @@
+"""`llmq-tpu sim` implementations: run/replay/regress fleet scenarios.
+
+Everything here is synchronous — :class:`~llmq_tpu.sim.harness.FleetSim`
+owns its own (virtual-time) event loop, so these commands must NOT be
+wrapped in ``asyncio.run``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+import click
+
+from llmq_tpu.sim.harness import FleetSim, SimReport
+from llmq_tpu.sim.invariants import check_invariants
+from llmq_tpu.sim.regression import (
+    REGRESSIONS,
+    report_metrics,
+    run_regression,
+)
+from llmq_tpu.sim.scenario import Scenario, get_scenario
+
+
+def _load_scenario(
+    name: Optional[str], file: Optional[str], seed: Optional[int]
+) -> Scenario:
+    if file:
+        with open(file, "r", encoding="utf-8") as fh:
+            scenario = Scenario.from_dict(json.load(fh))
+        if seed is not None:
+            scenario.seed = seed
+        return scenario
+    if not name:
+        raise click.UsageError("give a scenario NAME or --file")
+    try:
+        return get_scenario(name, seed=seed)
+    except KeyError as exc:
+        raise click.UsageError(str(exc)) from None
+
+
+def _print_report(report: SimReport, *, as_json: bool) -> int:
+    violations = check_invariants(report)
+    if as_json:
+        doc = report.summary()
+        doc["invariant_violations"] = violations
+        click.echo(json.dumps(doc, indent=2, default=str))
+    else:
+        summary = report.summary()
+        click.echo(
+            f"scenario {report.scenario!r} seed {report.seed}: "
+            f"{summary['submitted']} jobs → {summary['results']} results, "
+            f"{summary['failed']} dead-letters, "
+            f"{summary['quarantined']} quarantined "
+            f"({summary['virtual_s']}s virtual in {summary['wall_s']}s wall)"
+        )
+        click.echo(f"event digest: {report.digest}")
+        slo = report.slo_attainment()
+        if slo is not None:
+            click.echo(f"SLO attainment: {slo:.3f}")
+        if report.timed_out:
+            click.echo("TIMED OUT before all jobs settled", err=True)
+        if violations:
+            click.echo("invariant violations:", err=True)
+            for violation in violations:
+                click.echo(f"  - {violation}", err=True)
+        else:
+            click.echo("invariants: all hold")
+    return 1 if (violations or report.timed_out) else 0
+
+
+def sim_run(
+    name: Optional[str],
+    file: Optional[str],
+    seed: Optional[int],
+    as_json: bool,
+) -> None:
+    scenario = _load_scenario(name, file, seed)
+    report = FleetSim(scenario).run()
+    sys.exit(_print_report(report, as_json=as_json))
+
+
+def sim_replay(
+    name: Optional[str],
+    file: Optional[str],
+    seed: Optional[int],
+) -> None:
+    """Run the scenario twice and require event-identical digests."""
+    scenario = _load_scenario(name, file, seed)
+    first = FleetSim(scenario).run()
+    second = FleetSim(_load_scenario(name, file, seed)).run()
+    click.echo(f"run 1: {first.digest} ({len(first.events)} events)")
+    click.echo(f"run 2: {second.digest} ({len(second.events)} events)")
+    if first.digest == second.digest:
+        click.echo("replay: event-identical")
+        sys.exit(0)
+    click.echo("replay: DIVERGED", err=True)
+    sys.exit(1)
+
+
+def sim_list() -> None:
+    for spec in REGRESSIONS.values():
+        click.echo(f"{spec.name:20s} {spec.description}")
+        click.echo(f"{'':20s}   detune: {spec.detune} — {spec.detune_doc}")
+
+
+def sim_regress(name: Optional[str], detuned: bool) -> None:
+    """Run the regression suite (or one scenario). With --detuned the
+    expectation inverts: the detuned run must BREAK its baseline."""
+    names = [name] if name else list(REGRESSIONS)
+    exit_code = 0
+    for scenario_name in names:
+        if scenario_name not in REGRESSIONS:
+            raise click.UsageError(
+                f"unknown regression {scenario_name!r} "
+                f"(known: {', '.join(sorted(REGRESSIONS))})"
+            )
+        report, metrics, failures = run_regression(
+            scenario_name, detuned=detuned
+        )
+        if detuned:
+            spec = REGRESSIONS[scenario_name]
+            bound_failures = spec.check(report_metrics(report))
+            if bound_failures:
+                click.echo(
+                    f"{scenario_name}: detune detected "
+                    f"({len(bound_failures)} bound violations) — OK"
+                )
+            else:
+                click.echo(
+                    f"{scenario_name}: detune NOT detected — the "
+                    "regression has lost its teeth",
+                    err=True,
+                )
+                exit_code = 1
+        elif failures:
+            click.echo(f"{scenario_name}: FAIL", err=True)
+            for failure in failures:
+                click.echo(f"  - {failure}", err=True)
+            exit_code = 1
+        else:
+            click.echo(f"{scenario_name}: ok ({report.wall_s:.2f}s wall)")
+    sys.exit(exit_code)

@@ -25,6 +25,8 @@ from typing import Any, Dict, List, Optional
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
 
+from llmq_tpu.utils import clock
+
 _RESERVED_JOB_FIELDS = {
     "id",
     "prompt",
@@ -38,7 +40,11 @@ _RESERVED_JOB_FIELDS = {
 
 
 def utcnow() -> datetime:
-    return datetime.now(timezone.utc)
+    """Current UTC time through the injectable clock — heartbeats,
+    staleness math, and result stamps all derive from this, so the fleet
+    sim can move them together. Identical to ``datetime.now(timezone.utc)``
+    under the default clock."""
+    return datetime.fromtimestamp(clock.wall(), tz=timezone.utc)
 
 
 class SamplingOptions(BaseModel):

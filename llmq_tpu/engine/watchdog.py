@@ -23,16 +23,29 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
-import time
 from typing import Callable, Optional, Tuple
 
 from llmq_tpu.core.faults import HungDispatchError
+from llmq_tpu.utils import clock
 
 logger = logging.getLogger("llmq_tpu.watchdog")
 
 # Shared no-op bracket for the default-off path: stateless, reusable,
 # allocation-free at the call sites.
 NO_GUARD = contextlib.nullcontext()
+
+
+def dispatch_deadline_s(
+    p99: Optional[float], mult: float, min_s: float
+) -> float:
+    """The watchdog's deadline policy, as a pure function:
+    ``max(min_s, p99 * mult)``, the floor alone without history. Shared
+    by the live :class:`DispatchWatchdog` and the fleet sim's stub
+    engine, so detuning ``LLMQ_WATCHDOG_MULT`` regresses both the same
+    way."""
+    if p99 is None:
+        return float(min_s)
+    return max(float(min_s), float(p99) * float(mult))
 
 
 class DispatchWatchdog:
@@ -69,7 +82,7 @@ class DispatchWatchdog:
         # current bracket; cleared on bracket exit.
         self._tripped: Optional[Tuple[str, float, float]] = None
         self.trips = 0
-        self._last_ok = time.monotonic()
+        self._last_ok = clock.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="llmq-watchdog", daemon=True
@@ -83,9 +96,7 @@ class DispatchWatchdog:
             p99 = self._percentile(kind)
         except Exception:  # noqa: BLE001 — deadline math must never raise
             p99 = None
-        if p99 is None:
-            return self.min_s
-        return max(self.min_s, float(p99) * self.mult)
+        return dispatch_deadline_s(p99, self.mult, self.min_s)
 
     # --- bracketing -------------------------------------------------------
     def guard(self, kind: str) -> "_Guard":
@@ -97,7 +108,7 @@ class DispatchWatchdog:
         Grows without bound while a call is wedged (the heartbeat keeps
         publishing it from the event loop — that asymmetry is the whole
         point)."""
-        return time.monotonic() - self._last_ok
+        return clock.monotonic() - self._last_ok
 
     def wedged_kind(self) -> Optional[str]:
         """Kind of the currently-overdue in-flight bracket, or None."""
@@ -119,7 +130,7 @@ class DispatchWatchdog:
             if cur is None or tripped is not None:
                 continue
             kind, started, deadline = cur
-            elapsed = time.monotonic() - started
+            elapsed = clock.monotonic() - started
             if elapsed <= deadline:
                 continue
             with self._lock:
@@ -158,7 +169,7 @@ class _Guard:
         wd = self._wd
         deadline = wd.deadline_for(self._kind)
         with wd._lock:
-            wd._current = (self._kind, time.monotonic(), deadline)
+            wd._current = (self._kind, clock.monotonic(), deadline)
             wd._tripped = None
         return self
 
@@ -171,5 +182,5 @@ class _Guard:
         if exc_type is None:
             if tripped is not None:
                 raise HungDispatchError(*tripped)
-            wd._last_ok = time.monotonic()
+            wd._last_ok = clock.monotonic()
         return False

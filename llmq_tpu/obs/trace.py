@@ -32,8 +32,9 @@ import json
 import os
 import socket
 import threading
-import time
 from typing import Any, Dict, List, Optional
+
+from llmq_tpu.utils import clock
 
 TRACE_FIELD = "trace"
 _HOST = socket.gethostname()
@@ -57,8 +58,8 @@ def trace_event(
         return trace
     event = {
         "name": name,
-        "t_wall": time.time(),
-        "t_mono": time.monotonic(),
+        "t_wall": clock.wall(),
+        "t_mono": clock.monotonic(),
         "host": _HOST,
     }
     event.update(fields)
@@ -101,7 +102,7 @@ def trace_from_payload(payload: Any) -> Optional[Dict[str, Any]]:
 
 def mono_to_wall(t_mono: float) -> float:
     """Project a monotonic stamp from THIS host onto the wall clock."""
-    return time.time() - (time.monotonic() - t_mono)
+    return clock.wall() - (clock.monotonic() - t_mono)
 
 
 # --- JSONL event-log sink ---------------------------------------------------
@@ -124,8 +125,8 @@ def emit_trace_event(
     record = {
         "job_id": job_id,
         "event": name,
-        "t_wall": time.time(),
-        "t_mono": time.monotonic(),
+        "t_wall": clock.wall(),
+        "t_mono": clock.monotonic(),
         "host": _HOST,
     }
     record.update(fields)

@@ -1,0 +1,47 @@
+"""Fleet twin: discrete-event simulation of the llmq-tpu control plane.
+
+The sim runs the REAL in-process stack — ``BrokerManager``,
+``BrokerCore``/``MemoryBroker`` (optionally wrapped in ``ChaosBroker``),
+``BaseWorker``'s message loop with its full error ladder, the affinity
+janitor, admission control, the host-memory governor — under a
+virtual-clock asyncio event loop, with only the engine replaced by a
+seeded latency model. Thousands of workers and hours of queue time
+execute in seconds of wall clock, every run replayable from one seed.
+
+Layers:
+
+- :mod:`llmq_tpu.sim.vloop` — the virtual-time event loop + clock.
+- :mod:`llmq_tpu.sim.latency` — seeded dispatch-latency samples
+  (calibrated from BENCH_r0*.json when present).
+- :mod:`llmq_tpu.sim.scenario` — declarative traffic/fleet/fault shapes.
+- :mod:`llmq_tpu.sim.worker` — ``SimWorker`` (a real BaseWorker) over a
+  :class:`~llmq_tpu.sim.worker.StubEngine`.
+- :mod:`llmq_tpu.sim.harness` — ``FleetSim``: wires a scenario into a
+  run and collects a :class:`~llmq_tpu.sim.harness.SimReport`.
+- :mod:`llmq_tpu.sim.invariants` — safety-property checks over the
+  merged trace/result stream.
+- :mod:`llmq_tpu.sim.regression` — named scenarios with recorded
+  baselines that fail when a policy is detuned.
+
+This package must stay importable without jax — it is pure control
+plane.
+"""
+
+from llmq_tpu.sim.harness import FleetSim, SimReport
+from llmq_tpu.sim.invariants import check_invariants
+from llmq_tpu.sim.scenario import (
+    FaultSchedule,
+    FleetShape,
+    Scenario,
+    TrafficShape,
+)
+
+__all__ = [
+    "FaultSchedule",
+    "FleetShape",
+    "FleetSim",
+    "Scenario",
+    "SimReport",
+    "TrafficShape",
+    "check_invariants",
+]

@@ -1,0 +1,552 @@
+"""FleetSim: run a :class:`~llmq_tpu.sim.scenario.Scenario` end to end.
+
+The harness wires the production control plane together under the
+virtual clock:
+
+- a **submitter** ``BrokerManager`` on a plain ``memory://`` connection
+  (deadline stamping, admission-control shedding, prefix-affinity
+  routing, and the orphan janitor all run their real code),
+- N :class:`~llmq_tpu.sim.worker.SimWorker` instances whose broker
+  connections go through ``chaos+memory://`` when the fault schedule
+  wants broker chaos — delay/dup/kill faults hit the worker data plane,
+  not the harness's bookkeeping,
+- a seeded traffic generator, a seeded fault scheduler (crashes, churn),
+  and a completion poller.
+
+Everything runs in ONE process on ONE virtual-time loop; a run's entire
+event history is captured through the existing ``LLMQ_TRACE_LOG`` JSONL
+sink (stamped with virtual time) and canonicalised into a digest, so
+"same seed ⇒ same run" is checkable as string equality.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from llmq_tpu.broker.manager import (
+    FAILED_SUFFIX,
+    QUARANTINE_SUFFIX,
+    BrokerManager,
+    results_queue_name,
+)
+from llmq_tpu.broker.memory import reset_namespace
+from llmq_tpu.core.config import get_config
+from llmq_tpu.core.models import Job
+from llmq_tpu.obs import TRACE_FIELD
+from llmq_tpu.sim.scenario import Scenario
+from llmq_tpu.sim.vloop import run_virtual
+from llmq_tpu.sim.worker import SimWorker
+
+QUEUE = "simq"
+
+# Canonical-event stamp precision (decimal places of virtual seconds).
+# Coarse enough to absorb float noise, fine enough that a reordered or
+# re-timed event changes the digest.
+_STAMP_DECIMALS = 6
+
+
+@dataclass
+class SimReport:
+    """Everything a run produced, in plain data."""
+
+    scenario: str
+    seed: int
+    submitted: Dict[str, dict] = field(default_factory=dict)
+    results: List[dict] = field(default_factory=list)
+    failed: List[Tuple[dict, dict]] = field(default_factory=list)
+    quarantined: List[Tuple[dict, dict]] = field(default_factory=list)
+    events: List[dict] = field(default_factory=list)
+    counters: Dict[str, Any] = field(default_factory=dict)
+    # The effective policy env the run executed under (scenario.env plus
+    # the harness's own overrides) — invariant checks read THIS, not the
+    # process env, which is restored the moment the run ends.
+    env: Dict[str, str] = field(default_factory=dict)
+    digest: str = ""
+    virtual_s: float = 0.0
+    wall_s: float = 0.0
+    timed_out: bool = False
+
+    # --- derived views ----------------------------------------------------
+    def result_ids(self) -> List[str]:
+        return [str(r.get("id")) for r in self.results]
+
+    def failed_ids(self) -> List[str]:
+        return [str(p.get("id", h.get("x-job-id"))) for p, h in self.failed]
+
+    def quarantined_ids(self) -> List[str]:
+        return [str(p.get("id")) for p, h in self.quarantined]
+
+    def slo_attainment(self) -> Optional[float]:
+        """Fraction of deadline-carrying jobs whose result landed before
+        its deadline; None when no job carried one."""
+        deadlines = {
+            jid: meta["deadline_at"]
+            for jid, meta in self.submitted.items()
+            if meta.get("deadline_at") is not None
+        }
+        if not deadlines:
+            return None
+        met = 0
+        for res in self.results:
+            jid = str(res.get("id"))
+            at = deadlines.get(jid)
+            if at is not None and res.get("_finished_wall", 0.0) <= at:
+                met += 1
+        return met / len(deadlines)
+
+    def summary(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "submitted": len(self.submitted),
+            "results": len(self.results),
+            "failed": len(self.failed),
+            "quarantined": len(self.quarantined),
+            "events": len(self.events),
+            "digest": self.digest,
+            "virtual_s": round(self.virtual_s, 3),
+            "wall_s": round(self.wall_s, 3),
+            "timed_out": self.timed_out,
+            "slo_attainment": self.slo_attainment(),
+            **{f"counter_{k}": v for k, v in sorted(self.counters.items())},
+        }
+
+
+class FleetSim:
+    """One scenario run. Construct, then call :meth:`run` (synchronous —
+    the harness owns its event loop)."""
+
+    def __init__(self, scenario: Scenario) -> None:
+        scenario.validate()
+        self.scenario = scenario
+        self.queue = QUEUE
+        ns = f"sim-{scenario.name}-{scenario.seed}"
+        self.namespace = "".join(
+            ch if ch.isalnum() or ch in "-_" else "-" for ch in ns
+        )
+        # Live state during _main(). All of these are bounded by the
+        # scenario (worker count / job count) and a FleetSim lives for
+        # exactly one run() call.
+        self._workers: Dict[int, SimWorker] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._worker_tasks: Dict[int, asyncio.Task] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._next_index = 0
+        self._crashed: List[str] = []  # llmq: ignore[unbounded-host-buffer]
+        self._left: List[str] = []  # llmq: ignore[unbounded-host-buffer]
+        self._submitted: Dict[str, dict] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._stopped_workers: List[SimWorker] = []
+
+    # --- env plumbing -----------------------------------------------------
+    def _broker_url(self) -> str:
+        faults = self.scenario.faults
+        if faults.wants_chaos_broker:
+            params = []
+            if faults.delay_ms:
+                params.append(f"delay_ms={faults.delay_ms}")
+            if faults.dup_every:
+                params.append(f"dup_every={faults.dup_every}")
+            if faults.kill_every:
+                params.append(f"kill_every={faults.kill_every}")
+            params.append(f"seed={self.scenario.seed}")
+            return f"chaos+memory://{self.namespace}?" + "&".join(params)
+        return f"memory://{self.namespace}"
+
+    def _sim_env(self, trace_path: str) -> Dict[str, str]:
+        env = {
+            "LLMQ_BROKER_URL": self._broker_url(),
+            "LLMQ_TRACE_LOG": trace_path,
+        }
+        if self.scenario.fleet.prefix_affinity:
+            env["LLMQ_PREFIX_AFFINITY"] = "1"
+        env.update(self.scenario.env)
+        return env
+
+    # --- entry point ------------------------------------------------------
+    def run(self) -> SimReport:
+        # Real wall seconds by design: wall_s reports what the virtual run
+        # cost the host, which the injectable clock must not virtualize.
+        started = time.perf_counter()  # llmq: ignore[raw-clock-read]
+        fd, trace_path = tempfile.mkstemp(
+            prefix=f"llmq-sim-{self.namespace}-", suffix=".jsonl"
+        )
+        os.close(fd)
+        overrides = self._sim_env(trace_path)
+        saved = {k: os.environ.get(k) for k in overrides}
+        for key, value in overrides.items():
+            os.environ[key] = value
+        reset_namespace(self.namespace)
+        try:
+            report = run_virtual(self._main())
+        finally:
+            reset_namespace(self.namespace)
+            for key, value in saved.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
+        try:
+            report.events = _load_events(trace_path)
+        finally:
+            try:
+                os.unlink(trace_path)
+            except OSError:
+                pass
+        report.digest = _digest_events(report.events)
+        report.env = dict(overrides)
+        report.wall_s = time.perf_counter() - started  # llmq: ignore[raw-clock-read]
+        return report
+
+    # --- the run ----------------------------------------------------------
+    async def _main(self) -> SimReport:
+        scenario = self.scenario
+        loop = asyncio.get_running_loop()
+        report = SimReport(scenario=scenario.name, seed=scenario.seed)
+        # The submitter/collector stays on a plain memory:// connection:
+        # chaos belongs to the worker data plane, and a delayed health
+        # peek would make the janitor's own bookkeeping the bottleneck.
+        submitter = BrokerManager(
+            get_config(), url=f"memory://{self.namespace}"
+        )
+        await submitter.connect()
+        await submitter.setup_queue_infrastructure(self.queue)
+        try:
+            spinup = asyncio.ensure_future(self._spin_up_fleet())
+            traffic = asyncio.ensure_future(self._generate_traffic(submitter))
+            faults = asyncio.ensure_future(self._run_fault_schedule())
+            try:
+                report.timed_out = not await self._await_completion(
+                    submitter, traffic
+                )
+            finally:
+                for task in (spinup, traffic, faults):
+                    if not task.done():
+                        task.cancel()
+                await asyncio.gather(
+                    spinup, traffic, faults, return_exceptions=True
+                )
+            await self._stop_fleet()
+            report.submitted = self._submitted
+            report.results = await self._drain_results(submitter)
+            report.failed = await self._drain_dead(
+                submitter, self.queue + FAILED_SUFFIX
+            )
+            report.quarantined = await self._drain_dead(
+                submitter, self.queue + QUARANTINE_SUFFIX
+            )
+            report.counters = self._collect_counters(submitter)
+            report.virtual_s = loop.time()
+        finally:
+            await submitter.disconnect()
+        return report
+
+    # --- fleet ------------------------------------------------------------
+    def _start_worker(self) -> int:
+        index = self._next_index
+        self._next_index += 1
+        worker = SimWorker(
+            self.queue,
+            index,
+            seed=self.scenario.seed,
+            concurrency=self.scenario.fleet.concurrency,
+        )
+        self._workers[index] = worker
+        self._worker_tasks[index] = asyncio.ensure_future(worker.run())
+        return index
+
+    async def _spin_up_fleet(self) -> None:
+        fleet = self.scenario.fleet
+        gap = fleet.join_spread_s / max(1, fleet.workers)
+        for _ in range(fleet.workers):
+            self._start_worker()
+            await asyncio.sleep(gap)
+
+    def _running_indices(self) -> List[int]:
+        return sorted(
+            idx
+            for idx, w in self._workers.items()
+            if w.running and not w._crashed
+        )
+
+    async def _stop_fleet(self) -> None:
+        for worker in self._workers.values():
+            if worker.running:
+                worker.request_shutdown()
+        pending = [t for t in self._worker_tasks.values() if not t.done()]
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    # --- traffic ----------------------------------------------------------
+    def _templates(self) -> List[str]:
+        rng = random.Random(f"{self.scenario.seed}:templates")
+        heads = []
+        for t in range(self.scenario.traffic.templates):
+            words = [
+                f"tok{rng.randrange(10_000):04d}"
+                for _ in range(80)
+            ]
+            heads.append(f"[template {t}] " + " ".join(words) + "\n")
+        return heads
+
+    async def _generate_traffic(self, submitter: BrokerManager) -> None:
+        traffic = self.scenario.traffic
+        faults = self.scenario.faults
+        rng = random.Random(f"{self.scenario.seed}:traffic")
+        special = rng.sample(
+            range(traffic.jobs),
+            min(traffic.jobs, faults.poison_jobs + faults.hang_jobs),
+        )
+        poison = set(special[: faults.poison_jobs])
+        hangs = set(special[faults.poison_jobs :])
+        templates = self._templates()
+        for w in range(traffic.warmup_jobs):
+            await asyncio.sleep(rng.expovariate(traffic.warmup_rate_jobs_s))
+            await self._submit(
+                submitter, f"warm-{w:06d}", rng, templates, sim_extra={}
+            )
+        if traffic.warmup_jobs:
+            # Let a heartbeat cycle land so admission control has an
+            # observed fleet rate before the main arrival process.
+            await asyncio.sleep(traffic.warmup_pause_s)
+        for i in range(traffic.jobs):
+            if traffic.arrival == "poisson":
+                await asyncio.sleep(rng.expovariate(traffic.rate_jobs_s))
+            elif traffic.arrival == "uniform":
+                await asyncio.sleep(1.0 / traffic.rate_jobs_s)
+            job_id = f"job-{i:06d}"
+            extra: Dict[str, Any] = {}
+            if i in poison:
+                extra["poison"] = True
+            if i in hangs:
+                extra["hang_s"] = faults.hang_s
+            await self._submit(submitter, job_id, rng, templates, sim_extra=extra)
+
+    async def _submit(
+        self,
+        submitter: BrokerManager,
+        job_id: str,
+        rng: random.Random,
+        templates: List[str],
+        *,
+        sim_extra: Dict[str, Any],
+    ) -> None:
+        traffic = self.scenario.traffic
+        sim: Dict[str, Any] = {
+            "prompt_tokens": rng.randint(*traffic.prompt_tokens),
+            "output_tokens": rng.randint(*traffic.output_tokens),
+        }
+        sim.update(sim_extra)
+        if self.scenario.swap_bytes_per_job:
+            sim["swap_bytes"] = self.scenario.swap_bytes_per_job
+        if self.scenario.prefix_bytes_per_job:
+            sim["prefix_bytes"] = self.scenario.prefix_bytes_per_job
+        if templates and rng.random() < traffic.template_share:
+            prompt = rng.choice(templates) + f"request {job_id}"
+        else:
+            prompt = f"standalone request {job_id} " + "x" * 64
+        payload: Dict[str, Any] = {
+            "id": job_id,
+            "prompt": prompt,
+            "sim": sim,
+        }
+        if traffic.deadline_ms:
+            payload["deadline_ms"] = traffic.deadline_ms
+        job = Job.model_validate(payload)
+        await submitter.publish_job(self.queue, job)
+        # publish_job stamps deadline_at in place (and may shed).
+        self._submitted[job_id] = {
+            "deadline_at": job.deadline_at,
+            "poison": bool(sim.get("poison")),
+            "hang": "hang_s" in sim,
+        }
+
+    # --- faults / churn ---------------------------------------------------
+    def _fault_events(self) -> List[Tuple[float, str, int]]:
+        faults = self.scenario.faults
+        fleet = self.scenario.fleet
+        rng = random.Random(f"{self.scenario.seed}:faults")
+        events: List[Tuple[float, str, int]] = []
+        lo, hi = faults.crash_window
+        for _ in range(faults.crash_workers):
+            events.append((rng.uniform(lo, hi), "crash", 1))
+        for at, count in fleet.joins:
+            events.append((float(at), "join", int(count)))
+        for at, count in fleet.leaves:
+            events.append((float(at), "leave", int(count)))
+        events.sort(key=lambda e: (e[0], e[1]))
+        return events
+
+    async def _run_fault_schedule(self) -> None:
+        events = self._fault_events()
+        if not events:
+            return
+        rng = random.Random(f"{self.scenario.seed}:victims")
+        loop = asyncio.get_running_loop()
+        for at, kind, count in events:
+            delay = at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if kind == "join":
+                for _ in range(count):
+                    self._start_worker()
+            elif kind == "leave":
+                alive = self._running_indices()
+                for idx in alive[:count]:
+                    self._left.append(self._workers[idx].worker_id)
+                    self._workers[idx].request_shutdown()
+            elif kind == "crash":
+                alive = self._running_indices()
+                if not alive:
+                    continue
+                for idx in rng.sample(alive, min(count, len(alive))):
+                    worker = self._workers[idx]
+                    self._crashed.append(worker.worker_id)
+                    await worker.crash()
+
+    # --- completion -------------------------------------------------------
+    async def _await_completion(
+        self, submitter: BrokerManager, traffic: asyncio.Task
+    ) -> bool:
+        """Poll outcome-queue depths until every submitted job is
+        accounted for; False when ``max_virtual_s`` elapsed first."""
+        loop = asyncio.get_running_loop()
+        total = self.scenario.traffic.jobs + self.scenario.traffic.warmup_jobs
+        while True:
+            await asyncio.sleep(2.0)
+            if loop.time() >= self.scenario.max_virtual_s:
+                return False
+            if not traffic.done():
+                continue
+            settled = 0
+            for qname in (
+                results_queue_name(self.queue),
+                self.queue + FAILED_SUFFIX,
+                self.queue + QUARANTINE_SUFFIX,
+            ):
+                # MemoryBroker.stats never raises for a queue that does
+                # not exist yet; it reports None counts ("unavailable").
+                stats = await submitter.broker.stats(qname)
+                settled += stats.message_count_ready or 0
+            if settled >= total:
+                return True
+
+    # --- collection -------------------------------------------------------
+    async def _drain_results(self, submitter: BrokerManager) -> List[dict]:
+        out: List[dict] = []
+        qname = results_queue_name(self.queue)
+        while True:
+            msg = await submitter.broker.get(qname)
+            if msg is None:
+                break
+            try:
+                payload = json.loads(msg.body)
+            except Exception:  # noqa: BLE001 — keep the raw body visible
+                payload = {"id": None, "raw": msg.body.decode("utf-8", "replace")}
+            # Project the virtual completion stamp for SLO accounting.
+            finished = None
+            trace = payload.get(TRACE_FIELD) or {}
+            for event in trace.get("events", []) or []:
+                if isinstance(event, dict) and event.get("name") == "finished":
+                    finished = event.get("t_wall")
+            payload["_finished_wall"] = finished or 0.0
+            out.append(payload)
+            await msg.ack()
+        return out
+
+    async def _drain_dead(
+        self, submitter: BrokerManager, qname: str
+    ) -> List[Tuple[dict, dict]]:
+        out: List[Tuple[dict, dict]] = []
+        while True:
+            try:
+                msg = await submitter.broker.get(qname)
+            except Exception:  # noqa: BLE001 — undeclared queue: nothing died
+                return out
+            if msg is None:
+                break
+            try:
+                payload = json.loads(msg.body)
+            except Exception:  # noqa: BLE001
+                payload = {"id": None}
+            out.append((payload, dict(msg.headers or {})))
+            await msg.ack()
+        return out
+
+    def _collect_counters(self, submitter: BrokerManager) -> Dict[str, Any]:
+        workers = list(self._workers.values())
+        governor_stats = [w.governor.stats() for w in workers]
+        counters: Dict[str, Any] = {
+            "jobs_shed": submitter.jobs_shed,
+            "affinity_reclaimed": submitter.affinity_reclaimed,
+            "affinity_routed": submitter.affinity_routed,
+            "workers_started": len(workers),
+            "workers_crashed": len(self._crashed),
+            "workers_left": len(self._left),
+            "crashed_ids": list(self._crashed),
+            "jobs_processed": sum(w.jobs_processed for w in workers),
+            "jobs_failed": sum(w.jobs_failed for w in workers),
+            "jobs_quarantined": sum(w.jobs_quarantined for w in workers),
+            "jobs_deadline_exceeded": sum(
+                w.jobs_deadline_exceeded for w in workers
+            ),
+            "breakers_tripped": sum(1 for w in workers if w.breaker_tripped),
+            "watchdog_trips": sum(
+                w.engine.trips for w in workers if w.engine is not None
+            ),
+            "engine_rebuilds": sum(
+                w.engine.rebuilds for w in workers if w.engine is not None
+            ),
+            "swap_refusals": sum(g["swap_refusals"] for g in governor_stats),
+            "evictions_forced": sum(
+                g["evictions_forced"] for g in governor_stats
+            ),
+            "swap_recomputes": sum(w.swap_recomputes for w in workers),
+        }
+        return counters
+
+
+# --- trace canonicalisation -------------------------------------------------
+
+def _load_events(path: str) -> List[dict]:
+    """Canonical event stream from the run's JSONL sink: virtual stamps
+    (rounded), event name, job id, and the identity fields that matter
+    for replay comparison. Host and free-form reasons are dropped — they
+    carry machine names / exception reprs that vary harmlessly."""
+    events: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return events
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        event = {
+            "t": round(float(record.get("t_mono", 0.0)), _STAMP_DECIMALS),
+            "event": record.get("event"),
+            "job_id": record.get("job_id"),
+        }
+        for key in ("worker_id", "worker", "queue", "redeliveries"):
+            if key in record:
+                event[key] = record[key]
+        events.append(event)
+    return events
+
+
+def _digest_events(events: List[dict]) -> str:
+    dig = hashlib.blake2b(digest_size=16)
+    for event in events:
+        dig.update(json.dumps(event, sort_keys=True).encode("utf-8"))
+        dig.update(b"\n")
+    return dig.hexdigest()

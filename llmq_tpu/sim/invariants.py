@@ -1,0 +1,185 @@
+"""Safety-property checks over a completed sim run.
+
+These are the properties the production stack promises and the sim
+exists to prove under load, churn, and chaos:
+
+1. **Exactly one outcome per job** — every submitted job ends in
+   exactly one of {result, dead-letter, quarantine}; none vanish, none
+   double-complete across classes.
+2. **No duplicate results** — at-least-once delivery plus the worker
+   dedup layer must still yield exactly-once *results* (one per
+   (job, resume-offset) and one per job overall).
+3. **Reclaims bounded by deaths** — the affinity janitor only reclaims
+   private queues of workers that actually died or left; it never
+   steals from a live worker.
+4. **Shedding is justified** — admission-control sheds happen only
+   when a deadline exists; every shed job is explicitly dead-lettered
+   with its ``x-shed`` marker and must not also produce a result.
+5. **Monotone timelines** — within one run the trace log's virtual
+   monotonic stamps never go backwards per job (events were appended
+   in causal order).
+6. **Quarantine discipline** — with ``LLMQ_QUARANTINE_ATTEMPTS=N``,
+   quarantined jobs carry at least N fleet-wide attempts.
+
+:func:`check_invariants` returns a list of human-readable violations
+(empty = all hold), so tests can ``assert not check_invariants(r)`` and
+print the failures verbatim.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List
+
+from llmq_tpu.sim.harness import SimReport
+
+
+def check_invariants(report: SimReport) -> List[str]:
+    violations: List[str] = []
+    violations += _check_outcomes(report)
+    violations += _check_duplicates(report)
+    violations += _check_reclaims(report)
+    violations += _check_sheds(report)
+    violations += _check_monotone(report)
+    violations += _check_quarantine(report)
+    return violations
+
+
+def _check_outcomes(report: SimReport) -> List[str]:
+    out: List[str] = []
+    result_ids = set(report.result_ids())
+    failed_ids = set(report.failed_ids())
+    quarantine_ids = set(report.quarantined_ids())
+    for job_id in report.submitted:
+        classes = [
+            name
+            for name, ids in (
+                ("result", result_ids),
+                ("dead-letter", failed_ids),
+                ("quarantine", quarantine_ids),
+            )
+            if job_id in ids
+        ]
+        if len(classes) == 0:
+            out.append(f"job {job_id}: no outcome (lost)")
+        elif len(classes) > 1:
+            out.append(
+                f"job {job_id}: {len(classes)} outcome classes "
+                f"({' + '.join(classes)})"
+            )
+    for job_id in result_ids | failed_ids | quarantine_ids:
+        if job_id not in report.submitted and job_id != "None":
+            out.append(f"job {job_id}: outcome for a job never submitted")
+    return out
+
+
+def _check_duplicates(report: SimReport) -> List[str]:
+    out: List[str] = []
+    per_job = Counter(str(r.get("id")) for r in report.results)
+    for job_id, count in per_job.items():
+        if count > 1:
+            offsets = sorted(
+                r.get("resume_offset", 0)
+                for r in report.results
+                if str(r.get("id")) == job_id
+            )
+            out.append(
+                f"job {job_id}: {count} results (resume offsets {offsets})"
+            )
+    return out
+
+
+def _check_reclaims(report: SimReport) -> List[str]:
+    reclaimed_workers = {
+        e.get("worker")
+        for e in report.events
+        if e.get("event") == "affinity_reclaimed" and e.get("worker")
+    }
+    deaths = set(report.counters.get("crashed_ids", []))
+    # Graceful leavers retire their own queues; a janitor reclaim of one
+    # is legal only in the race where the leave beat its retirement —
+    # count them as deaths for the bound.
+    left = report.counters.get("workers_left", 0)
+    budget = len(deaths) + left
+    if len(reclaimed_workers) > budget:
+        return [
+            f"janitor reclaimed {len(reclaimed_workers)} workers' queues "
+            f"but only {budget} workers died/left "
+            f"(reclaimed: {sorted(reclaimed_workers)})"
+        ]
+    return []
+
+
+def _check_sheds(report: SimReport) -> List[str]:
+    out: List[str] = []
+    shed_entries = [
+        (payload, headers)
+        for payload, headers in report.failed
+        if headers.get("x-shed")
+    ]
+    deadline_possible = any(
+        meta.get("deadline_at") is not None
+        for meta in report.submitted.values()
+    ) or bool(report.env.get("LLMQ_DEADLINE_MS"))
+    if shed_entries and not deadline_possible:
+        out.append(
+            f"{len(shed_entries)} jobs shed with no deadline configured"
+        )
+    counter = report.counters.get("jobs_shed", 0)
+    if counter != len(shed_entries):
+        out.append(
+            f"jobs_shed counter ({counter}) disagrees with x-shed "
+            f"dead-letters ({len(shed_entries)})"
+        )
+    result_ids = set(report.result_ids())
+    for payload, _ in shed_entries:
+        job_id = str(payload.get("id"))
+        if job_id in result_ids:
+            out.append(f"job {job_id}: shed at admission AND completed")
+    return out
+
+
+def _check_monotone(report: SimReport) -> List[str]:
+    out: List[str] = []
+    last_seen: Dict[str, float] = {}
+    for event in report.events:
+        job_id = event.get("job_id")
+        stamp = event.get("t", 0.0)
+        if job_id is None:
+            continue
+        prev = last_seen.get(job_id)
+        if prev is not None and stamp < prev:
+            out.append(
+                f"job {job_id}: event {event.get('event')!r} at t={stamp} "
+                f"after t={prev} (timeline went backwards)"
+            )
+        last_seen[job_id] = stamp
+    return out
+
+
+def _check_quarantine(report: SimReport) -> List[str]:
+    out: List[str] = []
+    raw = report.env.get("LLMQ_QUARANTINE_ATTEMPTS", "").strip()
+    try:
+        attempts = int(raw) if raw else 0
+    except ValueError:
+        attempts = 0
+    if attempts <= 0:
+        if report.quarantined:
+            out.append(
+                f"{len(report.quarantined)} jobs quarantined with "
+                "quarantine disabled"
+            )
+        return out
+    for payload, headers in report.quarantined:
+        count = headers.get("x-delivery-count", 0)
+        try:
+            count = int(count)
+        except (TypeError, ValueError):
+            count = 0
+        if count < attempts:
+            out.append(
+                f"job {payload.get('id')}: quarantined at "
+                f"{count} attempts (< {attempts})"
+            )
+    return out

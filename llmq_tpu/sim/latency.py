@@ -1,0 +1,165 @@
+"""Seeded dispatch-latency model for the fleet sim's stub engine.
+
+Engine dispatch is the only piece of the stack the sim replaces, so the
+fidelity of everything downstream (watchdog policy, deadline shedding,
+fleet service rate) hangs on these samples. Latencies are lognormal —
+the standard shape for service times, and what the real per-kind
+dispatch histograms look like — parameterised by (p50, p95) per kind:
+
+    mu = ln(p50), sigma = ln(p95 / p50) / 1.645
+
+Calibration: :func:`load_calibration` scans ``BENCH_r0*.json`` files in
+the repo root for ``ttft_p50/ttft_p95/itl_p50/itl_p95`` keys (the bench
+harness's summary schema). The checked-in bench artifacts from CPU-only
+CI runs carry only error logs, so the built-in defaults below — typical
+single-host TPU v4 serving numbers at moderate batch — are the normal
+operating mode; real-hardware bench runs sharpen them automatically.
+
+A small straggler mixture rides on decode dispatches: with probability
+``straggler_prob`` a dispatch lands at 4.5–7.5× the analytic p99 —
+long enough to trip a detuned watchdog (``LLMQ_WATCHDOG_MULT=4``),
+short enough to clear a sane one (``MULT=8``). That separation is what
+the watchdog regression scenario keys on.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import random
+from typing import Dict, Optional
+
+# Typical single-host serving latencies (seconds): time-to-first-token
+# for a ~512-token prompt, and per-token inter-token latency.
+DEFAULTS: Dict[str, float] = {
+    "ttft_p50": 0.12,
+    "ttft_p95": 0.35,
+    "itl_p50": 0.015,
+    "itl_p95": 0.035,
+}
+
+# Reference prompt length the ttft numbers describe; prefill cost scales
+# linearly with prompt tokens relative to this.
+TTFT_REF_TOKENS = 512
+
+# Decode dispatches cover blocks of this many tokens (matches the
+# engine's decode-block cadence between deadline checks).
+DECODE_BLOCK_TOKENS = 16
+
+# z-scores for the lognormal fit / analytic p99.
+_Z95 = 1.645
+_Z99 = 2.326
+
+
+def load_calibration(root: Optional[str] = None) -> Dict[str, float]:
+    """Latency parameters, preferring bench artifacts over defaults.
+
+    Scans ``<root>/BENCH_r0*.json`` (root defaults to the repo root this
+    package is installed from, then the CWD) for any of the four keys,
+    anywhere in the document. Missing keys keep their defaults; a p95 at
+    or below its p50 is ignored (a degenerate fit would collapse sigma).
+    """
+    params = dict(DEFAULTS)
+    roots = []
+    if root is not None:
+        roots.append(root)
+    else:
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        roots.extend([pkg_root, os.getcwd()])
+    found: Dict[str, float] = {}
+    for base in roots:
+        for path in sorted(glob.glob(os.path.join(base, "BENCH_r0*.json"))):
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    doc = json.load(fh)
+            except Exception:  # noqa: BLE001 — bench files are advisory
+                continue
+            _scan(doc, found)
+        if found:
+            break
+    for kind in ("ttft", "itl"):
+        p50 = found.get(f"{kind}_p50")
+        p95 = found.get(f"{kind}_p95")
+        if p50 is not None and p50 > 0:
+            params[f"{kind}_p50"] = p50
+            if p95 is not None and p95 > p50:
+                params[f"{kind}_p95"] = p95
+            else:
+                # Keep the default *shape* (p95/p50 ratio) around the
+                # calibrated median.
+                ratio = DEFAULTS[f"{kind}_p95"] / DEFAULTS[f"{kind}_p50"]
+                params[f"{kind}_p95"] = p50 * ratio
+    return params
+
+
+def _scan(node: object, out: Dict[str, float]) -> None:
+    if isinstance(node, dict):
+        for key, value in node.items():
+            if key in DEFAULTS and isinstance(value, (int, float)):
+                out.setdefault(key, float(value))
+            else:
+                _scan(value, out)
+    elif isinstance(node, list):
+        for item in node:
+            _scan(item, out)
+
+
+class LatencyModel:
+    """Seeded per-dispatch latency samples.
+
+    One instance per simulated worker (seeded ``f"{seed}:lat:{worker}"``
+    by the harness) so worker latency streams are independent yet fully
+    determined by the scenario seed.
+    """
+
+    def __init__(
+        self,
+        seed: str,
+        *,
+        params: Optional[Dict[str, float]] = None,
+        straggler_prob: float = 0.02,
+    ) -> None:
+        self._rng = random.Random(seed)
+        self.params = dict(params or DEFAULTS)
+        self.straggler_prob = float(straggler_prob)
+
+    # --- lognormal machinery ---------------------------------------------
+    def _mu_sigma(self, kind: str) -> tuple:
+        p50 = self.params[f"{kind}_p50"]
+        p95 = self.params[f"{kind}_p95"]
+        mu = math.log(p50)
+        sigma = max(1e-6, math.log(p95 / p50) / _Z95)
+        return mu, sigma
+
+    def _sample(self, kind: str) -> float:
+        mu, sigma = self._mu_sigma(kind)
+        return math.exp(self._rng.gauss(mu, sigma))
+
+    def analytic_p99(self, kind: str, scale: float = 1.0) -> float:
+        """Closed-form p99 of a kind's distribution (× a linear scale).
+        The straggler mixture keys off this rather than sampled history
+        so its trip/no-trip separation is stable from dispatch one."""
+        mu, sigma = self._mu_sigma(kind)
+        return math.exp(mu + _Z99 * sigma) * scale
+
+    # --- dispatch samples -------------------------------------------------
+    def prefill_s(self, prompt_tokens: int) -> float:
+        """One prefill dispatch: ttft sample scaled by prompt length."""
+        scale = max(0.25, prompt_tokens / TTFT_REF_TOKENS)
+        return self._sample("ttft") * scale
+
+    def decode_block_s(self, block_tokens: int) -> float:
+        """One decode dispatch covering ``block_tokens`` tokens, with
+        the straggler mixture applied."""
+        base = self._sample("itl") * block_tokens
+        if self._rng.random() < self.straggler_prob:
+            p99 = self.analytic_p99("itl", scale=block_tokens)
+            base = max(base, p99 * self._rng.uniform(4.5, 7.5))
+        return base
+
+    def decode_p99(self, block_tokens: int = DECODE_BLOCK_TOKENS) -> float:
+        return self.analytic_p99("itl", scale=block_tokens)

@@ -1,0 +1,262 @@
+"""Policy-regression scenarios with recorded baselines.
+
+Each :class:`RegressionSpec` pins a seeded scenario, the metric bounds a
+healthy policy produces, and a documented *detune* — an env override
+that weakens exactly one policy knob. The contract:
+
+- the **baseline** run's metrics must land inside every recorded bound
+  (and its invariants must hold), and
+- the **detuned** run must land OUTSIDE at least one bound — proving the
+  suite actually has teeth against that regression, not just that the
+  numbers happened to match once.
+
+Runs are virtual-clock deterministic per seed, so the bounds are not
+statistical slop — they absorb deliberate cross-version drift (latency
+recalibration, scheduling-order changes) while staying far narrower
+than the detuned outcome.
+
+The four scenarios map to the four policy planes grown in PRs 11–14:
+
+- ``watchdog-trips``  — dispatch watchdog deadline policy
+  (``LLMQ_WATCHDOG_MULT``): detuning 8 → 4 makes ordinary straggler
+  dispatches indistinguishable from wedges, so trips/rebuilds explode.
+- ``deadline-shed``   — admission control (``LLMQ_DEADLINE_MS``):
+  shrinking the budget 60 s → 3 s sheds a burst the fleet could have
+  served.
+- ``governor-ladder`` — host-memory ladder (``LLMQ_HOST_MEM_GB``):
+  shrinking the budget turns a comfortably-evicting tier into constant
+  swap refusals (and every refusal must be preceded by eviction
+  pressure — the ladder, not a straight refusal).
+- ``quarantine-poison`` — poison containment
+  (``LLMQ_QUARANTINE_ATTEMPTS``): disabling it lets poison jobs churn
+  through the full redelivery cap and dead-letter instead of
+  quarantining with their failure history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Tuple
+
+from llmq_tpu.sim.harness import FleetSim, SimReport
+from llmq_tpu.sim.invariants import check_invariants
+from llmq_tpu.sim.scenario import (
+    FaultSchedule,
+    FleetShape,
+    Scenario,
+    TrafficShape,
+)
+
+Bounds = Dict[str, Tuple[float, float]]
+
+
+def report_metrics(report: SimReport) -> Dict[str, float]:
+    """The metric surface regressions bound. One flat dict so specs can
+    bound any subset and failure messages stay uniform."""
+    return {
+        "results": float(len(report.results)),
+        "dead_letters": float(len(report.failed)),
+        "quarantined": float(len(report.quarantined)),
+        "jobs_shed": float(report.counters.get("jobs_shed", 0)),
+        "watchdog_trips": float(report.counters.get("watchdog_trips", 0)),
+        "engine_rebuilds": float(report.counters.get("engine_rebuilds", 0)),
+        "swap_refusals": float(report.counters.get("swap_refusals", 0)),
+        "evictions_forced": float(
+            report.counters.get("evictions_forced", 0)
+        ),
+        "slo": (
+            report.slo_attainment()
+            if report.slo_attainment() is not None
+            else 1.0
+        ),
+    }
+
+
+@dataclass(frozen=True)
+class RegressionSpec:
+    name: str
+    description: str
+    build: Callable[[], Scenario]
+    baseline: Bounds
+    detune: Dict[str, str]
+    detune_doc: str
+
+    def scenario(self, *, detuned: bool = False) -> Scenario:
+        scn = self.build()
+        if detuned:
+            scn.env.update(self.detune)
+        return scn
+
+    def check(self, metrics: Dict[str, float]) -> List[str]:
+        """Bound violations (empty = metrics inside every bound)."""
+        failures: List[str] = []
+        for key, (lo, hi) in sorted(self.baseline.items()):
+            value = metrics.get(key)
+            if value is None:
+                failures.append(f"{self.name}: metric {key!r} missing")
+            elif not (lo <= value <= hi):
+                failures.append(
+                    f"{self.name}: {key}={value:g} outside "
+                    f"baseline [{lo:g}, {hi:g}]"
+                )
+        return failures
+
+
+def _watchdog_scenario() -> Scenario:
+    return Scenario(
+        name="watchdog-trips",
+        seed=5,
+        traffic=TrafficShape(
+            jobs=150, rate_jobs_s=40.0, output_tokens=(64, 256)
+        ),
+        fleet=FleetShape(workers=8, concurrency=2),
+        faults=FaultSchedule(hang_jobs=3, hang_s=600.0),
+        env={"LLMQ_WATCHDOG_MULT": "8", "LLMQ_WATCHDOG_MIN_S": "1.0"},
+    )
+
+
+def _shed_scenario() -> Scenario:
+    return Scenario(
+        name="deadline-shed",
+        seed=3,
+        traffic=TrafficShape(
+            jobs=200,
+            arrival="poisson",
+            rate_jobs_s=120.0,
+            output_tokens=(64, 192),
+            warmup_jobs=60,
+            warmup_rate_jobs_s=15.0,
+            warmup_pause_s=40.0,
+        ),
+        fleet=FleetShape(workers=8, concurrency=2),
+        env={"LLMQ_DEADLINE_MS": "60000"},
+    )
+
+
+def _governor_scenario() -> Scenario:
+    return Scenario(
+        name="governor-ladder",
+        seed=9,
+        traffic=TrafficShape(
+            jobs=150, rate_jobs_s=50.0, output_tokens=(16, 64)
+        ),
+        fleet=FleetShape(workers=4, concurrency=2),
+        env={"LLMQ_HOST_MEM_GB": "0.05"},
+        swap_bytes_per_job=6 * 1024 * 1024,
+        prefix_bytes_per_job=2 * 1024 * 1024,
+    )
+
+
+def _quarantine_scenario() -> Scenario:
+    return Scenario(
+        name="quarantine-poison",
+        seed=11,
+        traffic=TrafficShape(jobs=120, rate_jobs_s=40.0),
+        fleet=FleetShape(workers=8, concurrency=2),
+        faults=FaultSchedule(poison_jobs=5),
+        env={
+            "LLMQ_QUARANTINE_ATTEMPTS": "3",
+            "LLMQ_MAX_REDELIVERIES": "8",
+        },
+    )
+
+
+REGRESSIONS: Dict[str, RegressionSpec] = {
+    spec.name: spec
+    for spec in (
+        RegressionSpec(
+            name="watchdog-trips",
+            description=(
+                "Hung dispatches trip the watchdog; healthy stragglers "
+                "do not."
+            ),
+            build=_watchdog_scenario,
+            # Recorded from seed 5: 8 trips = 3 genuine hangs + 5
+            # warmup-floor trips before per-kind history engages.
+            baseline={
+                "watchdog_trips": (0, 10),
+                "engine_rebuilds": (0, 10),
+                "results": (150, 150),
+            },
+            detune={"LLMQ_WATCHDOG_MULT": "4"},
+            detune_doc=(
+                "MULT 8 → 4 halves every dispatch deadline; straggler "
+                "decode blocks (4.5–7.5 × p99) now trip it, so "
+                "trips/rebuilds roughly double (recorded: 18 vs 8)."
+            ),
+        ),
+        RegressionSpec(
+            name="deadline-shed",
+            description=(
+                "Admission control sheds nothing the fleet can serve "
+                "within deadline."
+            ),
+            build=_shed_scenario,
+            # Recorded from seed 3: 0 shed, SLO 1.0.
+            baseline={
+                "jobs_shed": (0, 10),
+                "slo": (0.90, 1.0),
+            },
+            detune={"LLMQ_DEADLINE_MS": "3000"},
+            detune_doc=(
+                "Deadline budget 60 s → 3 s makes queue-depth/rate "
+                "exceed the budget for nearly the whole burst "
+                "(recorded: 171 shed vs 0, SLO 0.05 vs 1.0)."
+            ),
+        ),
+        RegressionSpec(
+            name="governor-ladder",
+            description=(
+                "Host-memory ladder evicts cold prefixes before "
+                "refusing swap captures."
+            ),
+            build=_governor_scenario,
+            # Recorded from seed 9 at a 50 MB budget: evictions absorb
+            # all pressure, zero refusals.
+            baseline={
+                "swap_refusals": (0, 5),
+                "results": (150, 150),
+            },
+            detune={"LLMQ_HOST_MEM_GB": "0.008"},
+            detune_doc=(
+                "Budget 50 MB → 8 MB: a single 6 MB capture plus live "
+                "prefixes exceeds the swap rung even after eviction "
+                "(recorded: 146 refusals vs 0)."
+            ),
+        ),
+        RegressionSpec(
+            name="quarantine-poison",
+            description=(
+                "Poison jobs quarantine with history instead of "
+                "dead-lettering."
+            ),
+            build=_quarantine_scenario,
+            # Recorded from seed 11: all 5 poison jobs quarantine at
+            # exactly 3 fleet-wide attempts; nothing dead-letters.
+            baseline={
+                "quarantined": (5, 5),
+                "dead_letters": (0, 0),
+                "results": (115, 115),
+            },
+            detune={"LLMQ_QUARANTINE_ATTEMPTS": "0"},
+            detune_doc=(
+                "Quarantine disabled: each poison job burns through the "
+                "full redelivery cap and dead-letters anonymously "
+                "(recorded: 0 quarantined + 5 dead-letters vs 5 + 0)."
+            ),
+        ),
+    )
+}
+
+
+def run_regression(
+    name: str, *, detuned: bool = False
+) -> Tuple[SimReport, Dict[str, float], List[str]]:
+    """Run one named regression. Returns (report, metrics, failures)
+    where failures combines invariant violations with baseline-bound
+    violations — empty means the policy is healthy."""
+    spec = REGRESSIONS[name]
+    report = FleetSim(spec.scenario(detuned=detuned)).run()
+    metrics = report_metrics(report)
+    failures = check_invariants(report) + spec.check(metrics)
+    return report, metrics, failures

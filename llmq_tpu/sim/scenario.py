@@ -1,0 +1,158 @@
+"""Declarative scenario description for the fleet sim.
+
+A :class:`Scenario` is a value object — traffic shape, fleet shape,
+fault schedule, policy env — replayable from its single ``seed``. Every
+random draw in a run (interarrival gaps, prompt/output lengths, fault
+victim selection, latency samples, chaos scheme decisions) derives from
+``seed`` via namespaced ``random.Random(f"{seed}:<component>")``
+streams, so the same scenario produces an event-identical run on every
+machine (``random.Random(str)`` seeds via SHA-512, independent of
+PYTHONHASHSEED).
+
+Scenarios round-trip through plain dicts (``to_dict``/``from_dict``) so
+the CLI can load them from JSON and the regression suite can pin them
+in source.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class TrafficShape:
+    """What the submitters send."""
+
+    jobs: int = 200
+    # Interarrival process: "poisson" (exponential gaps at rate_jobs_s),
+    # "uniform" (fixed gap 1/rate_jobs_s), or "burst" (everything at t=0).
+    arrival: str = "poisson"
+    rate_jobs_s: float = 50.0
+    prompt_tokens: Tuple[int, int] = (64, 1024)
+    output_tokens: Tuple[int, int] = (16, 256)
+    # Fraction of jobs drawn from shared prompt templates (>=256-char
+    # common heads, so prefix-affinity routing has chains to key on).
+    template_share: float = 0.0
+    templates: int = 4
+    # Per-job deadline budget (ms); 0 = no deadline (config may still
+    # impose one via LLMQ_DEADLINE_MS in Scenario.env).
+    deadline_ms: int = 0
+    # Optional warmup phase before the main arrival process: submit
+    # ``warmup_jobs`` at ``warmup_rate_jobs_s``, then pause long enough
+    # for a heartbeat cycle so the fleet's observed service rate exists
+    # (admission control refuses to guess without one).
+    warmup_jobs: int = 0
+    warmup_rate_jobs_s: float = 10.0
+    warmup_pause_s: float = 40.0
+
+    def validate(self) -> None:
+        if self.arrival not in ("poisson", "uniform", "burst"):
+            raise ValueError(f"unknown arrival process {self.arrival!r}")
+        if self.jobs < 0 or self.rate_jobs_s <= 0:
+            raise ValueError("jobs must be >= 0 and rate_jobs_s > 0")
+
+
+@dataclass
+class FleetShape:
+    """Who serves it."""
+
+    workers: int = 8
+    concurrency: int = 4
+    # Graceful churn: (virtual_t, count) join/leave waves. Joins add
+    # fresh workers; leaves drain the longest-lived running workers.
+    joins: List[Tuple[float, int]] = field(default_factory=list)
+    leaves: List[Tuple[float, int]] = field(default_factory=list)
+    # Initial fleet spin-up is spread over this many virtual seconds so
+    # heartbeat cadences don't phase-lock.
+    join_spread_s: float = 5.0
+    prefix_affinity: bool = False
+
+
+@dataclass
+class FaultSchedule:
+    """What goes wrong, when. All selections are seeded draws."""
+
+    # Abrupt worker crashes: count of crash events inside the window.
+    crash_workers: int = 0
+    crash_window: Tuple[float, float] = (5.0, 60.0)
+    # Poison jobs (deterministic processor failure on every attempt) and
+    # hang jobs (one dispatch wedges for hang_s before returning).
+    poison_jobs: int = 0
+    hang_jobs: int = 0
+    hang_s: float = 600.0
+    # Broker chaos (routes the whole run through ChaosBroker):
+    delay_ms: int = 0
+    dup_every: int = 0
+    kill_every: int = 0
+
+    @property
+    def wants_chaos_broker(self) -> bool:
+        return bool(self.delay_ms or self.dup_every or self.kill_every)
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int = 0
+    traffic: TrafficShape = field(default_factory=TrafficShape)
+    fleet: FleetShape = field(default_factory=FleetShape)
+    faults: FaultSchedule = field(default_factory=FaultSchedule)
+    # Policy knobs, applied as environment for the duration of the run
+    # (LLMQ_DEADLINE_MS, LLMQ_WATCHDOG_MULT, LLMQ_QUARANTINE_ATTEMPTS,
+    # LLMQ_HOST_MEM_GB, ...). Detunes override these per-run.
+    env: Dict[str, str] = field(default_factory=dict)
+    # Virtual-time ceiling: the run fails rather than spin past this.
+    max_virtual_s: float = 3600.0
+    # Per-job host-memory pressure (bytes of swap capture / cold prefix
+    # per processed job) for governor scenarios; 0 = no governor load.
+    swap_bytes_per_job: int = 0
+    prefix_bytes_per_job: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Scenario":
+        data = dict(data)
+        traffic = data.pop("traffic", {}) or {}
+        fleet = data.pop("fleet", {}) or {}
+        faults = data.pop("faults", {}) or {}
+        for key in ("prompt_tokens", "output_tokens"):
+            if key in traffic and traffic[key] is not None:
+                traffic[key] = tuple(traffic[key])
+        if "crash_window" in faults and faults["crash_window"] is not None:
+            faults["crash_window"] = tuple(faults["crash_window"])
+        for key in ("joins", "leaves"):
+            if key in fleet and fleet[key] is not None:
+                fleet[key] = [tuple(item) for item in fleet[key]]
+        return cls(
+            traffic=TrafficShape(**traffic),
+            fleet=FleetShape(**fleet),
+            faults=FaultSchedule(**faults),
+            **data,
+        )
+
+    def validate(self) -> None:
+        self.traffic.validate()
+        if self.fleet.workers <= 0:
+            raise ValueError("fleet.workers must be > 0")
+        total_special = self.faults.poison_jobs + self.faults.hang_jobs
+        if total_special > self.traffic.jobs:
+            raise ValueError(
+                "poison_jobs + hang_jobs exceeds total traffic.jobs"
+            )
+
+
+def get_scenario(name: str, *, seed: Optional[int] = None) -> Scenario:
+    """Look up a named scenario (the regression suite's registry plus
+    any future additions), optionally re-seeded."""
+    from llmq_tpu.sim.regression import REGRESSIONS
+
+    if name not in REGRESSIONS:
+        known = ", ".join(sorted(REGRESSIONS))
+        raise KeyError(f"unknown scenario {name!r} (known: {known})")
+    scenario = REGRESSIONS[name].scenario()
+    if seed is not None:
+        scenario.seed = seed
+    return scenario

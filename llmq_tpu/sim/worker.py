@@ -1,0 +1,273 @@
+"""Simulated worker: the REAL BaseWorker over a stub engine.
+
+``SimWorker`` subclasses :class:`~llmq_tpu.workers.base.BaseWorker`
+directly — claim/trace/heartbeat/settle, the whole error ladder,
+deadline checks, quarantine, the circuit breaker all run the production
+code paths. Only ``_process_job`` differs: instead of driving a TPU
+engine it sleeps out seeded per-dispatch latency samples through
+:class:`StubEngine`, which reproduces the dispatch watchdog's *policy*
+(deadline = ``max(min_s, p99 * mult)`` from observed history — the same
+:func:`~llmq_tpu.engine.watchdog.dispatch_deadline_s` the live monitor
+uses) without the side thread, so detuning ``LLMQ_WATCHDOG_MULT``
+regresses sim and production identically.
+
+Faults a job can carry (under the ``sim`` extra field):
+
+- ``poison``: the processor raises on every attempt — exercises the
+  requeue → quarantine ladder.
+- ``hang_s``: one dispatch wedges for that long — exercises the
+  watchdog trip → rebuild path (or, with the watchdog off, the
+  job-timeout path).
+- ``swap_bytes`` / ``prefix_bytes``: host-memory pressure routed
+  through a real :class:`~llmq_tpu.utils.host_mem.HostMemoryGovernor`,
+  so the eviction → refusal ladder is the production one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import os
+from collections import OrderedDict, deque
+from typing import Deque, Dict, Optional, Set
+
+from llmq_tpu.core.models import Job
+from llmq_tpu.engine.watchdog import dispatch_deadline_s
+from llmq_tpu.sim.latency import DECODE_BLOCK_TOKENS, LatencyModel
+from llmq_tpu.utils.hashing import text_prefix_chain
+from llmq_tpu.utils.host_mem import HostMemoryGovernor
+from llmq_tpu.workers.base import BaseWorker
+
+# Virtual seconds a simulated engine rebuild costs after a watchdog
+# trip (compile cache warm — mirrors the in-process rebuild path).
+REBUILD_S = 2.0
+
+# Minimum per-kind history before the p99 estimate engages (below this
+# the deadline is the min_s floor alone, like the live watchdog).
+_P99_MIN_SAMPLES = 20
+_HISTORY_CAP = 512
+
+
+class StubEngine:
+    """Seeded latency playback with the watchdog's deadline policy.
+
+    Reads ``LLMQ_WATCHDOG_MULT`` / ``LLMQ_WATCHDOG_MIN_S`` from the
+    environment exactly like ``engine.Engine.__init__`` (env pins over
+    defaults; mult <= 0 disables), so scenario env blocks tune it the
+    same way they tune a real engine.
+    """
+
+    def __init__(self, model: LatencyModel) -> None:
+        self.model = model
+        self.mult = _env_float("LLMQ_WATCHDOG_MULT", 0.0)
+        self.min_s = _env_float("LLMQ_WATCHDOG_MIN_S", 30.0)
+        # One deque per dispatch kind (a handful), each maxlen-capped.
+        self._history: Dict[str, Deque[float]] = {}  # llmq: ignore[unbounded-host-buffer]
+        self.trips = 0
+        self.rebuilds = 0
+        self.dispatches = 0
+
+    def _p99(self, kind: str) -> Optional[float]:
+        hist = self._history.get(kind)
+        if hist is None or len(hist) < _P99_MIN_SAMPLES:
+            return None
+        ordered = sorted(hist)
+        return ordered[min(len(ordered) - 1, math.ceil(0.99 * len(ordered)) - 1)]
+
+    def _record(self, kind: str, duration: float) -> None:
+        hist = self._history.setdefault(kind, deque(maxlen=_HISTORY_CAP))
+        hist.append(duration)
+
+    async def dispatch(
+        self, kind: str, duration: float, *, retry_s: Optional[float] = None
+    ) -> None:
+        """One device dispatch of ``duration`` virtual seconds.
+
+        With the watchdog armed, a dispatch that would overrun its
+        deadline is cut at the deadline (trip), pays a rebuild, and
+        retries at ``retry_s`` (a clean re-dispatch after the rebuild) —
+        the same observable sequence a live trip → in-process engine
+        rebuild produces.
+        """
+        self.dispatches += 1
+        if self.mult > 0:
+            deadline = dispatch_deadline_s(
+                self._p99(kind), self.mult, self.min_s
+            )
+            if duration > deadline:
+                await asyncio.sleep(deadline)
+                self.trips += 1
+                await asyncio.sleep(REBUILD_S)
+                self.rebuilds += 1
+                duration = retry_s if retry_s is not None else deadline
+        await asyncio.sleep(duration)
+        self._record(kind, duration)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class SimWorker(BaseWorker):
+    """A BaseWorker whose processor is a :class:`StubEngine`."""
+
+    def __init__(self, queue: str, index: int, *, seed: int, **kwargs) -> None:
+        # _generate_worker_id runs inside super().__init__.
+        self._index = index
+        super().__init__(queue, **kwargs)
+        self._seed = seed
+        self.model = LatencyModel(f"{seed}:lat:{index}")
+        self.engine: Optional[StubEngine] = None
+        self._crashed = False
+        self._handler_tasks: Set[asyncio.Task] = set()
+        # Host-memory plumbing (engaged only when LLMQ_HOST_MEM_GB > 0):
+        # cold-prefix blobs are the evictable rung, swap captures the
+        # refusable one — the governor's real ladder arbitrates.
+        self.governor = HostMemoryGovernor(
+            int((self.config.host_mem_gb or 0.0) * (1 << 30))
+        )
+        self._prefix_blobs: "OrderedDict[str, int]" = OrderedDict()
+        self._swap_bytes = 0
+        self.swap_recomputes = 0
+        self.governor.register(
+            "prefix", self._prefix_usage, self._evict_prefix
+        )
+        self.governor.register("swap", lambda: self._swap_bytes)
+        # Prefix-affinity advertisement state: chains of recently-served
+        # templated prompts, so the submit path can route to us.
+        self._hot_chains: "OrderedDict[str, None]" = OrderedDict()
+
+    # --- identity / lifecycle hooks --------------------------------------
+    def _generate_worker_id(self) -> str:
+        return f"sim-w{self._index:04d}"
+
+    async def _initialize_processor(self) -> None:
+        self.engine = StubEngine(self.model)
+
+    async def _cleanup_processor(self) -> None:
+        return None
+
+    # --- the stub processor ----------------------------------------------
+    async def _process_job(self, job: Job) -> str:
+        sim = job.extras().get("sim") or {}
+        if sim.get("poison"):
+            raise RuntimeError("poison job (simulated deterministic fault)")
+        engine = self.engine
+        assert engine is not None
+        prompt_tokens = int(sim.get("prompt_tokens", 128))
+        output_tokens = int(sim.get("output_tokens", 64))
+        hang_s = float(sim.get("hang_s", 0.0))
+        await engine.dispatch("prefill", self.model.prefill_s(prompt_tokens))
+        blocks = max(1, math.ceil(output_tokens / DECODE_BLOCK_TOKENS))
+        hang_block = blocks // 2 if hang_s > 0 else -1
+        for i in range(blocks):
+            tokens = min(
+                DECODE_BLOCK_TOKENS,
+                output_tokens - i * DECODE_BLOCK_TOKENS,
+            ) or DECODE_BLOCK_TOKENS
+            duration = self.model.decode_block_s(tokens)
+            if i == hang_block:
+                await engine.dispatch(
+                    "decode", max(hang_s, duration), retry_s=duration
+                )
+            else:
+                await engine.dispatch("decode", duration)
+        self._account_host_mem(sim)
+        if self.config.prefix_affinity and job.prompt:
+            self._note_prefix(str(job.prompt))
+        return f"sim:{job.id}:{output_tokens}"
+
+    def _account_host_mem(self, sim: dict) -> None:
+        prefix_bytes = int(sim.get("prefix_bytes", 0))
+        swap_bytes = int(sim.get("swap_bytes", 0))
+        if not self.governor.enabled:
+            return
+        if prefix_bytes > 0:
+            key = f"p{len(self._prefix_blobs)}"
+            self._prefix_blobs[key] = prefix_bytes
+        if swap_bytes > 0:
+            if self.governor.admit_swap(swap_bytes):
+                # Captures are transient; model the high-water cost, not
+                # permanent growth, so the ladder (not a leak) decides.
+                self._swap_bytes = max(self._swap_bytes, swap_bytes)
+            else:
+                self.swap_recomputes += 1
+
+    def _prefix_usage(self) -> int:
+        return sum(self._prefix_blobs.values())
+
+    def _evict_prefix(self, nbytes: int) -> int:
+        freed = 0
+        while self._prefix_blobs and freed < nbytes:
+            _, size = self._prefix_blobs.popitem(last=False)
+            freed += size
+        return freed
+
+    def _note_prefix(self, prompt: str) -> None:
+        for digest in text_prefix_chain(prompt):
+            self._hot_chains[digest] = None
+            self._hot_chains.move_to_end(digest)
+        while len(self._hot_chains) > 32:
+            self._hot_chains.popitem(last=False)
+
+    def _prefix_chains(self) -> Optional[list]:
+        if not self.config.prefix_affinity or not self._hot_chains:
+            return None
+        return list(self._hot_chains)
+
+    def _engine_stats(self) -> Optional[dict]:
+        engine = self.engine
+        if engine is None:
+            return None
+        stats: dict = {"sim_dispatches": engine.dispatches}
+        if engine.trips:
+            stats["watchdog_trips"] = engine.trips
+            stats["engine_rebuilds"] = engine.rebuilds
+        return stats
+
+    # --- crash support ----------------------------------------------------
+    async def _process_message(self, message) -> None:  # type: ignore[override]
+        # Track the handler task so crash() can kill it mid-job — the
+        # cancelled message stays unacked and requeues with a
+        # delivery-count bump, exactly like a real worker dying.
+        task = asyncio.current_task()
+        if task is not None:
+            self._handler_tasks.add(task)
+        try:
+            await super()._process_message(message)
+        finally:
+            if task is not None:
+                self._handler_tasks.discard(task)
+
+    async def crash(self) -> None:
+        """Abrupt death: no drain, no handoff, no affinity retirement.
+        In-flight jobs are cancelled mid-dispatch and their deliveries
+        requeue via the broker's consumer-disconnect path."""
+        self._crashed = True
+        self.running = False
+        tasks = [t for t in self._handler_tasks if not t.done()]
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        for attr in ("_consumer_tag", "_affinity_consumer_tag"):
+            tag = getattr(self, attr, None)
+            if tag is not None and self.broker.connected:
+                try:
+                    await self.broker.cancel(tag, requeue=True)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                setattr(self, attr, None)
+        if self.broker.connected:
+            await self.broker.disconnect()
+
+    async def shutdown(self) -> None:
+        if self._crashed:
+            return  # crash() already tore everything down, ungracefully
+        await super().shutdown()

@@ -94,6 +94,20 @@ def text_prefix_chain(
     return chains
 
 
+def rendezvous_pick(digest: str, workers: List[str]) -> str:
+    """Deterministic owner among several workers advertising the same
+    digest (highest-random-weight hashing): every submitter picks the
+    same worker without coordination, and losing one advertiser only
+    remaps the chains it owned. Shared by affinity routing, KV-ship peer
+    selection, and the fleet sim's routing invariants."""
+    return max(
+        workers,
+        key=lambda w: hashlib.blake2b(
+            (digest + "|" + w).encode("utf-8"), digest_size=8
+        ).digest(),
+    )
+
+
 def token_fold(token_ids: Sequence[int]) -> str:
     """blake2b-16 hex over a token-id sequence (4-byte little-endian
     each) — the integrity plane's payload digest. Shared by the engine's

@@ -33,7 +33,6 @@ import asyncio
 import json
 import logging
 import signal
-import time
 from typing import Optional
 
 from llmq_tpu.broker.base import DeliveredMessage
@@ -59,6 +58,7 @@ from llmq_tpu.obs import (
     trace_event,
     trace_from_payload,
 )
+from llmq_tpu.utils import clock
 from llmq_tpu.utils.logging import ContextLogAdapter
 from llmq_tpu.workers.resume import (
     RESUME_FIELD,
@@ -213,9 +213,9 @@ class BaseWorker(abc.ABC):
             )
             # Monotonic clock for the beat cadence: wall time steps (NTP
             # slews, manual clock sets) must not skip or double beats.
-            last_beat = time.monotonic() - HEARTBEAT_INTERVAL_S
+            last_beat = clock.monotonic() - HEARTBEAT_INTERVAL_S
             while self.running:
-                now = time.monotonic()
+                now = clock.monotonic()
                 if now - last_beat >= HEARTBEAT_INTERVAL_S:
                     # Heartbeats pause during a broker outage (publishing
                     # them would just park stale liveness claims in the
@@ -350,7 +350,7 @@ class BaseWorker(abc.ABC):
             self._failure_reasons.pop(next(iter(self._failure_reasons)))
 
     def _deadline_expired(self, job: Job) -> bool:
-        return job.deadline_at is not None and time.time() > job.deadline_at
+        return job.deadline_at is not None and clock.wall() > job.deadline_at
 
     async def _dead_letter_deadline(
         self, job: Job, message: DeliveredMessage, trace: dict
@@ -444,7 +444,7 @@ class BaseWorker(abc.ABC):
     async def _process_message(self, message: DeliveredMessage) -> None:
         self._in_flight += 1
         self._drained.clear()
-        start = time.monotonic()
+        start = clock.monotonic()
         try:
             job = Job.model_validate_json(message.body)
         except Exception as exc:  # malformed payload: dead-letter, never requeue
@@ -492,7 +492,7 @@ class BaseWorker(abc.ABC):
             return
         try:
             output = await self._run_with_timeout(job)
-            duration_ms = (time.monotonic() - start) * 1000
+            duration_ms = (clock.monotonic() - start) * 1000
             trace_event(trace, "finished", duration_ms=round(duration_ms, 3))
             emit_trace_event(
                 job.id,

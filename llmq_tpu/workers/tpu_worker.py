@@ -24,7 +24,7 @@ import socket
 import time
 from collections import OrderedDict
 from pathlib import Path
-from typing import List, Optional
+from typing import Any, Callable, List, Optional
 
 from llmq_tpu.broker.manager import (
     job_affinity_text,
@@ -87,6 +87,7 @@ class TPUWorker(BaseWorker):
         spec_tokens: Optional[int] = None,
         tp_overlap: Optional[str] = None,
         mixed_step: Optional[str] = None,
+        engine_factory: Optional[Callable[["TPUWorker"], Any]] = None,
         **kwargs,
     ) -> None:
         self.model = model
@@ -106,6 +107,11 @@ class TPUWorker(BaseWorker):
         self._spec_tokens = spec_tokens
         self._tp_overlap = tp_overlap
         self._mixed_step = mixed_step
+        # Test/sim seam: a callable(worker) -> engine replaces the whole
+        # JAX engine build (and skips the kernel autotune passes), so the
+        # full worker control plane runs with a stub engine and no
+        # accelerator. None (the default) builds the real AsyncEngine.
+        self._engine_factory = engine_factory
         self.engine = None
         self._usage: dict = {}
         # Result-payload integrity (LLMQ_RESULT_DIGEST): emitted token
@@ -183,8 +189,9 @@ class TPUWorker(BaseWorker):
         # and signals stay live. The kernel A/B runs FIRST, while no JAX
         # backend is initialised in this process (libtpu is exclusive).
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, self._autotune_kernel)
-        await loop.run_in_executor(None, self._autotune_tp_overlap)
+        if self._engine_factory is None:
+            await loop.run_in_executor(None, self._autotune_kernel)
+            await loop.run_in_executor(None, self._autotune_tp_overlap)
         self.engine = await loop.run_in_executor(None, self._build_engine)
         # The fault callback fires on the engine thread mid-recovery;
         # breaker accounting belongs on the event loop.
@@ -424,6 +431,8 @@ class TPUWorker(BaseWorker):
         )
 
     def _build_engine(self):
+        if self._engine_factory is not None:
+            return self._engine_factory(self)
         from llmq_tpu.engine.engine import AsyncEngine
 
         engine = AsyncEngine(self._build_core())

@@ -1129,3 +1129,54 @@ class TestFleetSelfHealing:
             # (quarantine replaced dead-lettering for this job).
             assert (await mgr.broker.stats("pzq")).message_count == 0
             assert (await mgr.broker.stats("pzq.failed")).message_count == 0
+
+
+class TestChaosSeedResolution:
+    """Every chaos scheme resolves its seed the same way — explicit value
+    wins, then LLMQ_CHAOS_SEED, then 0 — and logs it at activation so a
+    failing chaos run in CI is replayable from its log line."""
+
+    def test_explicit_seed_wins_over_env(self, monkeypatch):
+        from llmq_tpu.broker.chaos import resolve_chaos_seed
+
+        monkeypatch.setenv("LLMQ_CHAOS_SEED", "777")
+        assert resolve_chaos_seed(42) == 42
+
+    def test_env_fallback_and_default(self, monkeypatch):
+        from llmq_tpu.broker.chaos import resolve_chaos_seed
+
+        monkeypatch.setenv("LLMQ_CHAOS_SEED", "777")
+        assert resolve_chaos_seed() == 777
+        monkeypatch.delenv("LLMQ_CHAOS_SEED")
+        assert resolve_chaos_seed() == 0
+
+    def test_garbage_env_falls_back_to_zero(self, monkeypatch, caplog):
+        from llmq_tpu.broker.chaos import resolve_chaos_seed
+
+        monkeypatch.setenv("LLMQ_CHAOS_SEED", "not-a-number")
+        with caplog.at_level("WARNING", logger="llmq_tpu.broker.chaos"):
+            assert resolve_chaos_seed() == 0
+        assert "LLMQ_CHAOS_SEED" in caplog.text
+
+    def test_kill_switch_honors_env_seed(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_CHAOS_SEED", "123")
+        from_env = WorkerKillSwitch("prefill", lambda: None)
+        explicit = WorkerKillSwitch("prefill", lambda: None, seed=123)
+        assert from_env.seed == 123
+        assert from_env.after == explicit.after  # identical schedule
+
+    def test_chaos_broker_url_seed_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_CHAOS_SEED", "555")
+        from_url = ChaosBroker("chaos+memory://seedtest?dup_every=3&seed=9")
+        from_env = ChaosBroker("chaos+memory://seedtest?dup_every=3")
+        assert from_url.seed == 9
+        assert from_env.seed == 555
+
+    def test_schemes_log_effective_seed(self, caplog):
+        with caplog.at_level("INFO", logger="llmq_tpu.broker.chaos"):
+            WorkerKillSwitch("decode", lambda: None, seed=31)
+            DeviceFaultInjector("prefill", "hang", seed=32)
+            BitFlipInjector("weight", seed=33)
+        assert "seed=31" in caplog.text
+        assert "seed=32" in caplog.text
+        assert "seed=33" in caplog.text

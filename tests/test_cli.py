@@ -393,6 +393,59 @@ async def test_monitor_top_degraded_fleet_shows_selfheal(
     assert "BRK" in out
 
 
+def test_monitor_top_ranks_thousand_worker_fleet():
+    """At fleet scale (1,000 heartbeats) `monitor top` renders only the
+    top-N rows by batch occupancy with a "+K more" caption, while the
+    summary line still aggregates the WHOLE fleet — tok/s and the
+    suspect-integrity count include hidden workers."""
+    from rich.console import Console
+
+    from llmq_tpu.cli.monitor import _render_top
+    from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
+
+    now = utcnow()
+    beats = {}
+    for i in range(1000):
+        wid = f"w-{i:04d}"
+        beats[wid] = WorkerHealth(
+            worker_id=wid,
+            status="running",
+            last_seen=now,
+            jobs_processed=i,
+            engine_stats={
+                "tokens_per_sec": 1.0,
+                # Distinct occupancies so the ranking is unambiguous:
+                # w-0999 is busiest, w-0000 idlest.
+                "batch_occupancy": i / 1000.0,
+            },
+            # Two suspect workers sit at the idle end — far below the
+            # top-40 cut — and must still reach the summary line.
+            integrity="suspect" if i < 2 else "ok",
+        )
+    stats = QueueStats(queue_name="bigq", message_count_ready=5)
+    frame = _render_top("bigq", beats, stats, top=40)
+    console = Console(width=220, record=True)
+    console.print(frame)
+    out = console.export_text()
+
+    assert "1000 fresh worker(s)" in out
+    assert "fleet 1000.0 tok/s" in out  # whole fleet, not just top rows
+    assert "suspect 2" in out  # hidden suspects still counted
+    assert "+960 more worker(s) below the top 40 by occupancy" in out
+    # The busiest 40 render; the idle tail (including the suspects) does not.
+    assert "w-0999" in out and "w-0960" in out
+    assert "w-0959" not in out and "w-0000" not in out
+
+
+def test_monitor_top_cli_exposes_top_option():
+    """`llmq-tpu monitor top --top N` threads through to the renderer."""
+    from llmq_tpu.cli.main import cli as cli_group
+
+    result = CliRunner().invoke(cli_group, ["monitor", "top", "--help"])
+    assert result.exit_code == 0
+    assert "--top" in result.output
+
+
 async def test_errors_view_shows_failure_reason(mem_url, monkeypatch, capsys):
     """`errors` renders the machine-readable failure class next to the
     human error message — deadline sheds and poison kills are visible

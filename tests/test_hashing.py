@@ -16,6 +16,7 @@ import pytest
 
 from llmq_tpu.utils.hashing import (
     CHAIN_DIGEST_SIZE,
+    rendezvous_pick,
     chain_hash,
     stable_bucket,
     text_prefix_chain,
@@ -112,3 +113,61 @@ def test_digests_stable_across_hash_seeds():
         outs.append(json.loads(proc.stdout))
     assert outs[0] == outs[1]
     assert len(outs[0]["chain"]) == 4  # (40-1)//8 full pages
+
+
+class TestRendezvousPick:
+    """Highest-random-weight hashing: the coordination-free owner choice
+    shared by affinity routing, KV-ship peer selection, and the fleet
+    sim. The two properties that make it usable at fleet scale: keys
+    spread evenly, and fleet churn only remaps the dead worker's keys."""
+
+    def test_deterministic_and_member(self):
+        workers = [f"w{i}" for i in range(7)]
+        pick = rendezvous_pick("digest-a", workers)
+        assert pick in workers
+        assert pick == rendezvous_pick("digest-a", list(reversed(workers)))
+
+    def test_balance_across_1k_workers(self):
+        """Across many keys the pick distribution stays within ±20% of
+        uniform — no worker silently becomes a hot spot."""
+        workers = [f"worker-{i:04d}" for i in range(1000)]
+        keys = 20_000
+        counts = {w: 0 for w in workers}
+        for k in range(keys):
+            counts[rendezvous_pick(f"chain-{k}", workers)] += 1
+        expect = keys / len(workers)
+        # Per-worker counts at 20 keys/worker are too noisy for a tight
+        # bound; check deciles of the sorted load instead (the shape of
+        # the distribution, which is what capacity planning reads).
+        ordered = sorted(counts.values())
+        decile = len(ordered) // 10
+        low_decile = sum(ordered[:decile]) / decile
+        high_decile = sum(ordered[-decile:]) / decile
+        assert low_decile >= expect * 0.5, (low_decile, expect)
+        assert high_decile <= expect * 1.6, (high_decile, expect)
+        assert sum(ordered) == keys
+
+    def test_minimal_disruption_on_leave(self):
+        """Removing one of n workers remaps only the keys it owned —
+        ~1/n of them — and every other key keeps its owner (the property
+        that makes affinity survive churn without a thundering herd)."""
+        n = 50
+        workers = [f"worker-{i:04d}" for i in range(n)]
+        keys = [f"chain-{k}" for k in range(5000)]
+        before = {k: rendezvous_pick(k, workers) for k in keys}
+        gone = workers[17]
+        survivors = [w for w in workers if w != gone]
+        moved = 0
+        for k in keys:
+            after = rendezvous_pick(k, survivors)
+            if before[k] == gone:
+                moved += 1
+                assert after != gone
+            else:
+                assert after == before[k], (
+                    f"key {k} moved {before[k]} -> {after} though its "
+                    "owner survived"
+                )
+        # The leaver owned ~1/n of the keys; allow generous noise.
+        expect = len(keys) / n
+        assert expect * 0.5 <= moved <= expect * 2.0, (moved, expect)

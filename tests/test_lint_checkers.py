@@ -129,3 +129,68 @@ def test_unparseable_file_reports_parse_error(tmp_path):
     found = analyze_paths([str(broken)])
     assert [v.rule_id for v in found] == ["parse-error"]
     assert found[0].severity == "error"
+
+
+# --- raw-clock-read (path-scoped: policy modules only) -----------------------
+# The fixture-file approach can't exercise this rule — it only fires for
+# paths matching the policy-module list — so these tests feed synthetic
+# paths through analyze_source directly.
+
+CLOCK_SNIPPET = """\
+import time
+
+def staleness():
+    return time.monotonic()
+"""
+
+
+@pytest.mark.unit
+def test_raw_clock_read_fires_in_policy_module():
+    found = analyze_source("llmq_tpu/broker/manager.py", CLOCK_SNIPPET)
+    assert [v.rule_id for v in found] == ["raw-clock-read"]
+    assert "clock.monotonic()" in found[0].message
+
+
+@pytest.mark.unit
+def test_raw_clock_read_suggests_wall_for_time_time():
+    snippet = "import time\n\ndef stamp():\n    return time.time()\n"
+    found = analyze_source("llmq_tpu/workers/base.py", snippet)
+    assert [v.rule_id for v in found] == ["raw-clock-read"]
+    assert "clock.wall()" in found[0].message
+
+
+@pytest.mark.unit
+def test_raw_clock_read_silent_outside_policy_modules():
+    assert analyze_source("llmq_tpu/engine/engine.py", CLOCK_SNIPPET) == []
+    assert analyze_source("tools/bench.py", CLOCK_SNIPPET) == []
+
+
+@pytest.mark.unit
+def test_raw_clock_read_blesses_the_clock_module_itself():
+    assert analyze_source("llmq_tpu/utils/clock.py", CLOCK_SNIPPET) == []
+
+
+@pytest.mark.unit
+def test_raw_clock_read_covers_sim_directory():
+    found = analyze_source("llmq_tpu/sim/newfile.py", CLOCK_SNIPPET)
+    assert [v.rule_id for v in found] == ["raw-clock-read"]
+
+
+@pytest.mark.unit
+def test_raw_clock_read_pragma_suppresses():
+    suppressed = CLOCK_SNIPPET.replace(
+        "time.monotonic()",
+        "time.monotonic()  # llmq: ignore[raw-clock-read]",
+    )
+    assert analyze_source("llmq_tpu/broker/manager.py", suppressed) == []
+
+
+@pytest.mark.unit
+def test_injectable_clock_usage_not_flagged():
+    snippet = (
+        "from llmq_tpu.utils import clock\n"
+        "\n"
+        "def staleness():\n"
+        "    return clock.monotonic()\n"
+    )
+    assert analyze_source("llmq_tpu/broker/manager.py", snippet) == []

@@ -179,6 +179,7 @@ def test_ladders_parse():
     assert "fleet_chaos_probe" in joined
     assert "engine_fault_probe" in joined
     assert "integrity_probe" in joined
+    assert "sim_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -366,6 +367,23 @@ def test_integrity_probe_runs():
     assert "weight-audit leg ok" in proc.stdout
     assert "canary leg ok" in proc.stdout
     assert "metric: integrity_probe_ok" in proc.stdout
+
+
+def test_sim_probe_runs():
+    """The fleet-twin rung runs end to end on CPU: a seeded fault-heavy
+    scenario completes with every invariant holding, a rerun is
+    event-identical (replay digest), and one policy regression passes
+    its recorded baseline while its documented detune breaks it."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/sim_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:sim_probe", proc)
+    assert "invariants leg ok" in proc.stdout
+    assert "replay leg ok" in proc.stdout
+    assert "regression leg ok" in proc.stdout
+    assert "metric: sim_probe_ok" in proc.stdout
 
 
 def test_bench_tiny_int4_runs():

@@ -53,6 +53,10 @@ run 900 engine_fault_probe python tools/engine_fault_probe.py
 #     weight-digest audit naming a flipped shard, golden-prompt canary
 #     round trip (value-level checks on the real chip).
 run 900 integrity_probe python tools/integrity_probe.py
+# 1h. Fleet-twin sim plane: invariants + replay determinism + one
+#     policy-regression baseline with detune teeth (virtual clock,
+#     host-side only; cheap, stays ahead of the long benches).
+run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

@@ -66,6 +66,12 @@ run 900 engine_fault_probe python tools/engine_fault_probe.py
 # the golden-prompt canary round trip — the value-level checks the
 # crash-shaped probes above can't see.
 run 900 integrity_probe python tools/integrity_probe.py
+# Fleet-twin simulation plane: seeded fault-heavy scenario with
+# invariants proven, replay determinism, and a policy-regression
+# baseline + detune-teeth check (virtual clock, host-side only; keeps
+# the policy planes the probes above exercise pinned to their recorded
+# baselines on this image).
+run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \

@@ -1,0 +1,106 @@
+"""End-to-end probe of the fleet-twin simulation plane.
+
+Three legs, each printing a ``probe: <leg> ok`` line:
+
+1. **invariants** — a seeded fault-heavy scenario (worker crashes,
+   poison jobs, chaos broker delay + duplicate deliveries) runs the real
+   worker control plane on the virtual clock and every safety property
+   holds: exactly one outcome per job, zero duplicate results, janitor
+   reclaims bounded by deaths.
+2. **replay** — the same scenario reruns event-identical (the trace
+   digest matches), proving every random draw derives from the seed.
+3. **regression** — one recorded policy baseline passes, and its
+   documented detune lands outside the recorded bounds (the suite has
+   teeth, not just numbers that matched once).
+
+Runs on CPU (preflight) and on device (hardware_session rungs)
+identically — the sim never touches an accelerator.
+
+    python tools/sim_probe.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from llmq_tpu.sim.harness import FleetSim
+from llmq_tpu.sim.invariants import check_invariants
+from llmq_tpu.sim.regression import REGRESSIONS, report_metrics, run_regression
+from llmq_tpu.sim.scenario import (
+    FaultSchedule,
+    FleetShape,
+    Scenario,
+    TrafficShape,
+)
+
+
+def _probe_scenario() -> Scenario:
+    return Scenario(
+        name="sim-probe",
+        seed=7,
+        traffic=TrafficShape(jobs=120, rate_jobs_s=40.0),
+        fleet=FleetShape(workers=12, concurrency=2),
+        faults=FaultSchedule(
+            crash_workers=2,
+            crash_window=(2.0, 3.0),
+            poison_jobs=2,
+            delay_ms=30,
+            dup_every=15,
+        ),
+        env={"LLMQ_MAX_REDELIVERIES": "50"},
+    )
+
+
+def run_invariants_leg():
+    report = FleetSim(_probe_scenario()).run()
+    assert not report.timed_out, "probe scenario hit the virtual-time ceiling"
+    violations = check_invariants(report)
+    assert not violations, "invariants broken:\n" + "\n".join(violations)
+    assert len(report.results) + len(report.failed) == 120, (
+        f"{len(report.results)} results + {len(report.failed)} dead-letters "
+        "!= 120 submitted"
+    )
+    print(
+        f"probe: invariants leg ok — 120 jobs through 12 workers with "
+        f"2 crashes + 2 poison + chaos dup/delay, "
+        f"{len(report.results)} results, all invariants hold "
+        f"({report.virtual_s:.0f}s virtual in {report.wall_s:.2f}s wall)"
+    )
+    return report
+
+
+def run_replay_leg(first):
+    second = FleetSim(_probe_scenario()).run()
+    assert second.digest == first.digest, (
+        f"replay diverged: {first.digest} vs {second.digest} "
+        f"({len(first.events)} vs {len(second.events)} events)"
+    )
+    print(
+        f"probe: replay leg ok — rerun event-identical "
+        f"(digest {first.digest}, {len(first.events)} events)"
+    )
+
+
+def run_regression_leg():
+    name = "quarantine-poison"
+    _, _, failures = run_regression(name)
+    assert not failures, f"{name} baseline broke:\n" + "\n".join(failures)
+    detuned_report, _, _ = run_regression(name, detuned=True)
+    broken = REGRESSIONS[name].check(report_metrics(detuned_report))
+    assert broken, f"{name} detune went undetected — no teeth"
+    print(
+        f"probe: regression leg ok — {name} baseline inside bounds, "
+        f"documented detune breaks {len(broken)} bound(s)"
+    )
+
+
+def main():
+    first = run_invariants_leg()
+    run_replay_leg(first)
+    run_regression_leg()
+    print("metric: sim_probe_ok legs=3")
+
+
+if __name__ == "__main__":
+    main()
