@@ -333,6 +333,22 @@ def init_devices():
         return None, [], f"no backend at all: {exc}"
 
 
+def _backend_stamp(platform, backend_note):
+    """Structured backend-probe outcome for the metric payload: which
+    platform actually produced this line and, when the accelerator never
+    came up, the probe's reason. Machine-readable on purpose — a driver
+    partitioning BENCH_r*.json lines into hardware vs CPU-fallback must
+    not have to parse the free-text ``note``."""
+    fallback = bool(backend_note) and (
+        backend_note.startswith("fell back to cpu")
+        or backend_note.startswith("no backend")
+    )
+    stamp = {"platform": platform, "fallback": fallback}
+    if backend_note:
+        stamp["probe_note"] = backend_note
+    return stamp
+
+
 def pick_preset(
     limit_bytes, platform: str, *, int8: bool = False, int4: bool = False
 ) -> str:
@@ -820,6 +836,13 @@ def main() -> None:
                         "hardware-measured line from PERF_RESULTS/ — NOT "
                         "measured this run"
                     ),
+                    "backend": {
+                        **_backend_stamp(
+                            getattr(devices[0], "platform", "cpu"),
+                            backend_note,
+                        ),
+                        "remeasured": False,
+                    },
                 }
             )
             return
@@ -1458,6 +1481,7 @@ def main() -> None:
         and _QUANT_FALLBACK.get("vs_baseline", 0) > payload["vs_baseline"]
     ):
         payload = _QUANT_FALLBACK
+    payload["backend"] = _backend_stamp(platform, backend_note)
     _emit(payload)
 
 

@@ -7,7 +7,9 @@ from llmq_tpu.analysis.checkers.devicefetch import DeviceFetchChecker
 from llmq_tpu.analysis.checkers.hostbuffer import HostBufferChecker
 from llmq_tpu.analysis.checkers.jaxsync import JaxHostSyncChecker
 from llmq_tpu.analysis.checkers.pickles import PickleSnapshotChecker
+from llmq_tpu.analysis.checkers.repartition import RepartitionChecker
 from llmq_tpu.analysis.checkers.settle import SettleExhaustiveChecker
+from llmq_tpu.analysis.checkers.sharding_axis import ShardingAxisChecker
 from llmq_tpu.analysis.checkers.tasks import OrphanTaskChecker
 from llmq_tpu.analysis.checkers.wallclock import (
     RawClockReadChecker,
@@ -21,6 +23,8 @@ ALL_CHECKERS = (
     CancelledSwallowChecker,
     JaxHostSyncChecker,
     CollectiveAxisChecker,
+    ShardingAxisChecker,
+    RepartitionChecker,
     WallclockDurationChecker,
     RawClockReadChecker,
     PickleSnapshotChecker,

@@ -38,7 +38,9 @@ from llmq_tpu.analysis.core import (
     Rule,
     SourceFile,
     Violation,
+    collect_tainted_names,
     parent,
+    walk_own_body,
 )
 
 JAX_HOST_SYNC = Rule(
@@ -126,13 +128,6 @@ def _is_hot(fn: ast.AST, ctx: AnalysisContext) -> bool:
     return False
 
 
-def _walk_own_body(fn: ast.AST) -> Iterator[ast.AST]:
-    """Walk a function body including nested defs (they trace too when
-    called from the jitted body), which is the conservative choice."""
-    for stmt in fn.body:  # type: ignore[union-attr]
-        yield from ast.walk(stmt)
-
-
 class JaxHostSyncChecker(Checker):
     rules = (JAX_HOST_SYNC, JAX_DONATE)
 
@@ -158,8 +153,11 @@ class JaxHostSyncChecker(Checker):
                 for a in (*args.posonlyargs, *args.args)
                 if a.arg not in static and a.arg not in ("self", "cls")
             }
+            # Seed the shared taint pass with the traced params so the
+            # coercion check also catches chains (``x = tokens; int(x)``).
+            traced = collect_tainted_names(node, seeds=traced_params)
             yield from self._check_body(
-                node, source, numpy_aliases, imports, traced_params
+                node, source, numpy_aliases, imports, traced
             )
             if jit is not None:
                 yield from self._check_donation(node, source, jit[1])
@@ -172,7 +170,7 @@ class JaxHostSyncChecker(Checker):
         imports: ImportMap,
         traced_params: Set[str],
     ) -> Iterator[Violation]:
-        for node in _walk_own_body(fn):
+        for node in walk_own_body(fn):
             if not isinstance(node, ast.Call):
                 continue
             func = node.func

@@ -1,0 +1,171 @@
+"""unconstrained-repartition: sharding-scrambling ops need an adjacent pin.
+
+The MoE mixed-mesh bug class (PR 14 → PR 17): inside jitted model code,
+ops whose output layout has no usable relationship to their input layout
+— ``argsort`` / ``sort`` / ``segment_sum`` / ``bincount`` /
+``ragged_dot`` over the flattened token axis — leave GSPMD free to pick
+*any* partitioning for them, and sharding propagation then walks that
+choice **backwards** into upstream blocks (the sp-ring prefill
+attention), silently repartitioning tensors that carried carefully
+chosen layouts. Worse, ``ragged_dot`` partitioned on its group axis
+keeps the *global* ``group_sizes`` per shard, so every shard miscounts
+its expert-group boundaries.
+
+The rule: any function in ``llmq_tpu/models/`` that calls one of these
+scramble ops must also pin a layout — either a direct
+``jax.lax.with_sharding_constraint`` call, or a call to a module-local
+pin helper (a function whose own body, transitively within the module,
+contains one — e.g. ``_moe_token_pins``). A function with scramble ops
+and no reachable pin is exactly the failure shape that produced the
+O(1e-1) MoE divergence, so the rule is an error.
+
+Static analysis cannot see which axis is actually sharded at trace time;
+a genuinely shard-local scramble (inside a ``shard_map`` body, where
+GSPMD never sees it) can suppress with
+``# llmq: ignore[unconstrained-repartition]`` plus a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+    walk_own_body,
+)
+
+UNCONSTRAINED_REPARTITION = Rule(
+    "unconstrained-repartition",
+    "error",
+    "sharding-scrambling op in model code with no adjacent "
+    "with_sharding_constraint pin",
+)
+
+#: Only jitted model-forward code is in scope: host-side code (engine
+#: bookkeeping, tests, tools) sorts freely.
+_MODEL_DIRS = ("llmq_tpu/models/",)
+
+#: Ops whose output partitioning is unconstrained by their inputs. The
+#: canonical paths jnp/lax aliases resolve to.
+_SCRAMBLE_OPS = frozenset(
+    {
+        "jax.numpy.argsort",
+        "jax.numpy.sort",
+        "jax.numpy.bincount",
+        "jax.lax.sort",
+        "jax.lax.ragged_dot",
+        "jax.ops.segment_sum",
+    }
+)
+
+_CONSTRAINT_PATHS = frozenset(
+    {
+        "jax.lax.with_sharding_constraint",
+        "jax.experimental.pjit.with_sharding_constraint",
+    }
+)
+
+
+def _in_scope(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(directory in norm for directory in _MODEL_DIRS)
+
+
+def _module_functions(tree: ast.Module) -> List[ast.AST]:
+    return [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _pin_providers(
+    functions: List[ast.AST], imports: ImportMap
+) -> Set[str]:
+    """Names of module-local functions that (transitively) pin a layout.
+
+    Pass 1 seeds with functions whose body contains a direct
+    ``with_sharding_constraint`` call; the fixed point adds functions
+    that call an already-known provider (``_moe_mlp`` is *not* added —
+    providers are recognized, callers are merely exempted).
+    """
+    providers: Set[str] = set()
+    for fn in functions:
+        for node in walk_own_body(fn):
+            if (
+                isinstance(node, ast.Call)
+                and (imports.resolve(node.func) or "") in _CONSTRAINT_PATHS
+            ):
+                providers.add(fn.name)  # type: ignore[union-attr]
+                break
+    while True:
+        before = len(providers)
+        for fn in functions:
+            if fn.name in providers:  # type: ignore[union-attr]
+                continue
+            if _calls_any(fn, providers):
+                providers.add(fn.name)  # type: ignore[union-attr]
+        if len(providers) == before:
+            return providers
+
+
+def _calls_any(fn: ast.AST, names: Set[str]) -> bool:
+    for node in walk_own_body(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in names
+        ):
+            return True
+    return False
+
+
+class RepartitionChecker(Checker):
+    rules = (UNCONSTRAINED_REPARTITION,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        if not _in_scope(source.path):
+            return
+        imports = ImportMap(source.tree)
+        functions = _module_functions(source.tree)
+        providers = _pin_providers(functions, imports)
+        for fn in functions:
+            scrambles: Dict[int, ast.Call] = {}
+            pinned = False
+            for node in walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = imports.resolve(node.func) or ""
+                if resolved in _CONSTRAINT_PATHS:
+                    pinned = True
+                elif resolved in _SCRAMBLE_OPS:
+                    scrambles.setdefault(id(node), node)
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in providers
+                ):
+                    pinned = True
+            if pinned or not scrambles:
+                continue
+            for call in scrambles.values():
+                op = imports.resolve(call.func)
+                yield Violation(
+                    rule=UNCONSTRAINED_REPARTITION,
+                    path=source.path,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        f"{op} scrambles sharding with no "
+                        "with_sharding_constraint pin in "
+                        f"'{fn.name}'; GSPMD propagates its free "  # type: ignore[union-attr]
+                        "partitioning choice backwards into upstream "
+                        "blocks (the MoE mixed-mesh bug class) — pin the "
+                        "operand/result layout or call a pin helper"
+                    ),
+                )

@@ -1,0 +1,139 @@
+"""sharding-axis: axis names in sharding specs must come from the registry.
+
+Generalizes ``collective-axis`` from collectives to *data layout*: every
+axis-name string appearing in a ``PartitionSpec`` / ``NamedSharding`` /
+``with_sharding_constraint`` / ``shard_map`` spec must reference the
+constants exported by ``llmq_tpu.parallel.mesh`` (``DP_AXIS``/``SP_AXIS``/
+``TP_AXIS`` — the ``AXIS_NAMES`` registry), never a bare string literal.
+
+A literal like ``P(None, "sp", None)`` still runs today, but it freezes
+the axis name at the call site: renaming an axis, or lowering a block
+onto a submesh with different axis names (the ROADMAP's disaggregated
+prefill/decode pools), silently desynchronizes the literal from the mesh
+and GSPMD treats the spec as referencing a nonexistent axis. The
+registry makes every sharding annotation follow the mesh definition.
+
+``parallel/mesh.py`` itself is exempt — it is where the axis-name
+strings are *defined*.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from llmq_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    ImportMap,
+    Rule,
+    SourceFile,
+    Violation,
+)
+
+SHARDING_AXIS = Rule(
+    "sharding-axis",
+    "error",
+    "axis name in a sharding spec is a string literal; use the "
+    "llmq_tpu.parallel.mesh axis constants",
+)
+
+#: The module where axis-name strings are legitimately spelled out.
+_EXEMPT_SUFFIXES = ("parallel/mesh.py",)
+
+_PARTITION_SPEC_PATHS = frozenset(
+    {
+        "jax.sharding.PartitionSpec",
+        "jax.experimental.pjit.PartitionSpec",
+        "jax.interpreters.pxla.PartitionSpec",
+    }
+)
+_SHARD_MAP_PATHS = frozenset(
+    {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+)
+_CONSTRAINT_PATHS = frozenset(
+    {
+        "jax.lax.with_sharding_constraint",
+        "jax.experimental.pjit.with_sharding_constraint",
+    }
+)
+
+
+def _is_exempt(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(suffix) for suffix in _EXEMPT_SUFFIXES)
+
+
+def _literal_strings(node: ast.AST) -> Iterator[ast.Constant]:
+    """String constants in a spec expression (axis-name positions)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub
+
+
+class ShardingAxisChecker(Checker):
+    rules = (SHARDING_AXIS,)
+
+    def run(self, source: SourceFile, ctx: AnalysisContext) -> Iterator[Violation]:
+        if _is_exempt(source.path):
+            return
+        imports = ImportMap(source.tree)
+        # Dedup by location: a literal inside ``NamedSharding(mesh, P("sp"))``
+        # is reachable through both the NamedSharding spec-arg walk and the
+        # PartitionSpec call check.
+        found: Dict[Tuple[int, int], Violation] = {}
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = imports.resolve(node.func) or ""
+            spec_exprs = self._spec_expressions(node, resolved)
+            for construct, expr in spec_exprs:
+                for lit in _literal_strings(expr):
+                    key = (lit.lineno, lit.col_offset)
+                    if key in found:
+                        continue
+                    found[key] = Violation(
+                        rule=SHARDING_AXIS,
+                        path=source.path,
+                        line=lit.lineno,
+                        col=lit.col_offset,
+                        message=(
+                            f"axis name {lit.value!r} in {construct} is a "
+                            "string literal; reference the "
+                            "llmq_tpu.parallel.mesh constants (AXIS_NAMES) "
+                            "so specs follow the mesh definition"
+                        ),
+                    )
+        yield from found.values()
+
+    @staticmethod
+    def _spec_expressions(
+        node: ast.Call, resolved: str
+    ) -> Iterator[Tuple[str, ast.AST]]:
+        """(construct label, expression holding axis names) pairs."""
+        if resolved in _PARTITION_SPEC_PATHS:
+            for arg in node.args:
+                yield "PartitionSpec(...)", arg
+        elif resolved == "jax.sharding.NamedSharding":
+            spec = _positional_or_kw(node, 1, "spec")
+            if spec is not None:
+                yield "NamedSharding(...)", spec
+        elif resolved in _CONSTRAINT_PATHS:
+            spec = _positional_or_kw(node, 1, "shardings")
+            if spec is not None:
+                yield "with_sharding_constraint(...)", spec
+        elif resolved in _SHARD_MAP_PATHS:
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    yield f"shard_map {kw.arg}", kw.value
+
+
+def _positional_or_kw(
+    node: ast.Call, index: int, kw_name: str
+) -> Optional[ast.AST]:
+    if len(node.args) > index:
+        return node.args[index]
+    for kw in node.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    return None

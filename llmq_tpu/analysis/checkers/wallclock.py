@@ -34,7 +34,7 @@ real seconds a virtual run took), suppress with
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Set
+from typing import Iterator
 
 from llmq_tpu.analysis.core import (
     AnalysisContext,
@@ -43,6 +43,7 @@ from llmq_tpu.analysis.core import (
     Rule,
     SourceFile,
     Violation,
+    collect_tainted_names,
     walk_skipping_functions,
 )
 
@@ -99,30 +100,6 @@ def _is_wallclock_call(node: ast.AST, imports: ImportMap) -> bool:
     )
 
 
-def _collect_tainted_names(fn: ast.AST, imports: ImportMap) -> Set[str]:
-    """Local names holding a ``time.time()`` sample, through assignment
-    chains (``t0 = time.time(); start = t0``). One forward pass per round
-    until the set stops growing — functions are small, chains are short."""
-    tainted: Set[str] = set()
-    while True:
-        before = len(tainted)
-        for node in walk_skipping_functions(fn.body):  # type: ignore[union-attr]
-            if isinstance(node, ast.Assign):
-                value, targets = node.value, node.targets
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                value, targets = node.value, [node.target]
-            else:
-                continue
-            if _is_wallclock_call(value, imports) or (
-                isinstance(value, ast.Name) and value.id in tainted
-            ):
-                for target in targets:
-                    if isinstance(target, ast.Name):
-                        tainted.add(target.id)
-        if len(tainted) == before:
-            return tainted
-
-
 class WallclockDurationChecker(Checker):
     rules = (WALLCLOCK_DURATION,)
 
@@ -136,7 +113,9 @@ class WallclockDurationChecker(Checker):
         for node in ast.walk(source.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            tainted = _collect_tainted_names(node, imports)
+            tainted = collect_tainted_names(
+                node, is_source=lambda v: _is_wallclock_call(v, imports)
+            )
 
             def _wall(operand: ast.AST) -> bool:
                 return _is_wallclock_call(operand, imports) or (

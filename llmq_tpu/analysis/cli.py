@@ -13,7 +13,7 @@ from typing import List, Optional, Sequence
 
 from llmq_tpu.analysis.checkers import RULES
 from llmq_tpu.analysis.core import AnalysisContext, analyze_paths
-from llmq_tpu.analysis.reporters import render_json, render_text
+from llmq_tpu.analysis.reporters import render_json, render_sarif, render_text
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -29,9 +29,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
-        help="report format",
+        help="report format (sarif = SARIF 2.1.0 for CI diff annotation)",
+    )
+    parser.add_argument(
+        "--spmd",
+        action="store_true",
+        help="also run the tier-B SPMD repartition diff gate (lowers the "
+        "tiny-preset programs over the mesh matrix in a subprocess with "
+        "8 virtual CPU devices and diffs collective signatures against "
+        "the recorded baseline)",
+    )
+    parser.add_argument(
+        "--spmd-record",
+        action="store_true",
+        help="re-record the SPMD collective-signature baseline instead of "
+        "diffing (implies --spmd)",
     )
     parser.add_argument(
         "--strict",
@@ -95,16 +109,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         select=set(args.select) if args.select else None,
         ignore=set(args.ignore) if args.ignore else None,
     )
-    report = (
-        render_json(violations) if args.format == "json" else render_text(violations)
-    )
-    print(report)
+    renderer = {
+        "json": render_json,
+        "sarif": render_sarif,
+        "text": render_text,
+    }[args.format]
+    print(renderer(violations))
     failing: List = [
         v
         for v in violations
         if v.severity == "error" or (args.strict and v.severity == "warning")
     ]
-    return 1 if failing else 0
+    rc = 1 if failing else 0
+
+    if args.spmd or args.spmd_record:
+        from llmq_tpu.analysis.spmd import run_gate_subprocess
+
+        spmd_rc = run_gate_subprocess(record=args.spmd_record)
+        rc = max(rc, spmd_rc)
+    return rc
 
 
 if __name__ == "__main__":

@@ -218,6 +218,51 @@ def walk_skipping_functions(body: Iterable[ast.stmt]) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+def walk_own_body(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body including nested defs (they trace/run in the
+    enclosing context too), which is the conservative choice for checkers
+    that follow values across closures."""
+    for stmt in fn.body:  # type: ignore[union-attr]
+        yield from ast.walk(stmt)
+
+
+def collect_tainted_names(
+    fn: ast.AST,
+    *,
+    seeds: Iterable[str] = (),
+    is_source=None,
+) -> Set[str]:
+    """Local names carrying a tainted value, through simple assignment
+    chains (``t0 = source(); start = t0``).
+
+    The taint originates from ``seeds`` (pre-tainted names, e.g. a jitted
+    function's traced parameters) and/or from any assignment whose value
+    satisfies ``is_source`` (e.g. a ``time.time()`` call). One forward
+    pass per round until the set stops growing — functions are small,
+    chains are short. Shared by the wallclock-duration and jax-host-sync
+    checkers; nested defs are skipped (their locals are a different
+    scope).
+    """
+    tainted: Set[str] = set(seeds)
+    while True:
+        before = len(tainted)
+        for node in walk_skipping_functions(fn.body):  # type: ignore[union-attr]
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if (is_source is not None and is_source(value)) or (
+                isinstance(value, ast.Name) and value.id in tainted
+            ):
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        tainted.add(target.id)
+        if len(tainted) == before:
+            return tainted
+
+
 # ---------------------------------------------------------------------------
 # Driving the pass
 # ---------------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""Render violations as human text or machine JSON."""
+"""Render violations as human text, machine JSON, or SARIF for CI."""
 
 from __future__ import annotations
 
@@ -7,6 +7,14 @@ from collections import Counter
 from typing import List, Sequence
 
 from llmq_tpu.analysis.core import Violation
+
+#: SARIF 2.1.0 is the schema GitHub code scanning ingests; emitting it
+#: lets CI annotate the exact diff lines a rule fired on.
+_SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def render_text(violations: Sequence[Violation]) -> str:
@@ -43,5 +51,58 @@ def render_json(violations: Sequence[Violation]) -> str:
             "warnings": sum(1 for v in violations if v.severity == "warning"),
             "by_rule": dict(sorted(by_rule.items())),
         },
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)
+
+
+def render_sarif(violations: Sequence[Violation]) -> str:
+    """SARIF 2.1.0 log: one run, the registered rules, one result per
+    violation. Rule metadata comes from the registry (not just the rules
+    that fired) so viewers can show descriptions for clean runs too."""
+    from llmq_tpu.analysis.checkers import RULES
+
+    rules = [
+        {
+            "id": rule.id,
+            "shortDescription": {"text": rule.summary},
+            "defaultConfiguration": {"level": rule.severity},
+        }
+        for rule in sorted(RULES.values(), key=lambda r: r.id)
+    ]
+    results = [
+        {
+            "ruleId": v.rule_id,
+            "level": v.severity,
+            "message": {"text": v.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": v.path},
+                        "region": {
+                            "startLine": v.line,
+                            # SARIF columns are 1-based; ast's are 0-based.
+                            "startColumn": v.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for v in violations
+    ]
+    payload = {
+        "$schema": _SARIF_SCHEMA,
+        "version": _SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "llmq-tpu-lint",
+                        "informationUri": "https://github.com/llmq-tpu",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
     }
     return json.dumps(payload, indent=2, sort_keys=False)

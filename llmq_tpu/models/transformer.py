@@ -176,12 +176,65 @@ def _mlp(
     )
 
 
+def moe_token_pin_enabled() -> bool:
+    """Whether the MoE grouped-matmul token-axis sharding pins are armed.
+
+    Default ON. ``LLMQ_MOE_TOKEN_PIN=off`` re-introduces the mixed-mesh
+    repartition bug deliberately — it exists so the SPMD diff gate's
+    detune test (and a hardware bisection session) can reproduce the
+    un-pinned programs; it is never a production setting.
+    """
+    return (os.environ.get("LLMQ_MOE_TOKEN_PIN") or "on").lower() not in (
+        "0",
+        "off",
+        "false",
+        "no",
+    )
+
+
+def _moe_token_pins(mesh):
+    """(pin_rows, pin_repl) for the MoE grouped-matmul operands.
+
+    GSPMD propagates the expert weights' tp sharding backwards through
+    ``ragged_dot``/``segment_sum`` and is free to partition their
+    flattened ``[N*k, ...]`` token/group axis over any mesh axis — but
+    each shard would keep the GLOBAL ``group_sizes``, so every shard's
+    expert-group boundaries are wrong and the grouped matmuls read the
+    wrong experts' rows (bisected on the pinned mixed-mesh divergence:
+    ``moe.gathered`` bit-stable, ``moe.gate`` rel 5e-1 on (2,2,2)).
+    ``pin_rows`` pins ONLY that leading token/group axis unsharded and
+    leaves every other dim to GSPMD (``P.UNCONSTRAINED``), so the
+    per-expert column/row splits still shard over tp; ``pin_repl`` pins
+    ``group_sizes`` fully replicated to match. Identity when no mesh is
+    threaded (single-device paths, shard_map bodies).
+    """
+    if mesh is None or not moe_token_pin_enabled():
+        return (lambda x: x), (lambda x: x)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    unconstrained = PartitionSpec.UNCONSTRAINED
+
+    def pin_rows(x):
+        spec = PartitionSpec(None, *([unconstrained] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec)
+        )
+
+    def pin_repl(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec())
+        )
+
+    return pin_rows, pin_repl
+
+
 def _moe_mlp(
     h: jnp.ndarray,
     lp: Params,
     config: ModelConfig,
     plan: "cm.TpRingPlan | None" = None,
     layer=-1,
+    mesh=None,
 ) -> jnp.ndarray:
     """Sparse mixture-of-experts MLP (qwen2_moe/qwen3_moe semantics),
     TPU-first: tokens are sorted by routed expert and each expert's group
@@ -191,12 +244,18 @@ def _moe_mlp(
     Routing follows HF Qwen2MoeSparseMoeBlock: softmax over ALL experts
     in f32, then top-k (optionally renormalized), plus qwen2_moe's
     always-on shared expert blended through a sigmoid gate.
+
+    The token/group axis of every grouped-matmul operand is pinned
+    unsharded (``_moe_token_pins``): ``ragged_dot``'s group semantics
+    are only correct when each shard sees ALL rows of ``xs`` alongside
+    the global ``group_sizes``.
     """
     *lead, H = h.shape
     x = h.reshape(-1, H)
     N = x.shape[0]
     E = config.num_experts
     k = config.num_experts_per_tok
+    pin_rows, pin_repl = _moe_token_pins(mesh)
 
     router_logits = _tap(
         (x @ lp["router"]).astype(jnp.float32), "moe.router", layer
@@ -211,29 +270,37 @@ def _moe_mlp(
     flat_e = top_e.reshape(-1)  # [N*k]
     order = jnp.argsort(flat_e)  # stable: ties keep token order
     token_of = order // k  # source token per sorted row
-    xs = _tap(x[token_of], "moe.gathered", layer)  # [N*k, H] grouped
-    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    xs = _tap(pin_rows(x[token_of]), "moe.gathered", layer)  # [N*k, H]
+    group_sizes = pin_repl(
+        jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    )
 
     # ragged_dot takes a real array operand: int8 expert stacks are
     # dequantized per layer-scan step (a transient one-layer bf16 copy;
     # HBM-resident storage stays int8).
     gate = _tap(
-        jax.lax.ragged_dot(
-            xs, qm.dequantize(lp["expert_gate_proj"], x.dtype), group_sizes
+        pin_rows(
+            jax.lax.ragged_dot(
+                xs, qm.dequantize(lp["expert_gate_proj"], x.dtype), group_sizes
+            )
         ),
         "moe.gate",
         layer,
     )
-    up = jax.lax.ragged_dot(
-        xs, qm.dequantize(lp["expert_up_proj"], x.dtype), group_sizes
+    up = pin_rows(
+        jax.lax.ragged_dot(
+            xs, qm.dequantize(lp["expert_up_proj"], x.dtype), group_sizes
+        )
     )
     if config.activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True) * up
     else:
         act = jax.nn.silu(gate) * up
     down = _tap(
-        cm.row_parallel_ragged_matmul(
-            act, lp["expert_down_proj"], group_sizes, x.dtype, plan
+        pin_rows(
+            cm.row_parallel_ragged_matmul(
+                act, lp["expert_down_proj"], group_sizes, x.dtype, plan
+            )
         ),
         "moe.down",
         layer,
@@ -339,7 +406,7 @@ class Transformer:
         h = h + attn_proj
         mlp_in = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, one_plus=one_plus)
         mlp_out = (
-            _moe_mlp(mlp_in, lp, cfg, plan, layer)
+            _moe_mlp(mlp_in, lp, cfg, plan, layer, self.mesh)
             if cfg.num_experts
             else _mlp(mlp_in, lp, cfg.activation, plan, layer)
         )

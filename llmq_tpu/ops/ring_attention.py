@@ -24,7 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 if not hasattr(jax, "shard_map"):  # jax 0.4.x: pre-promotion location
     from jax.experimental.shard_map import shard_map as _shard_map
@@ -162,6 +162,17 @@ def ring_prefill_attention(
         else None
     )
     spec = P(None, SP_AXIS, head, None)
+    # Defense in depth against the GSPMD back-propagation hazard class
+    # (the MoE mixed-mesh bug): pin the operands to the ring layout
+    # EXPLICITLY rather than letting the partitioner infer it from the
+    # shard_map boundary. Downstream blocks whose preferred partitioning
+    # differs (e.g. token-axis ops) then reshard HERE, visibly, instead
+    # of silently repartitioning the ring inputs.
+    ring_sharding = NamedSharding(mesh, spec)
+    q, k, v = (
+        jax.lax.with_sharding_constraint(x, ring_sharding)
+        for x in (q, k, v)
+    )
     varying = (SP_AXIS,) + ((TP_AXIS,) if head else ())
     fn = jax.shard_map(
         _ring_body(sp, scale, softcap, varying),

@@ -18,6 +18,14 @@ DP_AXIS = "dp"
 SP_AXIS = "sp"  # sequence/context parallel (ring attention over ICI)
 TP_AXIS = "tp"
 
+#: The ONLY mesh axis names this codebase defines. Every axis-name string
+#: in a PartitionSpec / NamedSharding / with_sharding_constraint /
+#: shard_map spec must reference these constants (the ``sharding-axis``
+#: lint rule enforces it), so renaming an axis — or threading a submesh —
+#: is a one-line change here instead of a grep-and-pray across every
+#: sharding annotation.
+AXIS_NAMES = (DP_AXIS, SP_AXIS, TP_AXIS)
+
 
 def auto_tensor_parallel(
     data_parallel: int = 1, devices=None, sequence_parallel: int = 1

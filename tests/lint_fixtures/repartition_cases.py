@@ -1,0 +1,63 @@
+"""unconstrained-repartition: scramble ops in model code need a pin.
+
+This rule is path-scoped to ``llmq_tpu/models/`` — the marker test feeds
+this file's text through ``analyze_source`` under a synthetic model path
+(see ``test_lint_checkers.py``), mirroring the raw-clock-read approach.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from llmq_tpu.parallel.mesh import DP_AXIS
+
+
+def bad_unpinned_argsort(flat_e):
+    return jnp.argsort(flat_e)  # EXPECT[unconstrained-repartition]
+
+
+def bad_unpinned_group_sizes(flat_e, n):
+    return jnp.bincount(flat_e, length=n)  # EXPECT[unconstrained-repartition]
+
+
+def bad_unpinned_ragged(xs, w, group_sizes):
+    return jax.lax.ragged_dot(xs, w, group_sizes)  # EXPECT[unconstrained-repartition]
+
+
+def bad_unpinned_combine(vals, seg, n):
+    return jax.ops.segment_sum(vals, seg, num_segments=n)  # EXPECT[unconstrained-repartition]
+
+
+def good_direct_pin(flat_e, mesh):
+    order = jnp.argsort(flat_e)
+    return jax.lax.with_sharding_constraint(
+        order, NamedSharding(mesh, PartitionSpec(None))
+    )
+
+
+def _pin_helper(x, mesh):
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(DP_AXIS))
+    )
+
+
+def good_via_pin_helper(flat_e, mesh):
+    return _pin_helper(jnp.argsort(flat_e), mesh)
+
+
+def _pin_helper_indirect(x, mesh):
+    return _pin_helper(x, mesh)
+
+
+def good_via_transitive_helper(flat_e, mesh):
+    return _pin_helper_indirect(jnp.argsort(flat_e), mesh)
+
+
+def good_host_side_sort(values):
+    # Plain builtins / non-jnp sorts carry no sharding to scramble.
+    return sorted(values)
+
+
+def good_suppressed(flat_e):
+    # Shard-local scramble (inside a shard_map body GSPMD never sees).
+    return jnp.argsort(flat_e)  # llmq: ignore[unconstrained-repartition]

@@ -1,0 +1,70 @@
+"""sharding-axis: axis names in sharding specs must be the parallel.mesh
+constants, not string literals."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec as P
+
+from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS
+
+
+def bad_partition_spec_literal():
+    return P(None, "sp", None)  # EXPECT[sharding-axis]
+
+
+def bad_partition_spec_full_name():
+    return PartitionSpec("dp", None)  # EXPECT[sharding-axis]
+
+
+def bad_partition_spec_tuple_entry():
+    return P(("dp", "sp"), None)  # EXPECT[sharding-axis] EXPECT[sharding-axis]
+
+
+def bad_named_sharding_literal(mesh):
+    return NamedSharding(mesh, P(None, "tp"))  # EXPECT[sharding-axis]
+
+
+def bad_constraint_literal(mesh, x):
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp"))  # EXPECT[sharding-axis]
+    )
+
+
+def bad_shard_map_specs(mesh, fn):
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(None, "sp", None), P()),  # EXPECT[sharding-axis]
+        out_specs=P(None, "sp", None),  # EXPECT[sharding-axis]
+    )
+
+
+def good_constants():
+    return P(None, SP_AXIS, TP_AXIS)
+
+
+def good_constant_tuple():
+    return P((DP_AXIS, SP_AXIS), None)
+
+
+def good_named_sharding(mesh):
+    return NamedSharding(mesh, P(DP_AXIS, None))
+
+
+def good_variable_axis(axis):
+    # A reference is exactly what the rule wants; only literals flag.
+    return P(None, axis, None)
+
+
+def good_unconstrained(x, mesh):
+    spec = P(None, *([P.UNCONSTRAINED] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def good_non_axis_string():
+    # String literals outside spec arguments are not axis names.
+    return jax.numpy.asarray([0], dtype="int32")
+
+
+def good_suppressed():
+    return P(None, "sp", None)  # llmq: ignore[sharding-axis]

@@ -29,6 +29,30 @@ def _reset_fallback():
     bench._QUANT_FALLBACK = None
 
 
+class TestBackendStamp:
+    def test_healthy_backend(self):
+        stamp = bench._backend_stamp("tpu", None)
+        assert stamp == {"platform": "tpu", "fallback": False}
+
+    def test_cpu_fallback_is_structured(self):
+        stamp = bench._backend_stamp(
+            "cpu", "fell back to cpu: probe failed or hung"
+        )
+        assert stamp["platform"] == "cpu"
+        assert stamp["fallback"] is True
+        assert "probe failed" in stamp["probe_note"]
+
+    def test_requested_cpu_is_not_a_fallback(self):
+        # JAX_PLATFORMS=cpu (tests, CI) returns no note: the platform is
+        # cpu by request, and the stamp must not smell like a failure.
+        stamp = bench._backend_stamp("cpu", None)
+        assert stamp == {"platform": "cpu", "fallback": False}
+
+    def test_stamp_is_json_serializable(self):
+        stamp = bench._backend_stamp("cpu", "fell back to cpu: x")
+        assert json.loads(json.dumps(stamp)) == stamp
+
+
 class TestQuantAttemptParsing:
     def _patch_run(self, monkeypatch, proc=None, exc=None):
         def fake_run(*a, **kw):

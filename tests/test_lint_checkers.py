@@ -35,6 +35,7 @@ CASES = [
     ("cancellation_cases.py", {"cancelled-swallow"}),
     ("jax_cases.py", {"jax-host-sync", "jax-donate"}),
     ("collective_axis_cases.py", {"collective-axis"}),
+    ("sharding_axis_cases.py", {"sharding-axis"}),
     ("wallclock_cases.py", {"wallclock-duration"}),
     ("pickle_cases.py", {"pickle-snapshot"}),
     ("hostbuffer_cases.py", {"unbounded-host-buffer"}),
@@ -183,6 +184,35 @@ def test_raw_clock_read_pragma_suppresses():
         "time.monotonic()  # llmq: ignore[raw-clock-read]",
     )
     assert analyze_source("llmq_tpu/broker/manager.py", suppressed) == []
+
+
+# --- unconstrained-repartition (path-scoped: llmq_tpu/models/ only) ----------
+# Same synthetic-path approach as raw-clock-read: the fixture's markers are
+# diffed against analyze_source under a model-directory path.
+
+
+@pytest.mark.unit
+def test_repartition_fixture_matches_markers_under_model_path():
+    path = FIXTURES / "repartition_cases.py"
+    expected = expected_markers(path)
+    assert expected and {r for _, r in expected} == {"unconstrained-repartition"}
+    found = {
+        (v.line, v.rule_id)
+        for v in analyze_source(
+            "llmq_tpu/models/repartition_cases.py",
+            path.read_text(encoding="utf-8"),
+        )
+    }
+    assert found == expected
+
+
+@pytest.mark.unit
+def test_repartition_silent_outside_model_code():
+    # The identical text under its real fixtures path produces nothing:
+    # host-side code sorts freely.
+    path = FIXTURES / "repartition_cases.py"
+    found = analyze_paths([str(path)], select={"unconstrained-repartition"})
+    assert found == []
 
 
 @pytest.mark.unit

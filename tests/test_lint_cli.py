@@ -142,6 +142,46 @@ def test_warning_passes_unless_strict(tmp_path, capsys):
 
 
 @pytest.mark.unit
+def test_sarif_report_shape(seed_file, capsys):
+    rc = lint_main([str(seed_file), "--format", "sarif"])
+    log = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    (run,) = log["runs"]
+
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "llmq-tpu-lint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    # the registry ships with the run, not just the rules that fired
+    assert {rid for rid, _ in EXPECTED} <= rule_ids
+    assert {"sharding-axis", "unconstrained-repartition"} <= rule_ids
+
+    found = set()
+    for result in run["results"]:
+        (loc,) = result["locations"]
+        region = loc["physicalLocation"]["region"]
+        assert region["startColumn"] >= 1  # SARIF columns are 1-based
+        assert loc["physicalLocation"]["artifactLocation"]["uri"] == str(
+            seed_file
+        )
+        assert result["message"]["text"]
+        found.add((result["ruleId"], region["startLine"]))
+    assert found == EXPECTED
+
+
+@pytest.mark.unit
+def test_sarif_clean_run_still_lists_rules(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("async def ok():\n    return 1\n")
+    assert lint_main([str(clean), "--format", "sarif"]) == 0
+    log = json.loads(capsys.readouterr().out)
+    (run,) = log["runs"]
+    assert run["results"] == []
+    assert run["tool"]["driver"]["rules"]
+
+
+@pytest.mark.unit
 def test_list_rules_covers_all_checkers(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out

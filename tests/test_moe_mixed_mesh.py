@@ -1,80 +1,71 @@
-"""Pin for the pre-existing MoE mixed-mesh token divergence.
+"""MoE mixed-mesh greedy parity — the cashed-in fix for the former
+pinned divergence (PR 14's ticket, closed in PR 17).
 
-TICKET (pinned, not fixed here)
--------------------------------
-``dryrun_multichip``'s sparse-MoE leg diverges from the single-device
-greedy run whenever sequence parallelism is COMBINED with another mesh
-axis. Measured isolation matrix (CPU, 8 virtual devices, this commit):
+ROOT CAUSE (supersedes the r14 ring-attention attribution)
+----------------------------------------------------------
+``dryrun_multichip``'s sparse-MoE leg diverged from the single-device
+greedy run whenever sequence parallelism was combined with another mesh
+axis. The r14 act-stat bisection correctly located the first corrupted
+tensor (layer-0 sp-ring prefill attention output) but misread the
+direction of causation: the attention was the *victim*, not the source.
+GSPMD propagates layouts backwards as well as forwards, and the MoE
+block's flattened-token-axis ops — ``argsort`` (transformer.py token
+permutation), ``gather``, ``ragged_dot`` — have a free layout choice on
+that axis. On meshes where sp combines with a second axis, XLA chose to
+partition the grouped matmul's token/group axis. ``ragged_dot``'s
+``group_sizes`` argument is computed globally (``bincount`` over ALL
+tokens), so each shard paired its local token slice with the GLOBAL
+group boundaries: wrong expert-group segmentation per shard, then the
+repartition back-propagated into the ring attention's operands, which
+is where the bisection first saw it.
 
-    mesh (dp,sp,tp)   greedy parity vs (1,1,1)
-    (2,1,4)           MATCH
-    (2,1,1)           MATCH
-    (1,2,1)           MATCH          <- sp alone is fine
-    (1,2,4)           'long' DIVERGED
-    (2,2,1)           'long' DIVERGED
-    (2,2,2)           'long' DIVERGED  <- the dryrun's mixed mesh
-    (2,4,1)           'long' DIVERGED
-    (4,2,1)           'a' AND 'long' DIVERGED
+THE FIX (models/transformer.py ``_moe_token_pins``)
+---------------------------------------------------
+``_moe_mlp`` pins the token axis of its intermediates with
+``with_sharding_constraint`` (rows unconstrained on trailing dims,
+``group_sizes`` replicated), so GSPMD may never shard the expert-group
+segmentation. ``LLMQ_MOE_TOKEN_PIN=off`` (trace-time read) deliberately
+re-introduces the bug for the SPMD diff gate's detune leg and the
+detune test below — it is never a production setting.
 
-The divergence appears at the FIRST generated token (prefill logits),
-only for the MoE model (the dense flagship matches on every mesh), and
-(4,2,1) diverging on a short 2-page prompt rules out the ring-attention
-long-prompt path as the sole trigger.
+The compiled-HLO regression gate for this bug class lives in
+``llmq_tpu/analysis/spmd.py`` (``llmq-tpu lint --spmd``): the un-pinned
+programs show up as new ``all-reduce@dp+sp+tp`` collectives in the
+single-row prefill module long before they flip a token.
 
-BISECTED (r14, LLMQ_ACT_STATS per-op taps on the first prefill
-dispatch, mesh (1,2,2) vs (1,1,1), noise floor from the known-good
-meshes (1,2,1)/(1,1,4) ≈ 1e-7 relative on mean|x|):
-
-    tap              layer 0 rel      verdict
-    ln1.out          0                clean
-    attn.q/k/v       ~1e-7            clean (noise floor)
-    attn.out         2.6e-4           <- divergence enters HERE
-    moe.combine      4.8e-3           downstream amplification
-    lm_head.logits   1.8e-2           flips the near-tied argmax
-
-The original prime suspect — ``_moe_mlp``'s ``argsort``/``segment_sum``
-combine — is EXONERATED as the entry point: its inputs already differ.
-The corruption enters inside the LAYER-0 sp-ring prefill attention
-(``ops/dispatch.prefill_attention``) while its q/k/v inputs are still
-bit-stable, and only when the program also contains the MoE block: the
-dense flagship on the identical (1,2,2) mesh holds attn.out at 7.7e-8.
-Every diverging mesh — (2,2,1), (1,2,2), (1,2,4) — produces the SAME
-corrupted stats bit-for-bit, so this is one deterministic alternative
-partitioning, not accumulation jitter. Conclusion: GSPMD sharding
-propagation from the MoE block's flattened-token-axis ops (gather /
-argsort / segment_sum) repartitions the upstream ring attention when
-sp is combined with any second mesh axis, and the re-partitioned
-softmax accumulates differently by O(1e-4) — enough to flip the tiny
-random model's near-tied logits. Candidate fixes: pin the attention
-input sharding with an explicit ``with_sharding_constraint`` on the
-token axis before the ring, or make the MoE combine shard-local
-(segment_sum per sp shard + all-gather). Until then cross-mesh
-snapshot migration must stay on the known-good meshes below.
-
-Repro: ``python -c "from __graft_entry__ import _engine_run;
-print(_engine_run(1,1,1,moe=True)[0]['long'],
-_engine_run(2,2,2,moe=True)[0]['long'])"`` with
-``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
-Bisection harness: LLMQ_ACT_STATS=1, run one prefill, diff
-``models.transformer.pop_act_stats()`` between meshes per (op, layer).
+Full measured matrix (CPU, 8 virtual devices): every mesh below must
+match the single-device greedy run bit-for-bit, including the five
+that diverged before the pins landed.
 """
+
+import os
+import subprocess
+import sys
+from pathlib import Path
 
 import pytest
 
 from __graft_entry__ import _engine_run
 
+REPO = Path(__file__).resolve().parent.parent
 
-@pytest.mark.skip(
-    reason="KNOWN DIVERGENCE (pre-existing, pinned): MoE + sp>=2 combined "
-    "with any other mesh axis flips greedy tokens vs single-device. "
-    "Bisected (r14 act-stat taps) to the layer-0 sp-ring prefill "
-    "attention being repartitioned by the MoE block's token-axis ops — "
-    "see module docstring ticket. Remove this skip once the attention "
-    "input sharding is pinned; the body then asserts the fix."
+#: The full measured matrix: formerly-diverging meshes first.
+FULL_MATRIX = (
+    (2, 2, 2),  # the dryrun's mixed mesh
+    (1, 2, 4),
+    (2, 2, 1),
+    (2, 4, 1),
+    (4, 2, 1),
+    (2, 1, 4),
+    (2, 1, 1),
+    (1, 2, 1),
 )
+
+
 def test_moe_mixed_mesh_greedy_parity():
-    """The dryrun's failing assertion, as a test: MoE on dp=2 x sp=2 x
-    tp=2 must match the single-device greedy run bit-for-bit."""
+    """The formerly-failing assertion, now the fix's proof: MoE on
+    dp=2 x sp=2 x tp=2 matches the single-device greedy run
+    bit-for-bit."""
     ref, _ = _engine_run(1, 1, 1, moe=True)
     got, _ = _engine_run(2, 2, 2, moe=True)
     for rid in ("a", "long"):
@@ -85,17 +76,69 @@ def test_moe_mixed_mesh_greedy_parity():
 
 
 @pytest.mark.slow
+def test_moe_full_matrix_greedy_parity():
+    """Every mesh in the measured matrix — including all five that
+    diverged before the token-axis pins — holds greedy parity. The
+    stochastic rows ('b', 'c') legitimately vary when the mesh shifts
+    reduction order, so only the greedy rows are compared (the same
+    convention as the dryrun's own parity legs)."""
+    ref, _ = _engine_run(1, 1, 1, moe=True)
+    for mesh in FULL_MATRIX:
+        got, _ = _engine_run(*mesh, moe=True)
+        for rid in ("a", "long"):
+            assert got[rid] == ref[rid], (
+                f"MoE mesh {mesh} diverged for {rid!r}: "
+                f"{ref[rid]} -> {got[rid]}"
+            )
+
+
+@pytest.mark.slow
 def test_moe_known_good_meshes_hold_parity():
-    """The boundary of the pinned bug must not creep: the meshes the
-    snapshot-migration plane is allowed to move MoE state between —
-    sp=1 combinations and sp alone — stay greedy-identical to the
-    single-device run."""
+    """The meshes that were ALWAYS parity-clean (sp=1 combinations and
+    sp alone) stay greedy-identical — a regression here means the fix
+    broke working configurations, not just missed the broken ones."""
     ref, _ = _engine_run(1, 1, 1, moe=True)
     for mesh in ((2, 1, 4), (2, 1, 1), (1, 2, 1)):
         got, _ = _engine_run(*mesh, moe=True)
         for rid in ("a", "long"):
             assert got[rid] == ref[rid], (
                 f"known-good MoE mesh {mesh} now diverges for {rid!r}: "
-                f"{ref[rid]} -> {got[rid]} — the pinned mixed-mesh bug "
-                "has spread"
+                f"{ref[rid]} -> {got[rid]}"
             )
+
+
+@pytest.mark.slow
+def test_moe_token_pin_detune_diverges():
+    """Teeth: with the pins disarmed the original bug must come back on
+    the dryrun's mixed mesh (otherwise the fix is dead code and the
+    parity above proves nothing). Runs in a subprocess so the trace-time
+    env read cannot leak into other tests' jit caches."""
+    code = (
+        "from __graft_entry__ import _engine_run\n"
+        "ref, _ = _engine_run(1, 1, 1, moe=True)\n"
+        "got, _ = _engine_run(2, 2, 2, moe=True)\n"
+        "diverged = [rid for rid in ('a', 'long') if got[rid] != ref[rid]]\n"
+        "print('DIVERGED' if diverged else 'MATCHED', diverged)\n"
+    )
+    env = dict(os.environ)
+    env["LLMQ_MOE_TOKEN_PIN"] = "off"
+    env["JAX_PLATFORMS"] = "cpu"
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DIVERGED" in proc.stdout, (
+        "LLMQ_MOE_TOKEN_PIN=off no longer reproduces the mixed-mesh "
+        "divergence — the pins are dead code or the detune knob rotted:\n"
+        + proc.stdout
+    )

@@ -180,6 +180,7 @@ def test_ladders_parse():
     assert "engine_fault_probe" in joined
     assert "integrity_probe" in joined
     assert "sim_probe" in joined
+    assert "shardcheck_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -384,6 +385,45 @@ def test_sim_probe_runs():
     assert "replay leg ok" in proc.stdout
     assert "regression leg ok" in proc.stdout
     assert "metric: sim_probe_ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_shardcheck_probe_runs():
+    """The sharding-analysis rung runs end to end on CPU: the AST sweep
+    is clean, the lowered-HLO gate's engine-step signatures on the probe
+    mesh match the committed baseline, and the MoE token-pin detune
+    fails the gate naming the program/mesh and nearest op."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/shardcheck_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:shardcheck_probe", proc)
+    assert "ast leg ok" in proc.stdout
+    assert "spmd-diff leg ok" in proc.stdout
+    assert "detune leg ok" in proc.stdout
+    assert "metric: shardcheck_probe_ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_spmd_gate_record_and_diff_legs(tmp_path):
+    """The gate's record/diff cycle works against a scratch baseline on
+    a subset mesh/program (CPU, 8 virtual devices): record writes the
+    signature file, an immediate diff against it is clean."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "LLMQ_SPMD_MESHES": "2x2x2",
+        "LLMQ_SPMD_PROGRAMS": "prefill1",
+        "LLMQ_SPMD_BASELINE": str(tmp_path / "baseline.json"),
+    }
+    rec = _run(env, ["python", "-m", "llmq_tpu.analysis.spmd", "--record"],
+               timeout=400)
+    _assert_ran("spmd:record", rec)
+    assert (tmp_path / "baseline.json").exists()
+    diff = _run(env, ["python", "-m", "llmq_tpu.analysis.spmd"], timeout=400)
+    _assert_ran("spmd:diff", diff)
+    assert "spmd: clean" in diff.stdout
 
 
 def test_bench_tiny_int4_runs():

@@ -351,8 +351,7 @@ class TestEngineRoundTrip:
         """State migration between differently-sharded engines: a
         snapshot taken on a single-device engine continues bit-identically
         on a tp=2 mesh (KV gathers to host on extract, scatters onto the
-        sharded pool on insert). MoE models stay pinned to sp=1 meshes —
-        see tests/test_moe_mixed_mesh.py."""
+        sharded pool on insert)."""
         baseline = run_to_completion(
             make_core(tp=2), [("m0", PROMPT, greedy(16))]
         )["m0"]
@@ -365,6 +364,58 @@ class TestEngineRoundTrip:
         dst = make_core(tp=2)
         dst.insert_request(wire)
         out = drain(dst, 1)["m0"]
+        assert out.token_ids == baseline.token_ids
+
+    @pytest.mark.slow
+    def test_cross_mesh_migration_moe_to_mixed_mesh(self):
+        """MoE state migrates onto an sp>=2 mixed mesh. This was gated
+        to sp=1 meshes while the MoE mixed-mesh divergence was pinned
+        (the destination engine would have continued with wrong logits);
+        with the grouped-matmul token-axis pins landed
+        (``models/transformer._moe_token_pins``, proven across the full
+        matrix in tests/test_moe_mixed_mesh.py) a snapshot taken on a
+        single-device MoE engine must continue bit-identically on the
+        dryrun's dp=2 x sp=2 x tp=2 mesh."""
+        moe_cfg = ModelConfig.tiny(
+            vocab_size=304,
+            model_type="qwen2_moe",
+            num_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=64,
+            shared_expert_intermediate_size=96,
+        )
+        moe_params = init_params(moe_cfg, jax.random.key(1), dtype=jnp.float32)
+
+        def moe_core(dp=1, sp=1, tp=1):
+            return EngineCore(
+                moe_cfg,
+                moe_params,
+                ByteTokenizer(),
+                mesh=make_mesh(
+                    data_parallel=dp,
+                    sequence_parallel=sp,
+                    tensor_parallel=tp,
+                ),
+                engine_config=EngineConfig(
+                    max_num_seqs=4,
+                    max_model_len=64,
+                    page_size=8,
+                    num_pages=40,
+                    kv_dtype=jnp.float32,
+                    min_prefill_bucket=16,
+                ),
+            )
+
+        baseline = run_to_completion(
+            moe_core(2, 2, 2), [("moe0", PROMPT, greedy(16))]
+        )["moe0"]
+        src = moe_core()
+        src.add_request("moe0", prompt=PROMPT, params=greedy(16))
+        step_until_tokens(src, "moe0", 5)
+        wire = snapshot_from_b64(snapshot_to_b64(src.extract_request("moe0")))
+        dst = moe_core(2, 2, 2)
+        dst.insert_request(wire)
+        out = drain(dst, 1)["moe0"]
         assert out.token_ids == baseline.token_ids
 
     def test_waiting_request_snapshot_reprefills(self):

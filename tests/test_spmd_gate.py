@@ -1,0 +1,224 @@
+"""Unit tests for the tier-B SPMD repartition diff gate (analysis/spmd).
+
+The parsing/attribution layer is pure string work, so it tests on
+synthetic HLO without touching jax. The end-to-end legs (lower a real
+engine jit, record, diff, detune) run the gate module in a subprocess
+on CPU with 8 virtual devices — the same rails ``llmq-tpu lint --spmd``
+and ``tools/shardcheck_probe.py`` use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from llmq_tpu.analysis import spmd
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# --- replica-group parsing ---------------------------------------------------
+
+
+@pytest.mark.unit
+def test_parse_brace_groups():
+    assert spmd._parse_brace_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
+    assert spmd._parse_brace_groups("{{0,2,4,6}}") == [[0, 2, 4, 6]]
+
+
+@pytest.mark.unit
+def test_expand_iota_groups_plain():
+    # [2,4]<=[8]: arange(8) chunked into 2 rows of 4.
+    assert spmd._expand_iota_groups(2, 4, [8], None) == [
+        [0, 1, 2, 3],
+        [4, 5, 6, 7],
+    ]
+
+
+@pytest.mark.unit
+def test_expand_iota_groups_transposed():
+    # [4,2]<=[2,4]T(1,0): arange(8).reshape(2,4).T.reshape(4,2) —
+    # pairs stride 4 apart (numpy-checked ground truth).
+    assert spmd._expand_iota_groups(4, 2, [2, 4], [1, 0]) == [
+        [0, 4],
+        [1, 5],
+        [2, 6],
+        [3, 7],
+    ]
+
+
+# --- axis attribution --------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_axes_label_single_axes():
+    shape = (2, 2, 2)  # device id = dp*4 + sp*2 + tp
+    assert spmd._axes_label([[0, 1]], shape) == "tp"
+    assert spmd._axes_label([[0, 2]], shape) == "sp"
+    assert spmd._axes_label([[0, 4]], shape) == "dp"
+
+
+@pytest.mark.unit
+def test_axes_label_multi_axis_and_self():
+    shape = (2, 2, 2)
+    assert spmd._axes_label([[0, 1, 2, 3]], shape) == "sp+tp"
+    assert spmd._axes_label([list(range(8))], shape) == "dp+sp+tp"
+    # Singleton groups move nothing.
+    assert spmd._axes_label([[0], [1]], shape) == "self"
+
+
+# --- HLO signature extraction ------------------------------------------------
+
+_SYNTHETIC_HLO = """\
+HloModule jit_step
+
+ENTRY main {
+  ar0 = f32[8]{0} all-reduce(x), replica_groups={{0,1},{2,3},{4,5},{6,7}}, \
+metadata={op_name="jit(step)/moe/ragged_dot" source_file="/repo/llmq_tpu/\
+models/transformer.py" source_line=283}
+  ar1 = f32[8]{0} all-reduce-done(ar0)
+  ag = f32[8]{0} all-gather(y), replica_groups=[2,4]<=[8], \
+metadata={op_name="jit(step)/attn/gather" source_file="/repo/llmq_tpu/\
+models/transformer.py" source_line=273}
+  cp = f32[8]{0} collective-permute(z), source_target_pairs={{0,2},{2,0}}
+  noop = f32[8]{0} all-reduce(w), replica_groups={{0},{1}}
+}
+"""
+
+
+@pytest.mark.unit
+def test_signature_from_hlo_counts_and_ops():
+    counts, ops = spmd.signature_from_hlo(_SYNTHETIC_HLO, (2, 2, 2))
+    # tp-pair all-reduce, sp+tp-quad all-gather, sp-hop permute; the
+    # -done line and the singleton-group reduce are both skipped.
+    assert counts == {
+        "all-reduce@tp": 1,
+        "all-gather@sp+tp": 1,
+        "collective-permute@sp": 1,
+    }
+    assert ops["all-reduce@tp"] == (
+        "jit(step)/moe/ragged_dot (transformer.py:283)"
+    )
+    assert ops["all-gather@sp+tp"] == (
+        "jit(step)/attn/gather (transformer.py:273)"
+    )
+
+
+# --- diffing -----------------------------------------------------------------
+
+
+def _cur(counts, ops=None):
+    return {"collectives": counts, "ops": ops or {}}
+
+
+@pytest.mark.unit
+def test_diff_clean():
+    cur = {"prefill1@2x2x2": _cur({"all-reduce@tp": 4})}
+    failures, notes = spmd.diff_signatures(
+        cur, {"prefill1@2x2x2": {"all-reduce@tp": 4}}
+    )
+    assert failures == [] and notes == []
+
+
+@pytest.mark.unit
+def test_diff_new_collective_fails_naming_op():
+    cur = {
+        "prefill1@2x2x2": _cur(
+            {"all-reduce@tp": 4, "all-reduce@dp+sp+tp": 3},
+            {"all-reduce@dp+sp+tp": "moe/ragged_dot (transformer.py:283)"},
+        )
+    }
+    failures, _ = spmd.diff_signatures(
+        cur, {"prefill1@2x2x2": {"all-reduce@tp": 4}}
+    )
+    assert len(failures) == 1
+    assert "all-reduce@dp+sp+tp" in failures[0]
+    assert "transformer.py:283" in failures[0]
+
+
+@pytest.mark.unit
+def test_diff_count_increase_fails_decrease_notes():
+    base = {"decode@2x2x2": {"all-reduce@sp": 2, "all-gather@tp": 2}}
+    up, _ = spmd.diff_signatures(
+        {"decode@2x2x2": _cur({"all-reduce@sp": 5, "all-gather@tp": 2})},
+        base,
+    )
+    assert len(up) == 1 and "x5, baseline x2" in up[0]
+    down_failures, down_notes = spmd.diff_signatures(
+        {"decode@2x2x2": _cur({"all-reduce@sp": 1, "all-gather@tp": 2})},
+        base,
+    )
+    assert down_failures == []
+    assert len(down_notes) == 1 and "improvement" in down_notes[0]
+
+
+@pytest.mark.unit
+def test_diff_missing_baseline_key_fails():
+    failures, _ = spmd.diff_signatures(
+        {"mixed@4x2x1": _cur({"all-reduce@dp": 1})}, {}
+    )
+    assert len(failures) == 1 and "no recorded baseline" in failures[0]
+
+
+# --- committed baseline sanity ----------------------------------------------
+
+
+@pytest.mark.unit
+def test_committed_baseline_covers_matrix():
+    payload = json.loads(spmd.BASELINE_PATH.read_text())
+    keys = set(payload["signatures"])
+    for shape in spmd.MESH_MATRIX:
+        for program in spmd.PROGRAMS:
+            assert spmd.program_key(program, shape) in keys
+    # Degenerate meshes legitimately record empty signatures (prefill
+    # on pure-DP replicates everything), but the load-bearing program —
+    # the single-row bucket on the full mixed mesh — must carry
+    # collectives, and sp>=2 meshes must show the ring permutes.
+    sig = payload["signatures"]
+    assert sig["prefill1@2x2x2"], "prefill1@2x2x2 recorded no collectives"
+    assert any(k.startswith("collective-permute") for k in sig["prefill1@2x2x2"])
+    assert sig["decode@2x2x2"] and sig["mixed@2x2x2"] and sig["verify@2x2x2"]
+
+
+# --- end-to-end subprocess legs ---------------------------------------------
+
+
+def _gate(extra_env, *args, timeout=400):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["LLMQ_SPMD_MESHES"] = "2x2x2"
+    env["LLMQ_SPMD_PROGRAMS"] = "prefill1"
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-m", "llmq_tpu.analysis.spmd", *args],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.integration
+def test_gate_subprocess_diff_clean_against_committed_baseline():
+    proc = _gate({})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "spmd: clean" in proc.stdout
+
+
+@pytest.mark.integration
+def test_gate_subprocess_detune_has_teeth():
+    """LLMQ_MOE_TOKEN_PIN=off re-introduces the unconstrained token-axis
+    repartition; the gate must fail and name program, mesh, and the
+    nearest transformer op."""
+    proc = _gate({"LLMQ_MOE_TOKEN_PIN": "off"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "spmd: FAIL" in proc.stdout
+    assert "prefill1@2x2x2" in proc.stdout
+    assert "transformer.py" in proc.stdout

@@ -57,6 +57,10 @@ run 900 integrity_probe python tools/integrity_probe.py
 #     policy-regression baseline with detune teeth (virtual clock,
 #     host-side only; cheap, stays ahead of the long benches).
 run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
+# 1i. Sharding-analysis plane: AST sweep + SPMD collective-signature
+#     diff + detune teeth (CPU subprocesses; cheap, guards the mesh
+#     matrix the benches below depend on).
+run 900 shardcheck_probe env JAX_PLATFORMS=cpu python tools/shardcheck_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

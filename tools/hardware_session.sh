@@ -72,6 +72,10 @@ run 900 integrity_probe python tools/integrity_probe.py
 # the policy planes the probes above exercise pinned to their recorded
 # baselines on this image).
 run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
+# Sharding-analysis plane: AST sweep + lowered-HLO collective-signature
+# diff vs the committed baseline + MoE token-pin detune teeth (runs its
+# jax legs in CPU subprocesses; never touches the accelerator).
+run 900 shardcheck_probe env JAX_PLATFORMS=cpu python tools/shardcheck_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \

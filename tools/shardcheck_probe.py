@@ -1,0 +1,117 @@
+"""End-to-end probe of the sharding-analysis plane (shardcheck).
+
+Three legs, each printing a ``probe: <leg> ok`` line:
+
+1. **ast** — the tier-A AST sweep over the real tree: both sharding
+   rules (``sharding-axis``, ``unconstrained-repartition``) are
+   registered and the production packages are clean.
+2. **spmd-diff** — the tier-B lowered-HLO gate on a subset mesh diffs
+   the engine step programs' collective signatures against the
+   committed baseline and passes (fresh interpreter, CPU with 8
+   virtual devices — the same rails CI uses).
+3. **detune** — ``LLMQ_MOE_TOKEN_PIN=off`` re-introduces the MoE
+   mixed-mesh repartition and the gate FAILS, naming the program/mesh
+   and the nearest op (the gate has teeth, not just numbers that
+   matched once).
+
+Runs identically on CPU (preflight) and on a device host
+(hardware_session / chip_watch rungs): every jax-touching leg forces
+``JAX_PLATFORMS=cpu`` in its own subprocess, so the probe never
+competes for the accelerator.
+
+    python tools/shardcheck_probe.py
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+#: One divergent mesh keeps the probe's wall clock bounded; the full
+#: matrix runs under `llmq-tpu lint --spmd` and in tests/test_spmd_gate.
+PROBE_MESH = "2x2x2"
+
+
+def _gate_cmd():
+    return [sys.executable, "-m", "llmq_tpu.analysis.spmd"]
+
+
+def _gate_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env["LLMQ_SPMD_MESHES"] = PROBE_MESH
+    env.update(extra)
+    return env
+
+
+def run_ast_leg():
+    from llmq_tpu.analysis import analyze_paths
+    from llmq_tpu.analysis.checkers import RULES
+
+    for rule in ("sharding-axis", "unconstrained-repartition"):
+        assert rule in RULES, f"{rule} missing from the rule registry"
+    violations = analyze_paths(["llmq_tpu", "tools"])
+    errors = [v for v in violations if v.severity == "error"]
+    assert not errors, "AST sweep found errors:\n" + "\n".join(
+        v.render() for v in errors
+    )
+    print(
+        f"probe: ast leg ok — {len(RULES)} rules over llmq_tpu/ + tools/, "
+        f"0 errors ({len(violations)} warning(s))"
+    )
+
+
+def run_spmd_diff_leg():
+    proc = subprocess.run(
+        _gate_cmd(),
+        env=_gate_env(),
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, (
+        f"spmd gate failed on {PROBE_MESH}:\n{proc.stdout}{proc.stderr}"
+    )
+    assert "spmd: clean" in proc.stdout, proc.stdout
+    print(
+        f"probe: spmd-diff leg ok — engine step signatures on "
+        f"{PROBE_MESH} match the committed baseline"
+    )
+
+
+def run_detune_leg():
+    proc = subprocess.run(
+        _gate_cmd(),
+        env=_gate_env(LLMQ_MOE_TOKEN_PIN="off"),
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode != 0, (
+        "detune went undetected — LLMQ_MOE_TOKEN_PIN=off must fail the "
+        f"gate (no teeth):\n{proc.stdout}"
+    )
+    out = proc.stdout
+    assert f"prefill1@{PROBE_MESH}" in out, out
+    assert "transformer.py" in out, out
+    print(
+        "probe: detune leg ok — un-pinned MoE token axis fails the gate "
+        f"naming prefill1@{PROBE_MESH} and the transformer op"
+    )
+
+
+def main():
+    run_ast_leg()
+    run_spmd_diff_leg()
+    run_detune_leg()
+    print("metric: shardcheck_probe_ok legs=3")
+
+
+if __name__ == "__main__":
+    main()
