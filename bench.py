@@ -516,6 +516,7 @@ def trim_plan(
     int4_s: float = 0.0,
     mixed_s: float = 0.0,
     prefix_s: float = 0.0,
+    disagg_s: float = 0.0,
 ) -> dict:
     """Budget-aware phase trimming (pure — unit-tested in
     tests/test_bench.py). Given the seconds left on LLMQ_BENCH_DEADLINE
@@ -534,12 +535,18 @@ def trim_plan(
       point (``tp_overlap_s`` one extra build+measure; a no-op rung on
       single-device meshes),
     - ``prefix_rung``: the templated-traffic prefix-cache rung at the
-      winning point (``prefix_s`` one extra build + a cold/warm pair).
+      winning point (``prefix_s`` one extra build + a cold/warm pair),
+    - ``disagg_rung``: the in-process two-pool prefill/decode A/B at the
+      winning point (``disagg_s``: two extra builds + a unified
+      reference pass + the pipelined handoff pass).
 
     The proven bf16 headline (``proven_s``) is the floor and is never
     dropped — a bench that measures *something* always beats a watchdog
-    0.0. Drop order is by speculation: the prefix rung first (purely
-    diagnostic — it reports a hit rate and never replaces the headline
+    0.0. Drop order is by speculation: the disagg rung first (purely
+    diagnostic like the prefix rung, and the most builds per datapoint —
+    it reports handoff latency and pool-split deltas, never the headline
+    number), then the prefix rung (it reports a hit
+    rate and never replaces the headline
     number, so shedding it loses telemetry, not the measurement), then
     the int4 attempt (deepest
     quantization, narrowest numerics margin — the rung most likely to
@@ -555,6 +562,7 @@ def trim_plan(
     """
     # (name, cost) in DROP order: most speculative first.
     phases = (
+        ("disagg_rung", disagg_s),
         ("prefix_rung", prefix_s),
         ("int4_ladder", int4_s),
         ("tp_overlap", tp_overlap_s),
@@ -747,6 +755,9 @@ def main() -> None:
         # The templated-traffic prefix rung is one extra build + a
         # short cold/warm pair at the winning point.
         prefix_s=240.0,
+        # The disaggregated two-pool rung is three extra builds (unified
+        # reference + prefill pool + decode pool) at the winning point.
+        disagg_s=420.0,
         proven_s=300.0,
     )
     if not all(plan.values()):
@@ -1417,6 +1428,178 @@ def main() -> None:
 
         gc.collect()
 
+    # Disaggregated two-pool rung at the winning (slots, K, spec) point:
+    # split the winning slot budget across a prefill-role engine and a
+    # decode-role engine, run templated traffic through the real phase
+    # boundary (prefill_only request -> snapshot codec round-trip ->
+    # insert_request adoption on the decode engine), and A/B against a
+    # unified engine serving the identical prompts. Diagnostic like the
+    # prefix rung: its product is the handoff cost (codec + insert) and
+    # the TTFT/ITL deltas of pool separation, never the headline number —
+    # an in-process A/B can't model the network hop between real pools,
+    # so the deltas here are the *floor* of disaggregation's cost.
+    disagg_metrics: dict = {}
+    if plan["disagg_rung"] and os.environ.get(
+        "LLMQ_BENCH_TRY_DISAGG", "1"
+    ).lower() not in ("0", "false"):
+        try:
+            import gc
+
+            from llmq_tpu.engine.snapshot import (
+                snapshot_from_b64,
+                snapshot_to_b64,
+            )
+
+            def _p50(vals):
+                ordered = sorted(vals)
+                return ordered[len(ordered) // 2] if ordered else None
+
+            tmpl_len = max(
+                page_size, (prompt_len * 3 // 4) // page_size * page_size
+            )
+            d_template = rng.integers(
+                1, config.vocab_size, size=tmpl_len
+            ).tolist()
+            pool_seqs = max(2, max_seqs // 2)
+            n_disagg = min(n_requests, max(2 * pool_seqs, 8))
+            d_prompts = [
+                d_template
+                + rng.integers(
+                    1, config.vocab_size, size=prompt_len - tmpl_len
+                ).tolist()
+                for _ in range(n_disagg)
+            ]
+
+            # Unified reference on the SAME prompts at the same pool
+            # size, so the A/B isolates the phase split (not slot count
+            # or traffic shape).
+            core = build_core(pool_seqs, best_block, best_spec,
+                              mixed=mixed_resolved)
+            core.add_request("dsu-warm", prompt_ids=d_prompts[0], params=sp())
+            while core.has_work:
+                core.step()
+            u_gen0 = core.total_generated_tokens
+            u_start = time.monotonic()
+            for i, ids in enumerate(d_prompts):
+                core.add_request(f"dsu-{i}", prompt_ids=ids, params=sp())
+            u_done = 0
+            while core.has_work:
+                u_done += len(core.step())
+            u_elapsed = time.monotonic() - u_start
+            assert u_done == n_disagg, f"{u_done}/{n_disagg} unified"
+            u_out = core.total_generated_tokens - u_gen0
+            u_stats = core.stats()
+            u_tok_s = u_out / u_elapsed
+            core = None
+            gc.collect()
+
+            pre = build_core(pool_seqs, best_block, 0)
+            dec = build_core(pool_seqs, best_block, best_spec)
+
+            def _handoff(out, stamps):
+                """Snapshot codec round-trip + adoption insert — the
+                in-process equivalent of the ship/snapshot paths."""
+                t0 = time.monotonic()
+                snap = snapshot_from_b64(snapshot_to_b64(out.snapshot))
+                dec.insert_request(snap)
+                stamps.append((time.monotonic() - t0) * 1000.0)
+
+            # Warm both pools through the full boundary (compiles the
+            # prefill-only path, the codec, and the adoption insert).
+            pre.add_request(
+                "dsw", prompt_ids=d_prompts[0], params=sp(),
+                prefill_only=True,
+            )
+            warm_ms: list = []
+            while pre.has_work or dec.has_work:
+                for out in pre.step() if pre.has_work else ():
+                    if out.snapshot is not None:
+                        _handoff(out, warm_ms)
+                if dec.has_work:
+                    dec.step()
+
+            handoff_ms: list = []
+            adopt_wall: dict = {}
+            d_gen0 = dec.total_generated_tokens
+            d_start = time.monotonic()
+            for i, ids in enumerate(d_prompts):
+                pre.add_request(
+                    f"dsd-{i}", prompt_ids=ids, params=sp(),
+                    prefill_only=True,
+                )
+            d_done = 0
+            while pre.has_work or dec.has_work:
+                for out in pre.step() if pre.has_work else ():
+                    if out.finish_reason == "prefill_done" and (
+                        out.snapshot is not None
+                    ):
+                        _handoff(out, handoff_ms)
+                        adopt_wall[out.rid] = time.monotonic() - d_start
+                if dec.has_work:
+                    d_done += len(dec.step())
+            d_elapsed = time.monotonic() - d_start
+            assert d_done == n_disagg, f"{d_done}/{n_disagg} adopted"
+            d_out = dec.total_generated_tokens - d_gen0
+            d_stats = dec.stats()
+            d_tok_s = d_out / d_elapsed
+            # Submit-to-first-token for an adopted request spans both
+            # pools: prefill span (all requests submitted at d_start) +
+            # the decode engine's insert->first-token TTFT.
+            pre_span_p50 = _p50(list(adopt_wall.values()))
+            disagg_metrics = {
+                "disagg_tok_s_chip": round(d_tok_s / len(devices), 2),
+                "disagg_vs_unified": round(d_tok_s / u_tok_s, 4),
+            }
+            p50 = _p50(handoff_ms)
+            if p50 is not None:
+                disagg_metrics["handoff_ms_p50"] = round(p50, 3)
+                disagg_metrics["handoff_ms_p95"] = round(
+                    sorted(handoff_ms)[
+                        min(len(handoff_ms) - 1,
+                            int(0.95 * len(handoff_ms)))
+                    ],
+                    3,
+                )
+            if (
+                pre_span_p50 is not None
+                and d_stats.get("ttft_p50_ms") is not None
+                and u_stats.get("ttft_p50_ms") is not None
+            ):
+                disagg_metrics["disagg_ttft_p50_delta_ms"] = round(
+                    pre_span_p50 * 1000.0
+                    + d_stats["ttft_p50_ms"]
+                    - u_stats["ttft_p50_ms"],
+                    3,
+                )
+            if (
+                d_stats.get("itl_p50_ms") is not None
+                and u_stats.get("itl_p50_ms") is not None
+            ):
+                disagg_metrics["disagg_itl_p50_delta_ms"] = round(
+                    d_stats["itl_p50_ms"] - u_stats["itl_p50_ms"], 3
+                )
+            print(
+                f"bench: disagg rung ({n_disagg} templated reqs, "
+                f"{pool_seqs}+{pool_seqs} slots) -> "
+                f"{d_tok_s:.1f} tok/s vs {u_tok_s:.1f} unified, "
+                f"handoff p50 "
+                f"{disagg_metrics.get('handoff_ms_p50', 0.0)} ms",
+                file=sys.stderr,
+            )
+            pre = dec = None
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                "bench: disagg rung exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
     tok_s_chip = tok_s / len(devices)
     # MoE presets: throughput scales with ACTIVE params per token (the
     # FLOPs actually spent), not the total parameter count.
@@ -1467,6 +1650,10 @@ def main() -> None:
         # hit rate, computed-prefill fraction, and the best-case warm
         # throughput — diagnostics, never the headline.
         **prefix_metrics,
+        # Disaggregated two-pool rung (absent when trimmed/opted out):
+        # pool-split throughput, handoff codec+insert latency, and the
+        # TTFT/ITL deltas vs the unified reference — diagnostics too.
+        **disagg_metrics,
         **(
             {"kv_dtype": kv_env}
             if kv_env not in ("", "auto")

@@ -49,6 +49,7 @@ RESULTS_SUFFIX = ".results"
 FAILED_SUFFIX = ".failed"
 HEALTH_SUFFIX = ".health"
 QUARANTINE_SUFFIX = ".quarantine"
+DECODE_SUFFIX = ".decode"
 
 # Heartbeat cadence (workers publish WorkerHealth this often) and the
 # fleet-wide staleness threshold derived from it: a worker that missed two
@@ -100,8 +101,25 @@ def affinity_queue_name(queue: str, worker_id: str) -> str:
 
 
 def kv_fetch_queue_name(queue: str, worker_id: str) -> str:
-    """Per-worker queue for cross-worker prefix-page fetch requests."""
+    """Per-worker queue for cross-worker prefix-page fetch requests
+    (and, in a disaggregated fleet, KV adoption offers at the
+    prefill→decode phase boundary)."""
     return f"{queue}.kv.{worker_id}"
+
+
+def decode_queue_name(queue: str) -> str:
+    """Shared decode-pool queue: prefill-role workers republish a
+    prefill-complete job here (snapshot riding under ``RESUME_FIELD``)
+    when no decode peer accepts the adoption offer in time."""
+    return queue + DECODE_SUFFIX
+
+
+def decode_adopt_queue_name(queue: str, worker_id: str) -> str:
+    """Per-decode-worker adoption queue. A decode worker durably parks an
+    accepted KV handoff here BEFORE replying "accepted" — so the payload
+    survives either side dying mid-handshake (the janitor reclaims an
+    orphaned adoption queue back onto ``<q>.decode``)."""
+    return f"{queue}.d.{worker_id}"
 
 
 # rendezvous_pick moved to llmq_tpu.utils.hashing (re-exported above for
@@ -136,6 +154,11 @@ class BrokerManager:
         # serves; each queue's value is REPLACED wholesale on refresh.
         self._affinity_map: Dict[str, Dict[str, List[str]]] = {}  # llmq: ignore[unbounded-host-buffer]
         self._affinity_at: Dict[str, float] = {}  # llmq: ignore[unbounded-host-buffer]
+        # Decode-pool discovery: per-queue {worker_id: prefix_chains} of
+        # fresh decode-role heartbeats, cached on the same refresh cadence
+        # as the affinity map (same wholesale-replace bounding).
+        self._decode_map: Dict[str, Dict[str, List[str]]] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._decode_at: Dict[str, float] = {}  # llmq: ignore[unbounded-host-buffer]
         # Per-queue {worker_id: last_seen epoch seconds} — retained past the
         # cache refresh so routing re-checks freshness per job, and past
         # health-TTL expiry so the janitor still knows which private queues
@@ -219,7 +242,16 @@ class BrokerManager:
         await self.broker.declare_queue(queue + FAILED_SUFFIX)
         if self.config.quarantine_attempts > 0:
             await self.broker.declare_queue(queue + QUARANTINE_SUFFIX)
-        if self.config.prefix_affinity:
+        if self.config.worker_role != "unified":
+            # Disaggregated fleets need the decode-pool queue up front so
+            # depth stats (and the auto-role controller reading them) work
+            # before the first snapshot fallback lands on it.
+            await self.broker.declare_queue(
+                decode_queue_name(queue),
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
+        if self.config.prefix_affinity or self.config.worker_role != "unified":
             self.start_affinity_janitor(queue)
 
     async def setup_pipeline_infrastructure(self, pipeline: PipelineConfig) -> None:
@@ -286,6 +318,32 @@ class BrokerManager:
                 mapping.setdefault(digest, []).append(wid)
         self._affinity_map[queue] = mapping
         self._affinity_at[queue] = now
+        return mapping
+
+    async def decode_targets(self, queue: str) -> Dict[str, List[str]]:
+        """``{worker_id: prefix_chains}`` of fresh decode-role workers on
+        ``queue`` — the candidate pool for KV adoption offers. Cached for
+        ``AFFINITY_REFRESH_S`` like the affinity map; a worker that
+        switched away from decode drops out on the next refresh (and the
+        offer handshake tolerates a stale pick — the peer replies busy)."""
+        now = clock.monotonic()
+        if now - self._decode_at.get(queue, float("-inf")) < AFFINITY_REFRESH_S:
+            return self._decode_map.get(queue, {})
+        mapping: Dict[str, List[str]] = {}
+        try:
+            beats = await self.get_worker_health(queue)
+        except Exception:  # noqa: BLE001 — health queue missing/unreadable
+            beats = {}
+        wall = utcnow()
+        self._record_worker_seen(queue, beats)
+        for wid, health in beats.items():
+            if health.role != "decode":
+                continue
+            if (wall - health.last_seen).total_seconds() > AFFINITY_FRESH_S:
+                continue
+            mapping[wid] = list(health.prefix_chains or [])
+        self._decode_map[queue] = mapping
+        self._decode_at[queue] = now
         return mapping
 
     def _record_worker_seen(
@@ -398,8 +456,28 @@ class BrokerManager:
                 emit_trace_event(
                     str(msg.message_id), "affinity_reclaimed", worker=wid
                 )
+            # A dead decode worker's parked adoptions go back to the shared
+            # decode pool — any surviving decode worker resumes them from
+            # the snapshot riding in the payload.
+            dq = decode_adopt_queue_name(queue, wid)
+            while True:
+                msg = await self.broker.get(dq)
+                if msg is None:
+                    break
+                await self.broker.publish(
+                    decode_queue_name(queue),
+                    msg.body,
+                    message_id=msg.message_id,
+                    headers=msg.headers,
+                )
+                await msg.ack()
+                reclaimed += 1
+                emit_trace_event(
+                    str(msg.message_id), "affinity_reclaimed", worker=wid
+                )
             await self.broker.delete_queue(aq)
             await self.broker.delete_queue(kv_fetch_queue_name(queue, wid))
+            await self.broker.delete_queue(dq)
             seen.pop(wid, None)
             logger.info(
                 "Reclaimed affinity queue %s (%d stranded messages%s)",

@@ -350,11 +350,21 @@ def worker() -> None:
                    "bandwidth-bound decode step's idle MXU does the "
                    "prefill; greedy outputs unchanged). Requires "
                    "--prefill-chunk. Default: LLMQ_MIXED_STEP or off")
+@click.option("--role", default=None,
+              type=click.Choice(["unified", "prefill", "decode", "auto"]),
+              help="Disaggregated serving role: 'prefill' consumes the "
+                   "shared queue, runs the prompt phase only, and hands "
+                   "KV off to the decode pool; 'decode' consumes "
+                   "<queue>.decode and adopts handed-off requests; "
+                   "'auto' switches between the two on fleet queue "
+                   "depths (hysteresis via LLMQ_ROLE_DWELL_S and the "
+                   "LLMQ_ROLE_SWITCH_LO/HI bands). Default: "
+                   "LLMQ_WORKER_ROLE or unified (monolith)")
 def worker_run(model, queue, tensor_parallel, data_parallel,
                sequence_parallel, concurrency, max_num_seqs, max_model_len,
                dtype, kv_dtype, prefill_chunk, prefix_caching,
                prefix_host_gb, decode_block, spec_tokens, tp_overlap,
-               mixed_step):
+               mixed_step, role):
     """Run a TPU inference worker serving MODEL on QUEUE."""
     from llmq_tpu.cli.worker import run_tpu_worker
 
@@ -375,6 +385,7 @@ def worker_run(model, queue, tensor_parallel, data_parallel,
         spec_tokens=spec_tokens,
         tp_overlap=tp_overlap,
         mixed_step=mixed_step,
+        role=role,
     )
 
 

@@ -21,6 +21,7 @@ from llmq_tpu.broker.manager import (
     FAILED_SUFFIX,
     QUARANTINE_SUFFIX,
     BrokerManager,
+    decode_queue_name,
     results_queue_name,
 )
 from llmq_tpu.core.config import get_config
@@ -350,12 +351,70 @@ def _integrity_cell(health: WorkerHealth, es: dict) -> str:
     return " ".join(parts) if parts else "-"
 
 
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def _role_summary(
+    fresh: Dict[str, WorkerHealth],
+    decode_depth: Optional[int],
+) -> str:
+    """Per-role fleet line for a disaggregated fleet. Superset-only: a
+    unified fleet (no heartbeat carries ``role``) renders "" and the
+    dashboard stays byte-identical to the pre-disaggregation one.
+
+    Handoff percentiles are each worker's own ring percentile, aggregated
+    as the fleet median — a cheap, rank-preserving summary (heartbeats
+    don't ship raw latency samples, so an exact fleet percentile isn't
+    computable from this vantage point)."""
+    roles = [h.role for h in fresh.values() if h.role]
+    if not roles and decode_depth is None:
+        return ""
+    counts: Dict[str, int] = {}
+    for role in roles:
+        counts[role] = counts.get(role, 0) + 1
+    # auto workers report their ACTIVE role (prefill/decode) in the role
+    # field; role_mode=auto in engine_stats marks them as switchable.
+    autos = sum(
+        1
+        for h in fresh.values()
+        if (h.engine_stats or {}).get("role_mode") == "auto"
+    )
+    parts = [
+        f"roles p:{counts.get('prefill', 0)}"
+        f" d:{counts.get('decode', 0)}"
+        + (f" (auto:{autos})" if autos else "")
+    ]
+    if decode_depth is not None:
+        parts.append(f"decode ready {decode_depth}")
+    p50s = [
+        (h.engine_stats or {}).get("handoff_ms_p50")
+        for h in fresh.values()
+    ]
+    p95s = [
+        (h.engine_stats or {}).get("handoff_ms_p95")
+        for h in fresh.values()
+    ]
+    p50s = [v for v in p50s if v is not None]
+    p95s = [v for v in p95s if v is not None]
+    if p50s:
+        parts.append(
+            f"handoff p50/p95 {_median(p50s):.0f}/{_median(p95s):.0f} ms"
+        )
+    return " | ".join(parts)
+
+
 def _render_top(
     queue: str,
     beats: Dict[str, WorkerHealth],
     stats: QueueStats,
     quarantine_depth: Optional[int] = None,
     top: int = 40,
+    decode_depth: Optional[int] = None,
 ):
     """One refresh frame: fleet summary line + per-worker table, built
     from the freshest heartbeat per worker. At fleet scale (thousands of
@@ -396,6 +455,9 @@ def _render_top(
         header += f" | [red]suspect {suspects}[/red]"
     if quarantine_depth:
         header += f" | [red]quarantined {quarantine_depth}[/red]"
+    role_line = _role_summary(fresh, decode_depth)
+    if role_line:
+        header += "\n" + role_line
     # The self-heal column is itself superset-only: it renders only when
     # some worker reports degradation, so a healthy fleet's dashboard is
     # byte-identical to the pre-self-healing one (and the table keeps its
@@ -427,6 +489,14 @@ def _render_top(
         cols.insert(8, "integrity")
     if show_selfheal:
         cols.insert(8, "self-heal")
+    # Role column, superset-only: appears once any worker heartbeats a
+    # role (disaggregated fleet); a unified fleet's table keeps its exact
+    # pre-disaggregation shape. Inserted LAST so its index (2, after
+    # status) is unaffected by the tail-position inserts above — the
+    # cells below mirror the same insert order.
+    show_role = any(h.role for h in beats.values())
+    if show_role:
+        cols.insert(2, "role")
     for col in cols:
         table.add_column(col)
 
@@ -467,6 +537,8 @@ def _render_top(
             cells.insert(8, _integrity_cell(health, es))
         if show_selfheal:
             cells.insert(8, _selfheal_cell(es))
+        if show_role:
+            cells.insert(2, health.role or "-")
         table.add_row(*cells)
     return Group(header, table)
 
@@ -499,10 +571,20 @@ async def monitor_top(
                     if qstats.stats_source != "unavailable"
                     else None
                 )
+                # Decode-pool depth: the queue only exists on a
+                # disaggregated fleet, so a missing queue reads as
+                # "unified" and keeps the summary line superset-only.
+                dstats = await mgr.get_queue_stats(decode_queue_name(queue))
+                ddepth = (
+                    dstats.message_count_ready
+                    if dstats.stats_source != "unavailable"
+                    else None
+                )
                 live.update(
                     _render_top(
                         queue, beats, stats,
                         quarantine_depth=qdepth, top=top,
+                        decode_depth=ddepth,
                     ),
                     refresh=True,
                 )

@@ -31,15 +31,27 @@ def run_tpu_worker(
     spec_tokens: Optional[int] = None,
     tp_overlap: Optional[str] = None,
     mixed_step: Optional[str] = None,
+    role: Optional[str] = None,
 ) -> None:
     """Launch the TPU inference worker (reference run_vllm_worker)."""
     setup_logging(structured=True)
+    if role is not None:
+        # Role rides Config (LLMQ_WORKER_ROLE) so the broker manager and
+        # worker base read one consistent value; the flag just pins the
+        # env before the worker builds its config.
+        import os
+
+        os.environ["LLMQ_WORKER_ROLE"] = role
     try:
         from llmq_tpu.workers.tpu_worker import TPUWorker
     except ImportError as exc:
         click.echo(f"TPU worker unavailable: {exc}", err=True)
         sys.exit(1)
-    click.echo(f"Starting TPU worker: model={model} queue={queue}", err=True)
+    click.echo(
+        f"Starting TPU worker: model={model} queue={queue}"
+        + (f" role={role}" if role else ""),
+        err=True,
+    )
     worker = TPUWorker(
         queue,
         model=model,

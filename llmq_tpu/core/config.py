@@ -197,6 +197,57 @@ class Config(BaseModel):
         "dispatches. Requires prefill_chunk_size.",
     )
 
+    # --- disaggregated prefill/decode serving -----------------------------
+    worker_role: str = Field(
+        default_factory=lambda: (_env("LLMQ_WORKER_ROLE") or "unified").lower(),
+        description="Disaggregated serving role. 'unified' (default) runs "
+        "prefill and decode on one worker, exactly the pre-disaggregation "
+        "behavior. 'prefill' consumes the shared job queue, runs prefill "
+        "only, and hands the request off at the phase boundary (KV ship "
+        "to a decode peer, snapshot republish to <q>.decode as fallback). "
+        "'decode' consumes <q>.decode plus its private adoption queue "
+        "<q>.d.<worker_id> and runs the decode hot path on adopted "
+        "requests. 'auto' starts as prefill and switches roles on fleet "
+        "queue-depth skew with hysteresis (role_dwell_s / role_switch_*).",
+    )
+
+    role_dwell_s: float = Field(
+        default_factory=lambda: _env_float("LLMQ_ROLE_DWELL_S", default=60.0),
+        description="Auto-role hysteresis: minimum seconds a worker stays "
+        "in its current role before the depth-ratio controller may switch "
+        "it again. Prevents role flapping when the prefill:decode demand "
+        "mix sits near a switch band.",
+    )
+
+    role_switch_hi: float = Field(
+        default_factory=lambda: _env_float("LLMQ_ROLE_SWITCH_HI", default=2.0),
+        description="Auto-role band: a decode-role worker switches to "
+        "prefill when (shared depth + 1) / (decode depth + 1) exceeds "
+        "this ratio (prefill demand dominates).",
+    )
+
+    role_switch_lo: float = Field(
+        default_factory=lambda: _env_float("LLMQ_ROLE_SWITCH_LO", default=0.5),
+        description="Auto-role band: a prefill-role worker switches to "
+        "decode when (shared depth + 1) / (decode depth + 1) falls below "
+        "this ratio (decode backlog dominates).",
+    )
+
+    role_check_interval_s: float = Field(
+        default_factory=lambda: _env_float(
+            "LLMQ_ROLE_CHECK_INTERVAL_S", default=5.0
+        ),
+        description="Auto-role controller cadence: seconds between fleet "
+        "queue-depth polls (two stats() reads per poll).",
+    )
+
+    handoff_timeout_s: float = Field(
+        default_factory=lambda: _env_float("LLMQ_HANDOFF_TIMEOUT_S", default=2.0),
+        description="Seconds a prefill-role worker waits for a decode "
+        "peer to accept a KV adoption offer before falling back to the "
+        "snapshot republish on <q>.decode.",
+    )
+
     result_digest: bool = Field(
         default_factory=lambda: (_env("LLMQ_RESULT_DIGEST") or "").lower()
         in ("1", "true", "yes", "on"),

@@ -228,6 +228,16 @@ class WorkerHealth(BaseModel):
         "of a worker that keeps failing canaries). None when every "
         "integrity knob is off (the default).",
     )
+    role: Optional[str] = Field(
+        None,
+        description="Disaggregated-serving role this worker is currently "
+        "serving: 'prefill' (consumes the shared queue, hands KV off at "
+        "the phase boundary) or 'decode' (consumes <q>.decode and adopts "
+        "shipped requests). An 'auto' worker advertises whichever role it "
+        "is in right now (engine_stats.role_mode says 'auto'). None for "
+        "unified workers (the default) — the field is omitted entirely, "
+        "so pre-disaggregation heartbeat payloads are byte-identical.",
+    )
 
 
 class ErrorInfo(BaseModel):

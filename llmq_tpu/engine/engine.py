@@ -144,6 +144,10 @@ class RequestOutput:
     # filled when the engine recorded them; workers project these onto
     # the request trace. None for sequences that predate instrumentation.
     timing: Optional[Dict[str, float]] = None
+    # Prefill-only requests (finish_reason="prefill_done") carry the
+    # prompt-KV snapshot here for the decode-pool handoff; None always
+    # for normal completions.
+    snapshot: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -773,6 +777,12 @@ class EngineCore:
         self.kv_restores = 0  # admissions restored from host KV pages
         self.snapshots_extracted = 0
         self.snapshots_inserted = 0
+        self.prefill_done = 0  # prefill-only requests finished at the boundary
+        # rid → RequestSnapshot taken at the prefill boundary, popped by
+        # _output_for when the finished RequestOutput is built. Transient:
+        # entries live only between _append_and_check and the drain of the
+        # same step's finished list.
+        self._prefill_snapshots: Dict[str, RequestSnapshot] = {}
         self.prefill_tokens = 0  # prompt positions actually computed
         self.prefix_demotes = 0  # pages parked in the host tier on evict
         self.prefix_promotes = 0  # pages restored from the host tier
@@ -1690,6 +1700,7 @@ class EngineCore:
         prompt_ids: Optional[List[int]] = None,
         params: Optional[SamplingParams] = None,
         deadline_at: Optional[float] = None,
+        prefill_only: bool = False,
     ) -> Sequence:
         if prompt_ids is None:
             if messages is not None:
@@ -1714,6 +1725,7 @@ class EngineCore:
             prompt_ids=list(prompt_ids),
             params=params,
             deadline_at=deadline_at,
+            prefill_only=prefill_only,
         )
         if deadline_at is not None:
             self._deadlines_enabled = True
@@ -2993,6 +3005,21 @@ class EngineCore:
     def _append_and_check(
         self, seq: Sequence, token: int, finished: List[RequestOutput]
     ) -> None:
+        if seq.prefill_only and not seq.output_ids:
+            # Disaggregated prefill boundary: the prompt KV is complete and
+            # the device just sampled the first token. Discard the token
+            # (the adopting decode worker re-derives the key chain and
+            # re-samples it bit-identically), snapshot the prompt KV while
+            # the pages are still held, and finish. The snapshot's
+            # kv_valid = len(prompt)-1 matches insert_request's contract
+            # for an empty-output snapshot, so the decode side recomputes
+            # only the last prompt position.
+            self._prefill_snapshots[seq.rid] = self._snapshot_seq(seq)
+            self.prefill_done += 1
+            self._finish_seq(
+                seq, "prefill_done", device_detected=False, finished=finished
+            )
+            return
         seq.output_ids.append(token)
         self.total_generated_tokens += 1
         now = time.monotonic()
@@ -3162,6 +3189,7 @@ class EngineCore:
             completion_tokens=len(seq.output_ids),
             finish_reason=seq.finish_reason or "stop",
             timing=timing,
+            snapshot=self._prefill_snapshots.pop(seq.rid, None),
         )
 
     # --- snapshot plane ---------------------------------------------------
@@ -3858,6 +3886,10 @@ class EngineCore:
             )[0]
         if self.prefix_store is not None:
             s.update(self.prefix_store.stats())
+        # Disaggregated serving (superset-only: appears once this engine
+        # has finished a prefill-only request at the phase boundary).
+        if self.prefill_done:
+            s["prefill_done"] = self.prefill_done
         # Fleet self-healing counters (superset-only: appear once moved).
         if self.deadline_expirations:
             s["deadline_expirations"] = self.deadline_expirations
@@ -3993,6 +4025,7 @@ class AsyncEngine:
         prompt_ids: Optional[List[int]] = None,
         params: Optional[SamplingParams] = None,
         deadline_at: Optional[float] = None,
+        prefill_only: bool = False,
     ) -> RequestOutput:
         import asyncio
 
@@ -4001,7 +4034,8 @@ class AsyncEngine:
         fut: Future = Future()
         self._futures[rid] = fut
         self._intake.put(
-            (rid, prompt, messages, prompt_ids, params, None, deadline_at)
+            (rid, prompt, messages, prompt_ids, params, None, deadline_at,
+             prefill_only)
         )
         self._wake.set()
         try:
@@ -4025,7 +4059,9 @@ class AsyncEngine:
             raise RuntimeError("engine is draining for handoff")
         fut: Future = Future()
         self._futures[rid] = fut
-        self._intake.put((rid, None, None, None, None, snapshot, deadline_at))
+        self._intake.put(
+            (rid, None, None, None, None, snapshot, deadline_at, False)
+        )
         self._wake.set()
         try:
             return await asyncio.wrap_future(fut)
@@ -4044,6 +4080,7 @@ class AsyncEngine:
                 kwargs.get("params"),
                 kwargs.get("snapshot"),
                 kwargs.get("deadline_at"),
+                kwargs.get("prefill_only", False),
             )
         )
         self._wake.set()
@@ -4450,7 +4487,8 @@ class AsyncEngine:
                     break
                 if item is None:
                     continue
-                rid, prompt, messages, prompt_ids, params, snapshot, dl = item
+                (rid, prompt, messages, prompt_ids, params, snapshot, dl,
+                 prefill_only) = item
                 try:
                     if snapshot is not None:
                         self.core.insert_request(snapshot, deadline_at=dl)
@@ -4462,6 +4500,7 @@ class AsyncEngine:
                             prompt_ids=prompt_ids,
                             params=params,
                             deadline_at=dl,
+                            prefill_only=prefill_only,
                         )
                     drained = True
                 except Exception as exc:  # tokenization/validation error

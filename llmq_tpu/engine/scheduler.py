@@ -180,6 +180,12 @@ class Sequence:
     admitted_at: int = -1  # scheduler tick of (last) admission, for LIFO preempt
     preempt_count: int = 0
     prefilled: bool = False  # KV cache holds this sequence (engine sets it)
+    # Disaggregated prefill role: stop after the prompt phase — the first
+    # sampled token is discarded, the prompt KV is snapshotted, and the
+    # sequence finishes with finish_reason="prefill_done" so the worker
+    # hands it to the decode pool (which re-samples that token from the
+    # same key chain, bit-identically).
+    prefill_only: bool = False
     # Wall-clock (time.time()) deadline, or None. The engine's sweep
     # expires waiting/running sequences past it between decode steps with
     # finish_reason="deadline_exceeded"; the worker dead-letters those.

@@ -502,6 +502,10 @@ class FleetSim:
             "engine_rebuilds": sum(
                 w.engine.rebuilds for w in workers if w.engine is not None
             ),
+            "role_switches": sum(w.role_switches for w in workers),
+            "handoffs_shipped": sum(w.handoffs_shipped for w in workers),
+            "handoffs_fallback": sum(w.handoffs_fallback for w in workers),
+            "jobs_adopted": sum(w.jobs_adopted for w in workers),
             "swap_refusals": sum(g["swap_refusals"] for g in governor_stats),
             "evictions_forced": sum(
                 g["evictions_forced"] for g in governor_stats

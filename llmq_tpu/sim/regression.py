@@ -15,7 +15,7 @@ statistical slop — they absorb deliberate cross-version drift (latency
 recalibration, scheduling-order changes) while staying far narrower
 than the detuned outcome.
 
-The four scenarios map to the four policy planes grown in PRs 11–14:
+The scenarios map to the policy planes grown in PRs 11–18:
 
 - ``watchdog-trips``  — dispatch watchdog deadline policy
   (``LLMQ_WATCHDOG_MULT``): detuning 8 → 4 makes ordinary straggler
@@ -31,6 +31,10 @@ The four scenarios map to the four policy planes grown in PRs 11–14:
   (``LLMQ_QUARANTINE_ATTEMPTS``): disabling it lets poison jobs churn
   through the full redelivery cap and dead-letter instead of
   quarantining with their failure history.
+- ``disagg-roleflap`` — elastic role autoscaling hysteresis
+  (``LLMQ_ROLE_DWELL_S``): zeroing the dwell lets the auto controller
+  re-decide roles on every depth check, so the prefill/decode cohorts
+  flap instead of converging.
 """
 
 from __future__ import annotations
@@ -64,6 +68,11 @@ def report_metrics(report: SimReport) -> Dict[str, float]:
         "evictions_forced": float(
             report.counters.get("evictions_forced", 0)
         ),
+        "role_switches": float(report.counters.get("role_switches", 0)),
+        "handoffs_fallback": float(
+            report.counters.get("handoffs_fallback", 0)
+        ),
+        "jobs_adopted": float(report.counters.get("jobs_adopted", 0)),
         "slo": (
             report.slo_attainment()
             if report.slo_attainment() is not None
@@ -147,6 +156,29 @@ def _governor_scenario() -> Scenario:
     )
 
 
+def _roleflap_scenario() -> Scenario:
+    # All-auto fleet on sustained traffic: everyone boots prefill-role,
+    # handoffs pile the decode queue, the depth-ratio controller flips a
+    # cohort to decode, and hysteresis (dwell) must keep the cohort from
+    # ping-ponging as the two queue depths see-saw.
+    return Scenario(
+        name="disagg-roleflap",
+        seed=7,
+        traffic=TrafficShape(
+            jobs=400,
+            rate_jobs_s=8.0,
+            prompt_tokens=(64, 512),
+            output_tokens=(32, 128),
+        ),
+        fleet=FleetShape(workers=8, concurrency=2),
+        env={
+            "LLMQ_WORKER_ROLE": "auto",
+            "LLMQ_ROLE_DWELL_S": "30",
+            "LLMQ_ROLE_CHECK_INTERVAL_S": "5",
+        },
+    )
+
+
 def _quarantine_scenario() -> Scenario:
     return Scenario(
         name="quarantine-poison",
@@ -222,6 +254,34 @@ REGRESSIONS: Dict[str, RegressionSpec] = {
                 "Budget 50 MB → 8 MB: a single 6 MB capture plus live "
                 "prefixes exceeds the swap rung even after eviction "
                 "(recorded: 146 refusals vs 0)."
+            ),
+        ),
+        RegressionSpec(
+            name="disagg-roleflap",
+            description=(
+                "Auto-role controller converges under a traffic flip "
+                "instead of flapping."
+            ),
+            build=_roleflap_scenario,
+            # Recorded from seed 7: 10 fleet-wide switches (each worker
+            # flips to decode roughly once as the prefill wave drains,
+            # plus a couple of late rebalances) and 399 fallback
+            # handoffs — every job prefilled by a prefill-role worker
+            # takes exactly one snapshot-fallback handoff (sim never
+            # ships peer-to-peer) and is adopted exactly once; the
+            # remainder were caught mid-flip and served unified.
+            baseline={
+                "results": (400, 400),
+                "role_switches": (1, 16),
+                "handoffs_fallback": (300, 800),
+                "jobs_adopted": (300, 800),
+            },
+            detune={"LLMQ_ROLE_DWELL_S": "0"},
+            detune_doc=(
+                "Dwell 30 s → 0 removes hysteresis: every 5 s depth "
+                "check re-decides the role, the prefill/decode cohorts "
+                "chase the see-sawing queue depths, and fleet-wide role "
+                "switches blow past the flap bound (recorded: 22 vs 10)."
             ),
         ),
         RegressionSpec(

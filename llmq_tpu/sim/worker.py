@@ -34,13 +34,20 @@ from typing import Deque, Dict, Optional, Set
 from llmq_tpu.core.models import Job
 from llmq_tpu.engine.watchdog import dispatch_deadline_s
 from llmq_tpu.sim.latency import DECODE_BLOCK_TOKENS, LatencyModel
+from llmq_tpu.utils import clock
 from llmq_tpu.utils.hashing import text_prefix_chain
 from llmq_tpu.utils.host_mem import HostMemoryGovernor
 from llmq_tpu.workers.base import BaseWorker
+from llmq_tpu.workers.resume import RESUME_FIELD, PrefillDone
 
 # Virtual seconds a simulated engine rebuild costs after a watchdog
 # trip (compile cache warm — mirrors the in-process rebuild path).
 REBUILD_S = 2.0
+
+# The stub engine has no KV to carry across a disaggregated handoff, so
+# prefill-role sim workers ship this opaque stand-in blob; the decode
+# side keys off RESUME_FIELD presence, never the blob's content.
+SIM_SNAPSHOT_B64 = "c2ltLXByZWZpbGwta3Y="  # base64("sim-prefill-kv")
 
 # Minimum per-kind history before the p99 estimate engages (below this
 # the deadline is the min_s floor alone, like the live watchdog).
@@ -163,7 +170,32 @@ class SimWorker(BaseWorker):
         prompt_tokens = int(sim.get("prompt_tokens", 128))
         output_tokens = int(sim.get("output_tokens", 64))
         hang_s = float(sim.get("hang_s", 0.0))
-        await engine.dispatch("prefill", self.model.prefill_s(prompt_tokens))
+        resume = job.extras().get(RESUME_FIELD)
+        adopted = isinstance(resume, dict) and "snapshot" in resume
+        if adopted:
+            # Decode-side continuation: the prefill pool already paid the
+            # prompt phase, so only the decode blocks run here. Adoption
+            # accounting mirrors the TPU worker's (counter + latency ring
+            # from the handoff stamp) so the twin's metrics line up.
+            self.jobs_adopted += 1
+            try:
+                latency_ms = max(
+                    0.0,
+                    (clock.wall() - float(resume.get("handoff_at"))) * 1000.0,
+                )
+            except (TypeError, ValueError):
+                latency_ms = 0.0
+            self._handoff_ms.append(latency_ms)
+        else:
+            await engine.dispatch(
+                "prefill", self.model.prefill_s(prompt_tokens)
+            )
+            if self.role_active == "prefill":
+                # Prompt KV complete — the base loop hands the job to the
+                # decode pool (sim never ships peer-to-peer: the default
+                # _ship_to_decode_peer declines, so every handoff takes
+                # the snapshot-fallback queue and counts as fallback).
+                raise PrefillDone(SIM_SNAPSHOT_B64)
         blocks = max(1, math.ceil(output_tokens / DECODE_BLOCK_TOKENS))
         hang_block = blocks // 2 if hang_s > 0 else -1
         for i in range(blocks):
@@ -256,7 +288,12 @@ class SimWorker(BaseWorker):
             task.cancel()
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
-        for attr in ("_consumer_tag", "_affinity_consumer_tag"):
+        for attr in (
+            "_consumer_tag",
+            "_affinity_consumer_tag",
+            "_decode_consumer_tag",
+            "_adopt_consumer_tag",
+        ):
             tag = getattr(self, attr, None)
             if tag is not None and self.broker.connected:
                 try:

@@ -33,6 +33,7 @@ import asyncio
 import json
 import logging
 import signal
+from collections import deque
 from typing import Optional
 
 from llmq_tpu.broker.base import DeliveredMessage
@@ -43,6 +44,8 @@ from llmq_tpu.broker.manager import (
     QUARANTINE_SUFFIX,
     BrokerManager,
     affinity_queue_name,
+    decode_adopt_queue_name,
+    decode_queue_name,
     kv_fetch_queue_name,
 )
 from llmq_tpu.core.config import Config, get_config
@@ -63,9 +66,14 @@ from llmq_tpu.utils.logging import ContextLogAdapter
 from llmq_tpu.workers.resume import (
     RESUME_FIELD,
     JobHandoff,
+    PrefillDone,
     ResultDeduper,
     resume_offset,
 )
+
+#: Valid LLMQ_WORKER_ROLE values. "unified" is the monolith default;
+#: "auto" workers start as prefill and switch on fleet queue depths.
+WORKER_ROLES = ("unified", "prefill", "decode", "auto")
 
 HEALTH_TTL_MS = 120_000
 
@@ -140,6 +148,27 @@ class BaseWorker(abc.ABC):
         self.jobs_deadline_exceeded = 0
         self.jobs_quarantined = 0
         self.breaker_tripped = False
+        # Disaggregated serving: the configured role ("unified" runs the
+        # monolith path unchanged) and the role currently served (differs
+        # from `role` only for "auto", whose controller flips role_active
+        # on fleet queue depths with hysteresis).
+        role = (self.config.worker_role or "unified").lower()
+        if role not in WORKER_ROLES:
+            raise ValueError(
+                f"LLMQ_WORKER_ROLE must be one of {WORKER_ROLES}, got {role!r}"
+            )
+        self.role = role
+        self.role_active = "prefill" if role == "auto" else role
+        self.role_switches = 0
+        self.handoffs_shipped = 0  # KV adoptions a decode peer accepted
+        self.handoffs_fallback = 0  # snapshot republishes to <q>.decode
+        self.jobs_adopted = 0  # handoffs this worker resumed as decoder
+        # Handoff publish→adoption latency samples (ms), bounded ring.
+        self._handoff_ms: deque = deque(maxlen=512)
+        self._role_since = clock.monotonic()
+        self._role_checked_at = float("-inf")
+        self._decode_consumer_tag: Optional[str] = None
+        self._adopt_consumer_tag: Optional[str] = None
 
     # --- abstract surface (reference base.py:57-75) -----------------------
     @abc.abstractmethod
@@ -195,21 +224,14 @@ class BaseWorker(abc.ABC):
         try:
             await self.initialize()
             self.running = True
-            self._consumer_tag = await self.broker.consume_jobs(
-                self.queue, self._process_message, prefetch=self.concurrency
-            )
-            if self.config.prefix_affinity:
-                self._affinity_consumer_tag = await self.broker.consume_jobs(
-                    affinity_queue_name(self.queue, self.worker_id),
-                    self._process_affinity_message,
-                    prefetch=self.concurrency,
-                )
+            await self._start_role_consumers()
             await self._start_extra_consumers()
             self.logger.info(
-                "Worker %s starting to consume from '%s' (prefetch=%d)",
+                "Worker %s starting to consume from '%s' (prefetch=%d, role=%s)",
                 self.worker_id,
                 self.queue,
                 self.concurrency,
+                self.role_active if self.role != "unified" else "unified",
             )
             # Monotonic clock for the beat cadence: wall time steps (NTP
             # slews, manual clock sets) must not skip or double beats.
@@ -223,6 +245,7 @@ class BaseWorker(abc.ABC):
                     if self.broker.transport_connected:
                         await self._publish_heartbeat()
                         last_beat = now
+                await self._maybe_switch_role()
                 await asyncio.sleep(1.0)
         finally:
             await self.shutdown()
@@ -233,7 +256,13 @@ class BaseWorker(abc.ABC):
         self.running = False
 
     async def shutdown(self) -> None:
-        for attr in ("_consumer_tag", "_affinity_consumer_tag", "_kv_consumer_tag"):
+        for attr in (
+            "_consumer_tag",
+            "_affinity_consumer_tag",
+            "_kv_consumer_tag",
+            "_decode_consumer_tag",
+            "_adopt_consumer_tag",
+        ):
             tag = getattr(self, attr, None)
             if tag is not None and self.broker.connected:
                 try:
@@ -260,6 +289,8 @@ class BaseWorker(abc.ABC):
             self.logger.warning("Timed out draining %d in-flight jobs", self._in_flight)
         if self.config.prefix_affinity and self.broker.connected:
             await self._retire_affinity_queue()
+        if self.role != "unified" and self.broker.connected:
+            await self._retire_adopt_queue()
         await self._cleanup_processor()
         if self.broker.connected:
             await self.broker.disconnect()
@@ -312,6 +343,145 @@ class BaseWorker(abc.ABC):
                 moved,
                 aq,
             )
+
+    async def _retire_adopt_queue(self) -> None:
+        """Graceful-shutdown half of adoption-orphan reclaim: return any
+        handoffs still parked on this worker's ``<q>.d.<id>`` queue to the
+        shared decode pool, then delete the queue. The janitor covers the
+        crashed-worker case."""
+        aq = decode_adopt_queue_name(self.queue, self.worker_id)
+        try:
+            while True:
+                msg = await self.broker.broker.get(aq)
+                if msg is None:
+                    break
+                await self.broker.broker.publish(
+                    decode_queue_name(self.queue),
+                    msg.body,
+                    message_id=msg.message_id,
+                    headers=msg.headers,
+                )
+                await msg.ack()
+            await self.broker.broker.delete_queue(aq)
+        except Exception:  # noqa: BLE001 — the janitor reclaims what's left
+            self.logger.warning(
+                "Adoption queue retirement incomplete", exc_info=True
+            )
+
+    # --- disaggregated roles ----------------------------------------------
+    async def _start_role_consumers(self) -> None:
+        """Attach the job consumers for the role currently served.
+
+        Prefill (and unified) workers consume the shared queue plus their
+        prefix-affinity queue; decode workers consume the shared decode
+        pool ``<q>.decode`` plus their private adoption queue ``<q>.d.<id>``
+        (accepted KV handoffs are parked there durably before the offer is
+        acknowledged). An auto worker holds exactly one of the two sets at
+        a time — switching roles swaps the set."""
+        if self.role_active == "decode":
+            dq = decode_queue_name(self.queue)
+            await self.broker.broker.declare_queue(
+                dq,
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
+            self._decode_consumer_tag = await self.broker.consume_jobs(
+                dq, self._process_message, prefetch=self.concurrency
+            )
+            aq = decode_adopt_queue_name(self.queue, self.worker_id)
+            await self.broker.broker.declare_queue(
+                aq,
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
+            self._adopt_consumer_tag = await self.broker.consume_jobs(
+                aq, self._process_message, prefetch=self.concurrency
+            )
+            return
+        self._consumer_tag = await self.broker.consume_jobs(
+            self.queue, self._process_message, prefetch=self.concurrency
+        )
+        if self.config.prefix_affinity:
+            self._affinity_consumer_tag = await self.broker.consume_jobs(
+                affinity_queue_name(self.queue, self.worker_id),
+                self._process_affinity_message,
+                prefetch=self.concurrency,
+            )
+
+    async def _stop_role_consumers(self) -> None:
+        for attr in (
+            "_consumer_tag",
+            "_affinity_consumer_tag",
+            "_decode_consumer_tag",
+            "_adopt_consumer_tag",
+        ):
+            tag = getattr(self, attr, None)
+            if tag is not None:
+                try:
+                    # requeue=False: in-flight deliveries finish under the
+                    # normal settle paths; requeueing would double-deliver.
+                    await self.broker.cancel(tag, requeue=False)
+                except Exception:  # noqa: BLE001 — best-effort swap
+                    pass
+                setattr(self, attr, None)
+
+    async def _maybe_switch_role(self) -> None:
+        """Auto-role controller: compare shared-queue (prefill demand)
+        against decode-pool depth and flip this worker's role when the
+        ratio leaves the hysteresis band. Two guards prevent flapping:
+        a check cadence (role_check_interval_s) and a minimum dwell in
+        the current role (role_dwell_s)."""
+        if self.role != "auto" or not self.running:
+            return
+        now = clock.monotonic()
+        if now - self._role_checked_at < self.config.role_check_interval_s:
+            return
+        self._role_checked_at = now
+        if now - self._role_since < self.config.role_dwell_s:
+            return
+        try:
+            shared = await self.broker.get_queue_stats(self.queue)
+            decode = await self.broker.get_queue_stats(
+                decode_queue_name(self.queue)
+            )
+        except Exception:  # noqa: BLE001 — no stats, no switch
+            return
+        dp = shared.message_count_ready
+        dd = decode.message_count_ready
+        if dp is None or dd is None:
+            return
+        # +1 smoothing keeps the ratio finite and biases an all-empty
+        # fleet toward staying put (ratio 1.0 is inside any sane band).
+        ratio = (dp + 1.0) / (dd + 1.0)
+        target = None
+        if self.role_active == "prefill" and ratio < self.config.role_switch_lo:
+            target = "decode"
+        elif self.role_active == "decode" and ratio > self.config.role_switch_hi:
+            target = "prefill"
+        if target is not None:
+            await self._switch_role(target, ratio=ratio)
+
+    async def _switch_role(self, target: str, *, ratio: float = 0.0) -> None:
+        prev = self.role_active
+        await self._stop_role_consumers()
+        self.role_active = target
+        self.role_switches += 1
+        self._role_since = clock.monotonic()
+        emit_trace_event(
+            self.worker_id,
+            "role_switch",
+            worker_id=self.worker_id,
+            role_from=prev,
+            role_to=target,
+            depth_ratio=round(ratio, 3),
+        )
+        self.logger.info(
+            "Role switch %s -> %s (shared:decode depth ratio %.2f)",
+            prev,
+            target,
+            ratio,
+        )
+        await self._start_role_consumers()
 
     async def _start_extra_consumers(self) -> None:
         """Hook: attach additional consumers after the main job consumer
@@ -490,6 +660,18 @@ class BaseWorker(abc.ABC):
             self._job_traces.pop(job.id, None)
             self._settle_in_flight()
             return
+        if self.role_active == "prefill" and isinstance(
+            job.extras().get(RESUME_FIELD), dict
+        ):
+            # A prefill worker claimed a job that already carries resume
+            # state (janitor reclaim or mid-switch delivery): its prompt
+            # KV exists somewhere already — forward it to the decode pool
+            # verbatim instead of re-prefilling (and instead of looping it
+            # through another prefill_done handoff forever).
+            await self._forward_to_decode(job, message)
+            self._job_traces.pop(job.id, None)
+            self._settle_in_flight()
+            return
         try:
             output = await self._run_with_timeout(job)
             duration_ms = (clock.monotonic() - start) * 1000
@@ -533,6 +715,13 @@ class BaseWorker(abc.ABC):
             # front of an expensive recovery path). Same terminal state as
             # the claim-time check: one explicit dead-letter, no requeue.
             await self._dead_letter_deadline(job, message, trace)
+        except PrefillDone as exc:
+            # Disaggregated phase boundary: prompt KV is complete; hand
+            # the request to the decode pool (adoption offer to a chosen
+            # decode peer, snapshot republish to <q>.decode as fallback).
+            # Caught before JobHandoff — this is forward progress, and
+            # before the failure ladders — it is not a failure.
+            await self._handoff_to_decode(job, message, trace, exc)
         except JobHandoff as exc:
             # Drain-with-handoff: the engine resolved this request with a
             # snapshot of its partial progress instead of a completion.
@@ -715,8 +904,11 @@ class BaseWorker(abc.ABC):
             from llmq_tpu.utils.host_mem import get_governor
 
             get_governor().note_resume_blob(len(body))
+            # A decode-role worker's in-flight requests belong to the
+            # decode pool — republishing them to the shared queue would
+            # hand KV-complete work back to prefill workers.
             await self.broker.broker.publish(
-                self.queue,
+                self._resume_queue(),
                 body,
                 message_id=job.id,
             )
@@ -737,6 +929,116 @@ class BaseWorker(abc.ABC):
             extra={"job_id": job.id},
         )
         await message.ack()
+
+    def _resume_queue(self) -> str:
+        """Where this worker's resumable handoffs republish: decode-role
+        workers keep KV-complete work inside the decode pool; everyone
+        else uses the shared queue (monolith behavior)."""
+        if self.role_active == "decode":
+            return decode_queue_name(self.queue)
+        return self.queue
+
+    async def _forward_to_decode(
+        self, job: Job, message: DeliveredMessage
+    ) -> None:
+        """Move a resume-carrying job off a prefill worker onto the decode
+        pool, payload untouched (trace and snapshot ride along)."""
+        try:
+            await self.broker.broker.publish(
+                decode_queue_name(self.queue),
+                message.body,
+                message_id=message.message_id,
+                headers=message.headers,
+            )
+            emit_trace_event(
+                job.id, "kv_handoff", worker_id=self.worker_id, path="forward"
+            )
+            await message.ack()
+        except Exception:  # noqa: BLE001 — transport down: redeliver
+            await message.reject(requeue=True)
+
+    async def _handoff_to_decode(
+        self,
+        job: Job,
+        message: DeliveredMessage,
+        trace: dict,
+        exc: PrefillDone,
+    ) -> None:
+        """Settle a prefill-complete job into the decode pool.
+
+        The prompt-KV snapshot rides under ``RESUME_FIELD`` (offset 0: no
+        output token was kept — the adopter re-samples the first token from
+        the re-derived key chain, bit-identically). Preferred path: offer
+        the payload to a rendezvous-picked decode peer over its
+        ``<q>.kv.<peer>`` queue (deepest prefix-affinity match wins); when
+        no peer accepts within ``handoff_timeout_s``, republish to the
+        shared ``<q>.decode`` queue. Either way the publish lands BEFORE
+        the ack, so a crash in the window leaves the original message to
+        redeliver and the result deduper collapses the double."""
+        try:
+            payload = json.loads(message.body)
+        except Exception:  # noqa: BLE001 — parsed once already; paranoia
+            await message.reject(requeue=True)
+            return
+        trace_event(trace, "prefill_done", worker_id=self.worker_id)
+        emit_trace_event(job.id, "prefill_done", worker_id=self.worker_id)
+        payload[RESUME_FIELD] = {
+            "snapshot": exc.snapshot_b64,
+            "offset": 0,
+            # Wall-clock handoff stamp: the adopting decode worker turns
+            # it into the handoff-latency sample in its heartbeats.
+            "handoff_at": clock.wall(),
+        }
+        # The boundary event must ride INSIDE the shipped payload (the
+        # adopter's result trace is built from it), so stamp it before
+        # serializing — optimistically as the ship path, rewritten below
+        # if the offer misses and the snapshot fallback carries the KV.
+        trace_event(
+            trace, "kv_handoff", worker_id=self.worker_id, path="ship"
+        )
+        payload[TRACE_FIELD] = trace
+        body = json.dumps(payload).encode("utf-8")
+        from llmq_tpu.utils.host_mem import get_governor
+
+        get_governor().note_resume_blob(len(body))
+        shipped = False
+        try:
+            shipped = await self._ship_to_decode_peer(job, body)
+        except Exception:  # noqa: BLE001 — offer failed: take the fallback
+            self.logger.debug("Decode adoption offer failed", exc_info=True)
+        if shipped:
+            self.handoffs_shipped += 1
+            emit_trace_event(
+                job.id, "kv_handoff", worker_id=self.worker_id, path="ship"
+            )
+            await message.ack()
+            return
+        trace["events"][-1]["path"] = "snapshot"
+        body = json.dumps(payload).encode("utf-8")
+        try:
+            await self.broker.broker.publish(
+                decode_queue_name(self.queue), body, message_id=job.id
+            )
+        except Exception:  # noqa: BLE001 — transport down
+            self.logger.warning(
+                "Decode-pool republish failed for job %s; requeueing plain",
+                job.id,
+                exc_info=True,
+            )
+            await message.reject(requeue=True)
+            return
+        self.handoffs_fallback += 1
+        emit_trace_event(
+            job.id, "kv_handoff", worker_id=self.worker_id, path="snapshot"
+        )
+        await message.ack()
+
+    async def _ship_to_decode_peer(self, job: Job, body: bytes) -> bool:
+        """Hook: offer a prefill-complete payload to a decode peer for
+        direct adoption; True only once a peer durably holds it. Base
+        workers have no peer discovery — the snapshot fallback covers
+        them."""
+        return False
 
     async def _run_with_timeout(self, job: Job) -> str:
         timeout = self.config.job_timeout_s
@@ -849,14 +1151,16 @@ class BaseWorker(abc.ABC):
             prefix_chains=self._prefix_chains(),
             last_dispatch_ok_age_s=self._dispatch_ok_age(),
             integrity=self._integrity_status(),
+            role=self._worker_role(),
         )
         try:
-            # The liveness/integrity fields are excluded (not serialized
-            # as null) when their machinery is off, so default-config
-            # heartbeat payloads stay byte-identical to older workers.
+            # The liveness/integrity/role fields are excluded (not
+            # serialized as null) when their machinery is off, so
+            # default-config heartbeat payloads stay byte-identical to
+            # older workers.
             unset = {
                 name
-                for name in ("last_dispatch_ok_age_s", "integrity")
+                for name in ("last_dispatch_ok_age_s", "integrity", "role")
                 if getattr(health, name) is None
             }
             await self.broker.broker.publish(
@@ -896,7 +1200,30 @@ class BaseWorker(abc.ABC):
                 stats[name] = value
         if self.breaker_tripped:
             stats["breaker_tripped"] = True
+        # Disaggregated-serving counters (superset-only, like the rest).
+        if self.role == "auto":
+            stats["role_mode"] = "auto"
+        for name in (
+            "role_switches",
+            "handoffs_shipped",
+            "handoffs_fallback",
+            "jobs_adopted",
+        ):
+            value = getattr(self, name, 0)
+            if value:
+                stats[name] = value
+        if self._handoff_ms:
+            vals = sorted(self._handoff_ms)
+            stats["handoff_ms_p50"] = round(vals[len(vals) // 2], 3)
+            stats["handoff_ms_p95"] = round(
+                vals[min(len(vals) - 1, int(len(vals) * 0.95))], 3
+            )
         return stats or None
+
+    def _worker_role(self) -> Optional[str]:
+        """The role advertised in heartbeats: the currently-served role
+        for disaggregated workers, None (field omitted) for unified."""
+        return None if self.role == "unified" else self.role_active
 
     def _prefix_chains(self) -> Optional[list]:
         """Subclasses may advertise hot prefix-chain digests (hex) for

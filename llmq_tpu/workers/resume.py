@@ -43,6 +43,19 @@ class JobHandoff(Exception):
         self.emitted = emitted
 
 
+class PrefillDone(Exception):
+    """Raised by a prefill-role processor when the engine finished the
+    prompt phase of a request (``finish_reason="prefill_done"``): the
+    prompt KV is complete and snapshotted, no output token was kept. The
+    message loop hands the request to the decode pool — adoption offer to
+    a chosen decode peer first, snapshot republish on ``<q>.decode`` as
+    the fallback — instead of publishing a result."""
+
+    def __init__(self, snapshot_b64: str) -> None:
+        super().__init__("prefill complete; handing off to the decode pool")
+        self.snapshot_b64 = snapshot_b64
+
+
 def resume_offset(extras: Optional[dict]) -> int:
     """The emitted-token offset a job's resume state claims (0 for a
     fresh job or malformed resume field)."""

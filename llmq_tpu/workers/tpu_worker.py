@@ -27,12 +27,14 @@ from pathlib import Path
 from typing import Any, Callable, List, Optional
 
 from llmq_tpu.broker.manager import (
+    decode_adopt_queue_name,
     job_affinity_text,
     kv_fetch_queue_name,
     rendezvous_pick,
 )
 from llmq_tpu.core.models import Job
 from llmq_tpu.obs import emit_trace_event, trace_event, trace_event_at
+from llmq_tpu.utils import clock
 from llmq_tpu.utils.hashing import (
     text_prefix_chain,
     token_fold,
@@ -40,7 +42,7 @@ from llmq_tpu.utils.hashing import (
 )
 from llmq_tpu.utils.host_mem import get_governor
 from llmq_tpu.workers.base import BaseWorker, DeadlineExceeded
-from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff
+from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff, PrefillDone
 
 PRESET_SCHEMES = ("preset://", "dummy://", "random://")
 
@@ -515,8 +517,12 @@ class TPUWorker(BaseWorker):
         """Attach the prefix-page fetch server: peers ask for chunks on
         ``<queue>.kv.<worker_id>`` and get chunk blobs on their reply
         queue. Requests are ephemeral (short TTL, single delivery) — a
-        requester that timed out has already recomputed."""
-        if not self._prefix_enabled():
+        requester that timed out has already recomputed.
+
+        The same RPC queue carries KV adoption offers in a disaggregated
+        fleet, so decode-capable workers (decode or auto role) attach it
+        even without prefix shipping."""
+        if not (self._prefix_enabled() or self.role in ("decode", "auto")):
             return
         kv_q = kv_fetch_queue_name(self.queue, self.worker_id)
         await self.broker.broker.declare_queue(
@@ -543,6 +549,12 @@ class TPUWorker(BaseWorker):
         peer_key = None
         try:
             req = json.loads(message.body)
+            if "adopt" in req:
+                # KV adoption offer from a prefill peer — outside the
+                # peer-serve accounting (it is a single durable publish,
+                # not a page export). peer_key stays None.
+                await self._serve_adopt_offer(req)
+                return
             want = [str(d) for d in (req.get("want") or [])][:64]
             reply_to = req.get("reply_to")
             req_id = req.get("req")
@@ -596,6 +608,134 @@ class TPUWorker(BaseWorker):
                 await message.ack()
             except Exception:  # noqa: BLE001 — already settled / transport gone
                 pass
+
+    async def _serve_adopt_offer(self, req: dict) -> None:
+        """Decode side of the phase-boundary handshake: a prefill peer
+        offers a prefill-complete job payload (prompt-KV snapshot riding
+        inside). Accept iff this worker currently serves the decode role;
+        on accept the payload is durably parked on this worker's private
+        ``<q>.d.<id>`` adoption queue BEFORE the reply goes out — either
+        side dying after that point leaves the payload recoverable (the
+        consumer drains it, or the janitor reclaims it to ``<q>.decode``)."""
+        reply_to = req.get("reply_to")
+        req_id = req.get("req")
+        payload = req.get("adopt")
+        accept = (
+            self.running
+            and self.role_active == "decode"
+            and isinstance(payload, str)
+            and bool(payload)
+        )
+        if accept:
+            aq = decode_adopt_queue_name(self.queue, self.worker_id)
+            try:
+                await self.broker.broker.declare_queue(
+                    aq,
+                    ttl_ms=self.config.job_ttl_ms,
+                    max_redeliveries=self.config.max_redeliveries,
+                )
+                await self.broker.broker.publish(
+                    aq, payload.encode("utf-8"), message_id=req_id
+                )
+            except Exception:  # noqa: BLE001 — can't park it: decline
+                self.logger.debug("Adoption park failed", exc_info=True)
+                accept = False
+        if reply_to:
+            reply = (
+                {"req": req_id, "accepted": True}
+                if accept
+                else {"req": req_id, "busy": True}
+            )
+            try:
+                await self.broker.broker.publish(
+                    reply_to, json.dumps(reply).encode("utf-8")
+                )
+            except Exception:  # noqa: BLE001 — offerer times out → fallback
+                self.logger.debug("Adoption reply failed", exc_info=True)
+
+    async def _ship_to_decode_peer(self, job: Job, body: bytes) -> bool:
+        """Pick a decode peer for this prefill-complete job — deepest
+        prefix-affinity match among fresh decode-role heartbeats wins,
+        rendezvous hash breaks ties (and covers the no-affinity case) —
+        then run the offer handshake. False on any miss: no fresh decode
+        peer, all negative-cached, peer declined, or reply timeout."""
+        try:
+            mapping = await self.broker.decode_targets(self.queue)
+        except Exception:  # noqa: BLE001 — discovery failed: fallback path
+            return False
+        now = time.monotonic()
+        peers = [
+            w
+            for w in mapping
+            if w != self.worker_id and not self._peer_dead(w, now)
+        ]
+        if not peers:
+            return False
+        peer = None
+        text = job_affinity_text(job)
+        if text:
+            for digest in reversed(text_prefix_chain(text)):
+                candidates = [
+                    w for w in peers if digest in (mapping.get(w) or [])
+                ]
+                if candidates:
+                    peer = rendezvous_pick(digest, candidates)
+                    break
+        if peer is None:
+            peer = rendezvous_pick(job.id, sorted(peers))
+        return await self._offer_adoption(peer, job.id, body)
+
+    async def _offer_adoption(
+        self, peer: str, job_id: str, body: bytes
+    ) -> bool:
+        """Offer/ack half of the handshake: publish the payload to the
+        peer's ``<q>.kv.<peer>`` RPC queue and poll the shared reply queue
+        until ``handoff_timeout_s``. True only on an explicit accept —
+        busy, timeout, or garbage all return False (snapshot fallback)."""
+        async with self._fetch_lock:
+            reply_q = kv_fetch_queue_name(self.queue, self.worker_id) + ".r"
+            try:
+                await self.broker.broker.declare_queue(
+                    reply_q, ttl_ms=30_000, max_redeliveries=1
+                )
+                await self.broker.broker.publish(
+                    kv_fetch_queue_name(self.queue, peer),
+                    json.dumps(
+                        {
+                            "adopt": body.decode("utf-8"),
+                            "reply_to": reply_q,
+                            "req": job_id,
+                            "from": self.worker_id,
+                        }
+                    ).encode("utf-8"),
+                )
+            except Exception:  # noqa: BLE001 — peer queue gone
+                return False
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.config.handoff_timeout_s
+            while loop.time() < deadline:
+                try:
+                    msg = await self.broker.broker.get(reply_q)
+                except Exception:  # noqa: BLE001 — transport hiccup
+                    break
+                if msg is None:
+                    await asyncio.sleep(0.05)
+                    continue
+                try:
+                    payload = json.loads(msg.body)
+                except Exception:  # noqa: BLE001
+                    payload = None
+                await msg.ack()
+                if (
+                    not isinstance(payload, dict)
+                    or payload.get("req") != job_id
+                ):
+                    continue  # stale reply from an earlier timed-out offer
+                return bool(payload.get("accepted"))
+            # Timeout: negative-cache the peer like a failed page fetch —
+            # its RPC queue may be an unreclaimed orphan.
+            self._dead_peers[peer] = time.monotonic() + PEER_NEGATIVE_CACHE_S
+            return False
 
     async def _maybe_fetch_prefix(self, job: Job, text: str) -> None:
         """Cache miss with a remote hit: ship the missing prefix pages
@@ -841,6 +981,32 @@ class TPUWorker(BaseWorker):
                 trace_event(
                     trace, "resumed", offset=len(snapshot.output_ids)
                 )
+            # Phase-boundary adoption: a handoff_at stamp marks this
+            # resume as a prefill→decode handoff (drain handoffs don't
+            # carry one). Count it and sample the handoff latency.
+            resume = job.extras().get(RESUME_FIELD)
+            ho_at = (
+                resume.get("handoff_at") if isinstance(resume, dict) else None
+            )
+            if ho_at is not None:
+                try:
+                    latency_ms = max(
+                        0.0, (clock.wall() - float(ho_at)) * 1000.0
+                    )
+                except (TypeError, ValueError):
+                    latency_ms = 0.0
+                self.jobs_adopted += 1
+                self._handoff_ms.append(latency_ms)
+                if trace is not None:
+                    trace_event(
+                        trace, "adopted", latency_ms=round(latency_ms, 3)
+                    )
+                emit_trace_event(
+                    job.id,
+                    "adopted",
+                    worker_id=self.worker_id,
+                    latency_ms=round(latency_ms, 3),
+                )
             try:
                 out = await self.engine.resume(
                     rid=job.id, snapshot=snapshot, **gen_kw
@@ -856,6 +1022,14 @@ class TPUWorker(BaseWorker):
                     extra={"job_id": job.id},
                 )
         if out is None:
+            if self.role_active == "prefill":
+                # Prefill role: run the prompt phase only. The engine
+                # finishes the request at the boundary with a prompt-KV
+                # snapshot (finish_reason="prefill_done"); the PrefillDone
+                # raise below routes it to the decode pool. Passed only
+                # for this role so unified call sites (and engine stubs)
+                # keep their existing signature.
+                gen_kw["prefill_only"] = True
             if job.messages is not None:
                 out = await self.engine.generate(
                     rid=job.id, messages=job.messages, params=params, **gen_kw
@@ -891,6 +1065,16 @@ class TPUWorker(BaseWorker):
                 else None,
                 out.emitted,
             )
+        if getattr(out, "finish_reason", None) == "prefill_done":
+            snap = getattr(out, "snapshot", None)
+            if snap is None:
+                # Must never happen (the engine snapshots before it
+                # finishes the sequence); RuntimeError — not ValueError —
+                # so the base loop requeues instead of dropping the job.
+                raise RuntimeError(
+                    f"prefill_done for job {job.id} carried no snapshot"
+                )
+            raise PrefillDone(snapshot_to_b64(snap))
         self._usage[job.id] = {
             "prompt_tokens": out.prompt_tokens,
             "completion_tokens": out.completion_tokens,

@@ -174,8 +174,14 @@ class TestChaosSoak:
                 await asyncio.wait_for(task, timeout=30.0)
 
 
-def _tpu_worker(ns: str, queue: str, **engine_kw) -> TPUWorker:
-    cfg = Config(broker_url=f"memory://{ns}", max_redeliveries=1000)
+def _tpu_worker(
+    ns: str, queue: str, role: str = "unified", **engine_kw
+) -> TPUWorker:
+    cfg = Config(
+        broker_url=f"memory://{ns}",
+        max_redeliveries=1000,
+        worker_role=role,
+    )
     kw = dict(
         model="preset://tiny",
         tensor_parallel=1,
@@ -186,7 +192,12 @@ def _tpu_worker(ns: str, queue: str, **engine_kw) -> TPUWorker:
         max_num_seqs=4,
     )
     kw.update(engine_kw)
-    return TPUWorker(queue, config=cfg, concurrency=8, **kw)
+    w = TPUWorker(queue, config=cfg, concurrency=8, **kw)
+    if role != "unified":
+        # In-process workers share host+pid and hence the generated id;
+        # the prefill side must not mistake the decode peer for itself.
+        w.worker_id = f"{w.worker_id}-{role}"
+    return w
 
 
 def _kill_jobs(n=6, max_tokens=24):
@@ -407,6 +418,233 @@ class TestChaosKillResume:
             names = [e["name"] for e in trace["events"]]
             assert "handoff" in names and "resumed" in names, names
             assert names.count("claimed") == 2, names
+
+
+@pytest.mark.slow
+class TestDisaggKillWindows:
+    """The two disaggregation-specific crash windows: a prefill worker
+    dying after its KV-handoff publish lands but before the claimed
+    message acks (the handoff's publish-before-ack window), and a decode
+    worker dying mid-adoption with partial decode progress. Both must
+    preserve the fleet invariant — exactly one result per job, greedy
+    token-identical to the unified monolith."""
+
+    async def test_kill_prefill_after_handoff_publish_before_ack(
+        self, mem_ns
+    ):
+        """The handoff publishes BEFORE the ack by design; a crash in
+        that window leaves the original message to redeliver. A second
+        prefill worker re-prefills it and hands it off AGAIN, so two
+        copies of the same offset-0 payload reach the decode pool — the
+        decode worker's result deduper collapses the double into exactly
+        one result, token-identical to the monolith."""
+        jobs = _kill_jobs()
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, {})
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("dkq")
+
+            # Decode worker first, heartbeat visible, so the ship path is
+            # live before any handoff fires.
+            wd = _tpu_worker(mem_ns, "dkq", role="decode")
+            td = asyncio.ensure_future(wd.run())
+            deadline = asyncio.get_running_loop().time() + 120.0
+            while not any(
+                h.role == "decode"
+                for h in (await mgr.get_worker_health("dkq")).values()
+            ):
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "decode worker never heartbeat"
+                )
+                await asyncio.sleep(0.05)
+            for j in jobs:
+                await mgr.publish_job("dkq", j)
+
+            wp1 = _tpu_worker(mem_ns, "dkq", role="prefill")
+            fired = {"done": False}
+            orig_process = wp1._process_message
+
+            class DieBeforeAck:
+                """First ack that follows a handoff publish never lands:
+                the worker 'dies' in the window. Its consumer is torn
+                down first so the redelivery cannot bounce back to the
+                dying worker."""
+
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+                async def ack(self):
+                    handed = wp1.handoffs_shipped + wp1.handoffs_fallback
+                    if not fired["done"] and handed >= 1:
+                        fired["done"] = True
+                        if wp1._consumer_tag is not None:
+                            await wp1.broker.cancel(
+                                wp1._consumer_tag, requeue=False
+                            )
+                            wp1._consumer_tag = None
+                        wp1.request_shutdown()
+                        await self._inner.reject(requeue=True)
+                        return
+                    await self._inner.ack()
+
+            async def process_in_window(message):
+                await orig_process(DieBeforeAck(message))
+
+            wp1._process_message = process_in_window
+            t1 = asyncio.ensure_future(wp1.run())
+            await asyncio.wait_for(t1, timeout=180.0)
+            assert fired["done"], "no handoff completed before wp1 drained"
+
+            # The replacement prefill worker claims the redelivered
+            # original (and forwards any drain snapshots wp1 left on the
+            # shared queue) — wait for its re-handoff so the duplicate
+            # copy provably exists before results are judged.
+            wp2 = _tpu_worker(mem_ns, "dkq", role="prefill")
+            t2 = asyncio.ensure_future(wp2.run())
+            try:
+                deadline = asyncio.get_running_loop().time() + 120.0
+                while wp2.handoffs_shipped + wp2.handoffs_fallback < 1:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "redelivered job never re-handed off"
+                    )
+                    await asyncio.sleep(0.05)
+                payloads = await _collect_all_payloads(
+                    mgr, "dkq.results", want_ids
+                )
+                # Every job funnels to the single decode worker exactly
+                # once — except the window job, which arrives twice. Wait
+                # until the duplicate has been fully processed (its
+                # publish is what the deduper suppresses), then sweep the
+                # results queue once more so a leaked double is visible.
+                deadline = asyncio.get_running_loop().time() + 120.0
+                while wd.jobs_processed < len(jobs) + 1:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        f"duplicate copy never reached the decode worker "
+                        f"(processed={wd.jobs_processed})"
+                    )
+                    await asyncio.sleep(0.05)
+                await asyncio.sleep(0.5)
+                while (msg := await mgr.broker.get("dkq.results")) is not None:
+                    payloads.append(json.loads(msg.body))
+                    await msg.ack()
+            finally:
+                wp2.request_shutdown()
+                wd.request_shutdown()
+                await asyncio.wait_for(
+                    asyncio.gather(t2, td), timeout=60.0
+                )
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged across the handoff-window kill"
+            )
+        assert wp1.handoffs_shipped + wp1.handoffs_fallback >= 1
+        assert wp2.handoffs_shipped + wp2.handoffs_fallback >= 1, (
+            "second prefill worker never re-handed the window job off"
+        )
+        assert wd.jobs_adopted >= len(jobs) + 1, (
+            "decode worker never adopted the duplicate copy"
+        )
+
+    async def test_kill_decode_mid_adoption_resumes_exactly_once(
+        self, mem_ns
+    ):
+        """A decode worker dies after adopting handed-off requests and
+        decoding part of them. Its drain republishes the partial progress
+        to the decode pool (``_resume_queue`` keeps KV-complete work
+        inside the pool); a replacement decode worker resumes mid-stream.
+        Exactly one result per job, token-identical to the monolith, with
+        the full three-worker lifecycle riding the traces."""
+        from llmq_tpu.obs import trace_from_payload
+
+        engine_kw = {"max_model_len": 160, "num_pages": 96}
+        jobs = _kill_jobs(n=4, max_tokens=120)
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, engine_kw)
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("daq")
+            for j in jobs:
+                await mgr.publish_job("daq", j)
+
+            # Prefill alone — no decode peer alive — so every boundary
+            # handoff takes the snapshot fallback onto <q>.decode.
+            wp = _tpu_worker(mem_ns, "daq", role="prefill", **engine_kw)
+            tp = asyncio.ensure_future(wp.run())
+            deadline = asyncio.get_running_loop().time() + 120.0
+            while wp.handoffs_fallback < len(jobs):
+                assert asyncio.get_running_loop().time() < deadline, (
+                    f"fallbacks stuck at {wp.handoffs_fallback}"
+                )
+                await asyncio.sleep(0.05)
+            assert wp.handoffs_shipped == 0
+            wp.request_shutdown()
+            await asyncio.wait_for(tp, timeout=60.0)
+
+            # Drive decode worker 1 manually (consumers attached by hand,
+            # no run() loop) so the kill lands the moment a request is
+            # provably mid-decode — at least two sampled tokens, so the
+            # republished snapshot must carry a nonzero offset.
+            wd1 = _tpu_worker(mem_ns, "daq", role="decode", **engine_kw)
+            await wd1.initialize()
+            wd1.running = True
+            await wd1._start_role_consumers()
+            deadline = asyncio.get_running_loop().time() + 120.0
+            while not any(
+                len(seq.output_ids) >= 2
+                for seq in wd1.engine.core.scheduler.running.values()
+            ):
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "no adopted request ever reached mid-decode"
+                )
+                await asyncio.sleep(0.01)
+            wd1.running = False
+            await wd1.shutdown()
+            assert wd1.jobs_adopted >= 1, "kill landed before any adoption"
+
+            wd2 = _tpu_worker(mem_ns, "daq", role="decode", **engine_kw)
+            t2 = asyncio.ensure_future(wd2.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "daq.results", want_ids
+                )
+            finally:
+                wd2.request_shutdown()
+                await asyncio.wait_for(t2, timeout=60.0)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged across the mid-adoption kill"
+            )
+        resumed = [p for p in payloads if p.get("resume_offset", 0) > 0]
+        assert resumed, (
+            "no job carried mid-stream progress across the decode kill"
+        )
+        # A resumed job's trace spans all three workers: prefill boundary
+        # (prefill_done + kv_handoff), first adoption (resumed/adopted),
+        # the dying worker's drain (handoff), and the second adoption.
+        for p in resumed:
+            trace = trace_from_payload(p)
+            assert trace is not None
+            names = [e["name"] for e in trace["events"]]
+            assert "prefill_done" in names, names
+            assert "kv_handoff" in names, names
+            assert "adopted" in names, names
+            assert "handoff" in names, names
+            assert names.count("resumed") >= 2, names
+            assert names.count("claimed") >= 3, names
 
 
 class TestChaosTrace:

@@ -437,6 +437,73 @@ def test_monitor_top_ranks_thousand_worker_fleet():
     assert "w-0959" not in out and "w-0000" not in out
 
 
+def test_monitor_top_role_summary_thousand_worker_fleet():
+    """Disaggregated fleet at scale (1,000 heartbeats): the header gains
+    the per-role summary — pool counts, auto-controller count, decode
+    pool depth, fleet handoff p50/p95 — and the table a role column.
+    Superset-only: a role-less fleet renders no disagg surface at all."""
+    from rich.console import Console
+
+    from llmq_tpu.cli.monitor import _render_top
+    from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
+
+    now = utcnow()
+    beats = {}
+    for i in range(1000):
+        wid = f"w-{i:04d}"
+        role = "prefill" if i < 600 else "decode"
+        engine_stats = {
+            "tokens_per_sec": 1.0,
+            "batch_occupancy": i / 1000.0,
+        }
+        if role == "decode":
+            # Uniform ring percentiles so the fleet median is exact.
+            engine_stats["handoff_ms_p50"] = 12.0
+            engine_stats["handoff_ms_p95"] = 34.0
+        if i % 10 == 0:
+            engine_stats["role_mode"] = "auto"
+        beats[wid] = WorkerHealth(
+            worker_id=wid,
+            status="running",
+            last_seen=now,
+            jobs_processed=i,
+            role=role,
+            engine_stats=engine_stats,
+        )
+    stats = QueueStats(queue_name="bigq", message_count_ready=5)
+    frame = _render_top("bigq", beats, stats, top=40, decode_depth=7)
+    console = Console(width=220, record=True)
+    console.print(frame)
+    out = console.export_text()
+
+    assert "roles p:600 d:400 (auto:100)" in out
+    assert "decode ready 7" in out
+    assert "handoff p50/p95 12/34 ms" in out
+    # The busiest rows (occupancy ranking unchanged) carry role cells.
+    assert "role" in out and "decode" in out
+    assert "1000 fresh worker(s)" in out
+
+    # Superset-only: same renderer, role-less fleet, no decode depth —
+    # the unified frame must not grow a role line or column.
+    plain = {
+        wid: WorkerHealth(
+            worker_id=wid,
+            status="running",
+            last_seen=now,
+            jobs_processed=1,
+            engine_stats={"tokens_per_sec": 1.0},
+        )
+        for wid in ("u-0", "u-1")
+    }
+    plain_frame = _render_top("bigq", plain, stats, top=40)
+    console = Console(width=220, record=True)
+    console.print(plain_frame)
+    plain_out = console.export_text()
+    assert "roles p:" not in plain_out
+    assert "handoff" not in plain_out
+    assert "role" not in plain_out
+
+
 def test_monitor_top_cli_exposes_top_option():
     """`llmq-tpu monitor top --top N` threads through to the renderer."""
     from llmq_tpu.cli.main import cli as cli_group

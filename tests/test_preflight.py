@@ -181,6 +181,7 @@ def test_ladders_parse():
     assert "integrity_probe" in joined
     assert "sim_probe" in joined
     assert "shardcheck_probe" in joined
+    assert "disagg_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -368,6 +369,25 @@ def test_integrity_probe_runs():
     assert "weight-audit leg ok" in proc.stdout
     assert "canary leg ok" in proc.stdout
     assert "metric: integrity_probe_ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_disagg_probe_runs():
+    """The disaggregated-serving rung runs end to end on CPU: prompt KV
+    ships over the adoption handshake with unified-fleet token parity,
+    the same jobs take the snapshot fallback with parity when no decode
+    peer is alive, and the auto-role controller flips
+    prefill→decode→prefill under synthetic depth skew."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/disagg_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:disagg_probe", proc)
+    assert "handoff leg ok" in proc.stdout
+    assert "fallback leg ok" in proc.stdout
+    assert "autoswitch leg ok" in proc.stdout
+    assert "metric: disagg_probe_ok" in proc.stdout
 
 
 def test_sim_probe_runs():

@@ -57,6 +57,10 @@ run 900 integrity_probe python tools/integrity_probe.py
 #     policy-regression baseline with detune teeth (virtual clock,
 #     host-side only; cheap, stays ahead of the long benches).
 run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
+# 1j. Disaggregated prefill/decode plane: KV adoption handshake parity,
+#     snapshot-fallback parity, auto-role switch — the handoff snapshot
+#     is extracted from device-resident KV on the real chip.
+run 900 disagg_probe python tools/disagg_probe.py
 # 1i. Sharding-analysis plane: AST sweep + SPMD collective-signature
 #     diff + detune teeth (CPU subprocesses; cheap, guards the mesh
 #     matrix the benches below depend on).

@@ -46,6 +46,10 @@ run 900 metrics_probe env LLMQ_METRICS_PORT=0 python tools/metrics_probe.py
 # and a seeded kill-resume mini-chaos on the memory broker — proves
 # crash-resume holds with device-resident KV, not just on CPU.
 run 900 snapshot_probe python tools/snapshot_probe.py
+# Disaggregated prefill/decode plane: ship-path KV adoption parity,
+# snapshot-fallback parity, and the auto-role depth controller — the
+# phase-boundary handoff runs with device-resident prompt KV here.
+run 900 disagg_probe python tools/disagg_probe.py
 # Fleet-wide prefix-cache plane: intra-engine reuse parity, host-tier
 # demote->promote parity, and a two-worker page ship over the memory
 # broker — proves the KV gather/scatter paths on the real chip, not
