@@ -517,6 +517,7 @@ def trim_plan(
     mixed_s: float = 0.0,
     prefix_s: float = 0.0,
     disagg_s: float = 0.0,
+    pp_s: float = 0.0,
 ) -> dict:
     """Budget-aware phase trimming (pure — unit-tested in
     tests/test_bench.py). Given the seconds left on LLMQ_BENCH_DEADLINE
@@ -538,11 +539,17 @@ def trim_plan(
       winning point (``prefix_s`` one extra build + a cold/warm pair),
     - ``disagg_rung``: the in-process two-pool prefill/decode A/B at the
       winning point (``disagg_s``: two extra builds + a unified
-      reference pass + the pipelined handoff pass).
+      reference pass + the pipelined handoff pass),
+    - ``pp_rung``: the pipeline-parallel staged-engine rung at the
+      winning point (``pp_s``: one extra build over the pp=2 mesh + a
+      measure pass; a no-op rung on single-device meshes).
 
     The proven bf16 headline (``proven_s``) is the floor and is never
     dropped — a bench that measures *something* always beats a watchdog
-    0.0. Drop order is by speculation: the disagg rung first (purely
+    0.0. Drop order is by speculation: the pp rung first (the model
+    FITS one host here by construction — the rung only prices the
+    bubble fraction and stage-boundary bytes a real multi-host pipeline
+    would pay, never the headline number), then the disagg rung (purely
     diagnostic like the prefix rung, and the most builds per datapoint —
     it reports handoff latency and pool-split deltas, never the headline
     number), then the prefix rung (it reports a hit
@@ -562,6 +569,7 @@ def trim_plan(
     """
     # (name, cost) in DROP order: most speculative first.
     phases = (
+        ("pp_rung", pp_s),
         ("disagg_rung", disagg_s),
         ("prefix_rung", prefix_s),
         ("int4_ladder", int4_s),
@@ -758,6 +766,9 @@ def main() -> None:
         # The disaggregated two-pool rung is three extra builds (unified
         # reference + prefill pool + decode pool) at the winning point.
         disagg_s=420.0,
+        # The pipeline-parallel rung is one extra build (pp=2 staged
+        # mesh, per-stage executables) + measure at the winning point.
+        pp_s=300.0,
         proven_s=300.0,
     )
     if not all(plan.values()):
@@ -1029,13 +1040,14 @@ def main() -> None:
     )
 
     def build_core(
-        max_seqs, block, spec=0, tp_overlap="off", mixed="off", prefix=False
+        max_seqs, block, spec=0, tp_overlap="off", mixed="off", prefix=False,
+        mesh_override=None,
     ):
         return EngineCore(
             config,
             params,
             ByteTokenizer(),
-            mesh=mesh,
+            mesh=mesh_override if mesh_override is not None else mesh,
             engine_config=EngineConfig(
                 max_num_seqs=max_seqs,
                 max_model_len=1 << (prompt_len + gen_len + 2).bit_length(),
@@ -1600,6 +1612,70 @@ def main() -> None:
 
         gc.collect()
 
+    # Pipeline-parallel rung at the winning (slots, K) point: rebuild
+    # over a pp=2 mesh (layer stack split across two stage submeshes,
+    # activations hopping the boundary host-driven) and re-measure the
+    # headline workload. Diagnostic: the model FITS one host here by
+    # construction, so the rung's product is the measured cost of
+    # staging — tok/s vs the single-stage number, the GPipe bubble
+    # fraction of the run's actual microbatching, and the
+    # stage-boundary activation bytes per generated token (the floor of
+    # what a real cross-host DCN hop would carry). Spec decoding stays
+    # off (the staged engine gates it) and the rung never replaces the
+    # headline.
+    pp_metrics: dict = {}
+    if (
+        plan["pp_rung"]
+        and len(devices) >= 2
+        and os.environ.get("LLMQ_BENCH_TRY_PP", "1").lower()
+        not in ("0", "false")
+    ):
+        try:
+            pp_mesh = make_mesh(devices=devices, pipeline_parallel=2)
+            core = build_core(
+                max_seqs, best_block, 0, mixed=mixed_resolved,
+                mesh_override=pp_mesh,
+            )
+            run(1, "warmup-single")
+            run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+            gen_before = core.total_generated_tokens
+            bytes_before = core.pp_boundary_bytes
+            pp_elapsed = run(n_requests, f"bench-s{max_seqs}-pp2")
+            pp_out = core.total_generated_tokens - gen_before
+            pp_tok_s = pp_out / pp_elapsed
+            pp_stats = core.stats()
+            pp_bytes_tok = (core.pp_boundary_bytes - bytes_before) / pp_out
+            pp_metrics = {
+                "pp_stages": int(pp_stats["pp_stages"]),
+                "pp_tok_s_chip": round(pp_tok_s / len(devices), 2),
+                "pp_vs_unified": round(pp_tok_s / tok_s, 4),
+                "pp_bubble_fraction": round(
+                    float(pp_stats["pp_bubble_fraction"]), 4
+                ),
+                "pp_boundary_bytes_per_token": round(pp_bytes_tok, 1),
+            }
+            print(
+                f"bench: pp rung ({pp_stats['pp_stages']} stages) -> "
+                f"{pp_tok_s:.1f} tok/s "
+                f"({pp_metrics['pp_vs_unified']}x single-stage), bubble "
+                f"{pp_metrics['pp_bubble_fraction']}, "
+                f"{pp_metrics['pp_boundary_bytes_per_token']} boundary "
+                f"bytes/token",
+                file=sys.stderr,
+            )
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                "bench: pp rung exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
     tok_s_chip = tok_s / len(devices)
     # MoE presets: throughput scales with ACTIVE params per token (the
     # FLOPs actually spent), not the total parameter count.
@@ -1654,6 +1730,10 @@ def main() -> None:
         # pool-split throughput, handoff codec+insert latency, and the
         # TTFT/ITL deltas vs the unified reference — diagnostics too.
         **disagg_metrics,
+        # Pipeline-parallel rung (absent when trimmed/opted out/single
+        # device): staged-engine throughput vs single-stage, GPipe
+        # bubble fraction, and stage-boundary bytes/token — diagnostics.
+        **pp_metrics,
         **(
             {"kv_dtype": kv_env}
             if kv_env not in ("", "auto")

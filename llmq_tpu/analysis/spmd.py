@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import re
 import subprocess
@@ -54,8 +55,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 #: The measured mesh matrix from tests/test_moe_mixed_mesh.py: the three
 #: known-good meshes plus the five that diverged before the token-axis
-#: pins landed (PR 17).
-MESH_MATRIX: Tuple[Tuple[int, int, int], ...] = (
+#: pins landed (PR 17). Shapes are ``(dp, sp, tp)`` or — for the
+#: pipeline rows — ``(dp, sp, tp, pp)``; pp rows lower every per-stage
+#: executable plus the head program and merge the counts, and the axis
+#: attribution asserts no collective ever carries a ``pp`` label (stage
+#: boundaries move data by explicit host transfer, never a collective).
+MESH_MATRIX: Tuple[Tuple[int, ...], ...] = (
     (2, 1, 1),
     (1, 2, 1),
     (2, 1, 4),
@@ -64,6 +69,8 @@ MESH_MATRIX: Tuple[Tuple[int, int, int], ...] = (
     (2, 2, 2),
     (2, 4, 1),
     (4, 2, 1),
+    (1, 1, 1, 2),
+    (1, 1, 2, 2),
 )
 
 #: ``prefill`` is the batched executable (B = max_prefill_batch);
@@ -101,16 +108,33 @@ _OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
 _SOURCE_RE = re.compile(r'source_file="([^"]+)"[^"]*source_line=(\d+)')
 
 
-def mesh_key(shape: Tuple[int, int, int]) -> str:
+def mesh_key(shape: Tuple[int, ...]) -> str:
     return "x".join(str(n) for n in shape)
 
 
-def parse_mesh_key(key: str) -> Tuple[int, int, int]:
-    dp, sp, tp = (int(part) for part in key.split("x"))
-    return dp, sp, tp
+def parse_mesh_key(key: str) -> Tuple[int, ...]:
+    """``"2x2x2"`` → (dp, sp, tp); ``"1x1x2x2"`` → (dp, sp, tp, pp)."""
+    parts = tuple(int(part) for part in key.split("x"))
+    if len(parts) not in (3, 4):
+        raise ValueError(f"mesh key {key!r} must have 3 or 4 components")
+    return parts
 
 
-def program_key(program: str, shape: Tuple[int, int, int]) -> str:
+def mesh_pp_degree(shape: Tuple[int, ...]) -> int:
+    return shape[3] if len(shape) > 3 else 1
+
+
+def programs_for_shape(
+    shape: Tuple[int, ...], programs: Sequence[str]
+) -> List[str]:
+    """Speculative verify is gated off under pp (the engine raises), so
+    pp rows sign every program except ``verify``."""
+    if mesh_pp_degree(shape) > 1:
+        return [p for p in programs if p != "verify"]
+    return list(programs)
+
+
+def program_key(program: str, shape: Tuple[int, ...]) -> str:
     return f"{program}@{mesh_key(shape)}"
 
 
@@ -160,20 +184,30 @@ def _expand_iota_groups(
     return [ids[i * s : (i + 1) * s] for i in range(g)]
 
 
-def _axes_label(groups: List[List[int]], shape: Tuple[int, int, int]) -> str:
+def _axes_label(groups: List[List[int]], shape: Tuple[int, ...]) -> str:
     """Mesh axes a set of device groups moves data over.
 
     Device ids follow ``make_mesh``'s (dp, sp, tp) row-major grid, so a
     group's coordinates vary exactly on the axes the collective spans:
     tp groups are stride-1 runs, sp groups stride tp, dp groups stride
-    sp*tp, and multi-axis collectives vary several coordinates.
+    sp*tp, and multi-axis collectives vary several coordinates. Under
+    pp the per-stage executables are compiled over 3-axis submeshes
+    whose participant ids live in [0, dp*sp*tp) — an id at or beyond
+    that range means a group straddles a stage boundary, which labels
+    the collective ``pp`` and fails the gate (stage-to-stage data moves
+    by explicit host transfer, never by collective).
     """
-    from llmq_tpu.parallel.mesh import AXIS_NAMES
+    from llmq_tpu.parallel.mesh import AXIS_NAMES  # (dp, sp, tp, pp)
 
-    dp, sp, tp = shape
+    dp, sp, tp = shape[:3]
+    inner = dp * sp * tp
     varying = set()
     for group in groups:
-        coords = [((i // (sp * tp)), (i // tp) % sp, i % tp) for i in group]
+        coords = [
+            ((i % inner) // (sp * tp), ((i % inner) // tp) % sp,
+             (i % inner) % tp, i // inner)
+            for i in group
+        ]
         for axis_idx, name in enumerate(AXIS_NAMES):
             if len({c[axis_idx] for c in coords}) > 1:
                 varying.add(name)
@@ -268,7 +302,7 @@ _VARIANTS: Dict[str, Tuple[Tuple[str, object], ...]] = {
 }
 
 
-def _build_core(shape: Tuple[int, int, int], overrides=()):
+def _build_core(shape: Tuple[int, ...], overrides=()):
     """A tiny-MoE EngineCore on the given mesh. ``__init__`` runs
     ``_resync`` so ``_dev_state`` is live and every jit is buildable."""
     import jax
@@ -279,9 +313,10 @@ def _build_core(shape: Tuple[int, int, int], overrides=()):
     from llmq_tpu.models.transformer import init_params
     from llmq_tpu.parallel.mesh import make_mesh
 
-    dp, sp, tp = shape
+    dp, sp, tp = shape[:3]
     mesh = make_mesh(
-        data_parallel=dp, sequence_parallel=sp, tensor_parallel=tp
+        data_parallel=dp, sequence_parallel=sp, tensor_parallel=tp,
+        pipeline_parallel=mesh_pp_degree(shape),
     )
     config = tiny_moe_config()
     params = init_params(config, jax.random.key(0), dtype=jnp.float32)
@@ -314,6 +349,9 @@ def _lower_engine_hlo(core, program: str) -> str:
 
     def sds(a):
         return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    if core.pp > 1:
+        return _lower_engine_pp_hlo(core, program)
 
     params = jax.tree.map(sds, core.params)
     kp, vp = sds(core.k_pages), sds(core.v_pages)
@@ -357,7 +395,88 @@ def _lower_engine_hlo(core, program: str) -> str:
     return lowered.compile().as_text()
 
 
-def lower_program_hlo(program: str, shape: Tuple[int, int, int]) -> str:
+def _lower_engine_pp_hlo(core, program: str) -> str:
+    """Concatenated compiled HLO of every per-stage executable plus the
+    head program (pp > 1 engines compile one module per stage, chained
+    by the host drivers). Concatenation is the right merge for the
+    signature: counts are per-line, so the sum over stages falls out —
+    and each stage's replica ids live in [0, dp*sp*tp), which is what
+    lets ``_axes_label`` certify no collective crosses a stage boundary.
+    """
+    import jax
+    import numpy as np
+
+    def sds(a):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    i32 = np.int32
+    pp = core.pp
+    st = jax.tree.map(sds, core._dev_state)
+    stage_params = [
+        jax.tree.map(sds, tree) for tree in core.params["stages"]
+    ]
+    stage_kv = [sds(kp) for kp in core.k_pages]
+    pps = core._pages_per_seq
+    texts: List[str] = []
+
+    def run_chain(stage_jits, head_jit, stage_data, head_extra):
+        """Lower stage 0..pp-2 then the head, threading the hidden-grid
+        ShapeDtypeStruct exactly as the host drivers thread the array."""
+        h = None
+        for s in range(pp - 1):
+            args = (stage_params[s], stage_kv[s], stage_kv[s])
+            args += stage_data + ((h,) if s > 0 else ())
+            lowered = stage_jits[s].lower(*args)
+            texts.append(lowered.compile().as_text())
+            h = jax.eval_shape(stage_jits[s], *args)[0]
+        lowered = head_jit.lower(
+            stage_params[-1], stage_kv[-1], stage_kv[-1], h, *head_extra
+        )
+        texts.append(lowered.compile().as_text())
+
+    if program == "decode":
+        # Driver ships (st[0] tokens, st[1] ctx, st[2] bt, st[3] active).
+        run_chain(
+            core._pp_decode_stage,
+            core._pp_decode_head["greedy"],
+            (st[0], st[1], st[2], st[3]),
+            (st,),
+        )
+    elif program in ("prefill", "prefill1"):
+        batch = 1 if program == "prefill1" else core.cfg.max_prefill_batch
+        bucket = core.cfg.max_model_len
+        tok = jax.ShapeDtypeStruct((batch, bucket), i32)
+        lens = jax.ShapeDtypeStruct((batch,), i32)
+        bt = jax.ShapeDtypeStruct((batch, pps), i32)
+        rows = tuple(sds(r) for r in core._pack_sampling_rows([], batch))
+        run_chain(
+            core._pp_prefill_stage,
+            core._pp_prefill_head["greedy"],
+            (tok, lens, bt),
+            (tok, lens, bt) + rows + (st,),
+        )
+    elif program == "mixed":
+        chunk = core.cfg.prefill_chunk_size
+        seg_t = jax.ShapeDtypeStruct((chunk,), i32)
+        seg_p = jax.ShapeDtypeStruct((chunk,), i32)
+        seg_f = jax.ShapeDtypeStruct((), np.bool_)
+        seg_l = jax.ShapeDtypeStruct((), i32)
+        m_bt = jax.ShapeDtypeStruct((1, pps), i32)
+        m_lens = jax.ShapeDtypeStruct((1,), i32)
+        rows = tuple(sds(r) for r in core._pack_sampling_rows([], 1))
+        run_chain(
+            core._pp_mixed_stage,
+            core._pp_mixed_head["greedy"],
+            (st[0], st[1], st[3], st[2], seg_t, seg_p, seg_l, m_bt,
+             rows[0]),
+            (seg_t, seg_p, seg_f, seg_l, m_bt, m_lens) + rows + (st,),
+        )
+    else:
+        raise ValueError(f"program {program!r} not lowered under pp")
+    return "\n".join(texts)
+
+
+def lower_program_hlo(program: str, shape: Tuple[int, ...]) -> str:
     """One-shot convenience: build the right engine variant and lower."""
     core = _build_core(shape, _VARIANTS[program])
     try:
@@ -379,7 +498,7 @@ def collect_signatures(
     out: Dict[str, Dict[str, object]] = {}
     for shape in meshes:
         by_variant: Dict[Tuple, List[str]] = {}
-        for program in programs:
+        for program in programs_for_shape(shape, programs):
             by_variant.setdefault(_VARIANTS[program], []).append(program)
         for overrides, group in by_variant.items():
             core = _build_core(shape, overrides)
@@ -431,6 +550,14 @@ def diff_signatures(
             continue
         for ckey in sorted(set(counts) | set(base)):
             now, then = counts.get(ckey, 0), base.get(ckey, 0)
+            axes = ckey.split("@", 1)[1] if "@" in ckey else ""
+            if now > 0 and "pp" in axes.split("+"):
+                failures.append(
+                    f"{key}: collective crosses a pipeline-stage "
+                    f"boundary: {ckey} (x{now}) — nearest op: "
+                    f"{ops.get(ckey, '?')}"
+                )
+                continue
             if now > then:
                 failures.append(
                     f"{key}: NEW resharding collective {ckey} "
@@ -533,7 +660,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     meshes, programs = _selected(args)
-    needed = max(dp * sp * tp for dp, sp, tp in meshes)
+    needed = max(math.prod(shape) for shape in meshes)
     have = len(jax.devices())
     if have < needed:
         print(

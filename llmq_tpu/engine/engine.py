@@ -118,7 +118,14 @@ from llmq_tpu.obs.trace import emit_trace_event
 from llmq_tpu.ops import dispatch as _dispatch
 from llmq_tpu.utils.host_mem import get_governor
 from llmq_tpu.ops.attention import mixed_query_grid
-from llmq_tpu.parallel.mesh import DP_AXIS, SP_AXIS, TP_AXIS, make_mesh
+from llmq_tpu.parallel import pipeline as pp_mod
+from llmq_tpu.parallel.mesh import (
+    DP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    make_mesh,
+    mesh_pp,
+)
 from llmq_tpu.parallel.sharding import kv_page_pspec, param_shardings
 
 logger = logging.getLogger(__name__)
@@ -450,6 +457,32 @@ class EngineCore:
         self.tokenizer = tokenizer
         self.cfg = engine_config or EngineConfig()
         self.mesh = mesh if mesh is not None else make_mesh(tensor_parallel=1)
+        # Pipeline parallelism: a (pp, dp, sp, tp) mesh is carved into pp
+        # independent 3-axis stage submeshes; NOTHING is ever sharded
+        # over pp. `self.mesh` is rebound to the LAST (head) stage's
+        # submesh so every existing slot sharding, decode-state leaf and
+        # sampler binding stays exactly where pp=1 put it — the head
+        # stage owns decode state, sampling and the logits matmul, and
+        # earlier stages only ever see (tokens, positions, block tables,
+        # hidden states).
+        self.full_mesh = self.mesh
+        self.pp = mesh_pp(self.mesh)
+        if self.pp > 1:
+            if self.cfg.spec_tokens > 0:
+                raise ValueError(
+                    "spec_tokens > 0 with pp > 1 is not supported: the "
+                    "draft/verify loop needs the full layer stack in one "
+                    "executable (stage-split verify would ship hidden "
+                    "states per candidate token)"
+                )
+            self._stage_meshes = pp_mod.stage_submeshes(self.mesh)
+            self._stage_ranges = pp_mod.stage_layer_ranges(
+                model_config.num_layers, self.pp
+            )
+            self.mesh = self._stage_meshes[-1]
+        else:
+            self._stage_meshes = [self.mesh]
+            self._stage_ranges = [(0, model_config.num_layers)]
         # Resolved once, before any trace: the mode is a static field on
         # the frozen Transformer, so every jit variant (prefill buckets,
         # decode, verify, chunked prefill) sees the same choice and the
@@ -462,14 +495,55 @@ class EngineCore:
             max_seqs=self.cfg.max_num_seqs,
             logger=logger,
         )
-        self.model = Transformer(
-            model_config, mesh=self.mesh, tp_overlap=self.tp_overlap
-        )
-
-        self._param_shardings = param_shardings(
-            self.mesh, model_config, params=params
-        )
-        self.params = jax.tree.map(jax.device_put, params, self._param_shardings)
+        if self.pp > 1:
+            # One Transformer + param subtree + sharding tree per stage.
+            # The stage field confines the lax.scan to [lo, hi) layers
+            # (local KV indices, global sliding-window policy); the
+            # param/sharding trees are generic pytrees under a "stages"
+            # key so tree-wide consumers (digest_params, the weight
+            # audit) walk them unchanged.
+            tied = "lm_head" not in params
+            stage_models = []
+            stage_trees = []
+            stage_shardings = []
+            for s, (lo, hi) in enumerate(self._stage_ranges):
+                sub = pp_mod.slice_stage_params(
+                    params,
+                    lo,
+                    hi,
+                    num_layers=model_config.num_layers,
+                    tied_embeddings=tied,
+                )
+                stage_models.append(
+                    Transformer(
+                        model_config,
+                        mesh=self._stage_meshes[s],
+                        tp_overlap=self.tp_overlap,
+                        stage=(lo, hi),
+                    )
+                )
+                sh = param_shardings(
+                    self._stage_meshes[s], model_config, params=sub
+                )
+                stage_trees.append(
+                    jax.tree.map(jax.device_put, sub, sh)
+                )
+                stage_shardings.append(sh)
+            self._stage_models = stage_models
+            self.model = stage_models[-1]
+            self.params = {"stages": stage_trees}
+            self._param_shardings = {"stages": stage_shardings}
+        else:
+            self._stage_models = None
+            self.model = Transformer(
+                model_config, mesh=self.mesh, tp_overlap=self.tp_overlap
+            )
+            self._param_shardings = param_shardings(
+                self.mesh, model_config, params=params
+            )
+            self.params = jax.tree.map(
+                jax.device_put, params, self._param_shardings
+            )
 
         if self.cfg.enable_prefix_caching and not self.cfg.prefill_chunk_size:
             raise ValueError(
@@ -489,29 +563,63 @@ class EngineCore:
         self.scheduler.on_preempt = self._on_scheduler_preempt
         self._pages_per_seq = sched_cfg.pages_per_seq
 
-        self._kv_sharding = NamedSharding(
-            self.mesh, kv_page_pspec(model_config, self.mesh.shape[TP_AXIS])
-        )
         # Pin the KV pool to row-major layout at every jit boundary. Left
         # to itself XLA picks a different parameter layout than the Pallas
         # custom call's required default, then inserts FOUR full-pool
         # transpose copies per step in the entry computation (~12 ms/step
         # at 3B — measured round 2; dwarfs the attention kernel itself).
-        self._kv_format = Format(
-            Layout(tuple(range(5))), self._kv_sharding
-        )
-        k_pages, v_pages = make_kv_pages(
-            model_config, num_pages, self.cfg.page_size, dtype=self.cfg.kv_dtype
-        )
-        self.k_pages = jax.device_put(k_pages, self._kv_format)
-        self.v_pages = jax.device_put(v_pages, self._kv_format)
-        logger.info(
-            "KV cache: %d pages x %d tokens (%.2f GiB total), %d slots",
-            num_pages,
-            self.cfg.page_size,
-            2 * k_pages.size * k_pages.dtype.itemsize / 2**30,
-            self.cfg.max_num_seqs,
-        )
+        # Under pp each stage owns its own pool holding just that stage's
+        # [hi-lo] layer slab; every pool shares one page-index space (the
+        # scheduler's), so block tables replicate across stages verbatim.
+        self._kv_shardings = [
+            NamedSharding(m, kv_page_pspec(model_config, m.shape[TP_AXIS]))
+            for m in self._stage_meshes
+        ]
+        self._kv_formats = [
+            Format(Layout(tuple(range(5))), sh) for sh in self._kv_shardings
+        ]
+        self._kv_sharding = self._kv_shardings[-1]
+        self._kv_format = self._kv_formats[-1]
+        if self.pp > 1:
+            self.k_pages = []
+            self.v_pages = []
+            total_bytes = 0
+            for s, (lo, hi) in enumerate(self._stage_ranges):
+                k_s, v_s = make_kv_pages(
+                    model_config,
+                    num_pages,
+                    self.cfg.page_size,
+                    dtype=self.cfg.kv_dtype,
+                    num_layers=hi - lo,
+                )
+                self.k_pages.append(jax.device_put(k_s, self._kv_formats[s]))
+                self.v_pages.append(jax.device_put(v_s, self._kv_formats[s]))
+                total_bytes += 2 * k_s.size * k_s.dtype.itemsize
+            logger.info(
+                "KV cache: %d pages x %d tokens (%.2f GiB total over %d "
+                "pipeline stages), %d slots",
+                num_pages,
+                self.cfg.page_size,
+                total_bytes / 2**30,
+                self.pp,
+                self.cfg.max_num_seqs,
+            )
+        else:
+            k_pages, v_pages = make_kv_pages(
+                model_config,
+                num_pages,
+                self.cfg.page_size,
+                dtype=self.cfg.kv_dtype,
+            )
+            self.k_pages = jax.device_put(k_pages, self._kv_format)
+            self.v_pages = jax.device_put(v_pages, self._kv_format)
+            logger.info(
+                "KV cache: %d pages x %d tokens (%.2f GiB total), %d slots",
+                num_pages,
+                self.cfg.page_size,
+                2 * k_pages.size * k_pages.dtype.itemsize / 2**30,
+                self.cfg.max_num_seqs,
+            )
 
         # Slot-axis sharding: decode shards the batch over dp when it
         # divides evenly; otherwise slots are replicated (tp still shards
@@ -614,6 +722,14 @@ class EngineCore:
         self.prefix_host_gb = host_gb
         self.prefix_store = None
         if host_gb > 0:
+            if self.pp > 1:
+                raise ValueError(
+                    "prefix_host_gb > 0 with pp > 1 is not supported: the "
+                    "host cold tier demotes single-pool pages; per-stage "
+                    "pools need a per-stage demote path (device-level "
+                    "prefix caching itself works — stage pools share the "
+                    "page-index space)"
+                )
             if not self.cfg.enable_prefix_caching:
                 raise ValueError(
                     "prefix_host_gb > 0 requires enable_prefix_caching: "
@@ -666,6 +782,12 @@ class EngineCore:
             self.logit_guard = guard
         else:
             self.logit_guard = self.cfg.logit_guard
+        if self.logit_guard == "on" and self.pp > 1:
+            raise ValueError(
+                "logit_guard=on with pp > 1 is not supported: the guard "
+                "widens every jit's output tuple, and the pp drivers "
+                "re-dispatch those tuples across stage boundaries"
+            )
         self.guard_logit_max = self.cfg.guard_logit_max
         env_gmax = os.environ.get("LLMQ_GUARD_LOGIT_MAX", "").strip()
         if env_gmax:
@@ -708,6 +830,14 @@ class EngineCore:
                 "dispatch piggybacks a prefill *chunk* onto the decode "
                 "batch (bucketed whole-prompt prefill has no chunks)"
             )
+        # LLMQ_PP_WIRE=1 routes every stage-boundary hidden-state handoff
+        # through the snapshot wire codec (serialize → frame → decode →
+        # device_put) instead of a direct device_put. Lossless — the
+        # codec round-trips raw bytes — so greedy parity holds; it is the
+        # single-process stand-in for the inter-host tcp:// hop and keeps
+        # the wire format honest (the same frames ship over DCN when
+        # stages live on different hosts).
+        self.pp_wire = os.environ.get("LLMQ_PP_WIRE", "0") == "1"
         self._buckets = _prefill_buckets(
             self.cfg, sp=int(self.mesh.shape.get(SP_AXIS, 1))
         )
@@ -793,6 +923,11 @@ class EngineCore:
         self.hbm_oom_events = 0  # allocation faults the ladder absorbed
         # Numerics-integrity counters (superset-only in stats: all stay
         # at zero — and their stats keys absent — with the knobs off).
+        # Pipeline-parallel boundary accounting (pp > 1 only; superset-
+        # only keys in stats). One "transfer" is one stage→stage hidden-
+        # state handoff; bytes count the [rows, T, H] activation payload.
+        self.pp_boundary_bytes = 0
+        self.pp_boundary_transfers = 0
         self.guard_trips = 0  # dispatches whose on-device guard fired
         self.weight_audits = 0  # background/on-demand digest sweeps run
         self.weight_audit_mismatches = 0  # leaves whose HBM digest changed
@@ -1083,10 +1218,12 @@ class EngineCore:
                 jnp.any(steps < mins), apply, lambda l: l, logits
             )
 
-        def decode_step(params, kp, vp, st, *, mode):
+        def decode_step(params, kp, vp, st, *, mode, h=None):
             (tokens, ctx, bt, active, keys, steps, temps, topks,
              topps, _limits, mins, stop_ids) = st
-            logits, kp, vp = model.decode(params, tokens, ctx, kp, vp, bt, active)
+            logits, kp, vp = model.decode(
+                params, tokens, ctx, kp, vp, bt, active, h=h
+            )
             # Guard reads the raw model logits: suppress_stops writes
             # NEG_INF sentinels that would false-trip the magnitude lane.
             g = guard_stats(logits, active) if guard else None
@@ -1335,11 +1472,11 @@ class EngineCore:
 
         def prefill_step(params, kp, vp, p_tokens, p_lengths, p_bt, p_slots,
                          p_keys, p_steps, p_temps, p_topks, p_topps,
-                         p_limits, p_mins, p_stopids, *rest, mode):
+                         p_limits, p_mins, p_stopids, *rest, mode, h=None):
             # rest = (p_history, st) under speculation, (st,) otherwise.
             p_history, st = rest if spec else (None, rest[0])
             logits, kp, vp = model.prefill(
-                params, p_tokens, p_lengths, kp, vp, p_bt
+                params, p_tokens, p_lengths, kp, vp, p_bt, h=h
             )
             g = guard_stats(logits, p_slots >= 0) if guard else None
             out, st = sample_and_scatter(
@@ -1354,14 +1491,14 @@ class EngineCore:
         def chunkfill_step(params, kp, vp, c_tokens, c_positions, c_bt,
                            c_final, c_last, c_lengths, c_slots, c_keys,
                            c_steps, c_temps, c_topks, c_topps, c_limits,
-                           c_mins, c_stopids, *rest, mode):
+                           c_mins, c_stopids, *rest, mode, h=None):
             """One chunk of prompt positions for up to B rows. Rows whose
             prompt ENDS in this chunk (c_final) sample their first token
             and scatter into the decode state exactly like prefill_step;
             other rows only extend their cached K/V."""
             c_history, st = rest if spec else (None, rest[0])
             logits, kp, vp = model.prefill_chunk(
-                params, c_tokens, c_positions, kp, vp, c_bt, c_last
+                params, c_tokens, c_positions, kp, vp, c_bt, c_last, h=h
             )
             # Guard watches every valid row's chunk logits (non-final
             # rows too: mid-prompt logits are real model outputs, so
@@ -1479,6 +1616,45 @@ class EngineCore:
             )
             return outs, kp, vp, st
 
+        def mixed_iter(params, kp, vp, h, seg_tokens, seg_positions,
+                       seg_final, seg_last, m_bt, m_lengths, m_slots,
+                       m_keys, m_steps, m_temps, m_topks, m_topps,
+                       m_limits, m_mins, m_stopids, st, *, mode):
+            """ONE iteration of the mixed scan body, h-threaded — the pp
+            head-stage executable (the host drives the K loop because
+            every iteration's hidden states cross stage boundaries).
+            Math is line-for-line the scan body above minus the guard and
+            speculation branches, both of which are gated off under pp."""
+            slot = m_slots[0]
+            (tokens, ctx, bt, active, keys, steps, temps, topks,
+             topps, limits, mins, stop_ids) = st
+            qtok, qpos, is_chunk = mixed_query_grid(
+                tokens, ctx, active, seg_tokens, seg_positions,
+                slot, max_kv_pos,
+            )
+            gather = jnp.where(is_chunk, seg_last, 0)
+            bt_used = bt.at[slot].set(m_bt[0])
+            logits, kp, vp = model.mixed(
+                params, qtok, qpos, kp, vp, bt_used, gather, h=h
+            )
+            d_logits = suppress_stops(logits, stop_ids, steps, mins)
+            next_tokens = sample_tokens(
+                d_logits, keys, steps, temps, topks, topps, mode=mode
+            )
+            out = jnp.where(active, next_tokens, 0)
+            st = advance_state(st, out, active)
+            out1, st = sample_and_scatter(
+                logits[slot][None],
+                seg_final[None] & (m_slots >= 0),
+                m_lengths, m_bt, m_slots, m_keys, m_steps, m_temps,
+                m_topks, m_topps, m_limits, m_mins, m_stopids, st,
+                mode=mode,
+            )
+            emit = jnp.where(
+                (jnp.arange(S) == slot) & seg_final, out1[0], out
+            )
+            return emit, kp, vp, st
+
         repl, slot1, slot2 = self._repl, self._slot1, self._slot2
         kv = self._kv_format
         st_sh = (slot1, slot1, slot2, slot1, slot2, slot1, slot1, slot1,
@@ -1493,6 +1669,15 @@ class EngineCore:
         self._prefill_fn = prefill_step
         self._chunkfill_fn = chunkfill_step
         self._mixedfill_fn = mixedfill_step
+        self._mixed_iter_fn = mixed_iter
+        if self.pp > 1:
+            self._build_pp_jits(
+                decode_step=decode_step,
+                prefill_step=prefill_step,
+                chunkfill_step=chunkfill_step,
+                mixed_iter=mixed_iter,
+            )
+            return
         self._make_jits(self._param_shardings)
 
     def _make_jits(self, param_spec) -> None:
@@ -1596,6 +1781,406 @@ class EngineCore:
                 for mode in ("greedy", "stochastic", "filtered")
             }
 
+    def _build_pp_jits(
+        self, *, decode_step, prefill_step, chunkfill_step, mixed_iter
+    ) -> None:
+        """Stage-partitioned executables + the host drivers that chain
+        them (pp > 1). Each NON-HEAD stage compiles one executable per
+        dispatch kind over its own 3-axis submesh — (stage params, stage
+        KV pools, data args[, upstream hidden]) → (hidden grid, pools) —
+        and the HEAD stage compiles the existing per-mode step closures
+        with the upstream hidden threaded in, so sampling, decode-state
+        advance and donation are bit-for-bit the pp=1 programs. The
+        drivers installed into ``_decode_jits``/``_prefill_jits``/
+        ``_chunkfill_jits``/``_mixedfill_jits`` keep the pp=1 call
+        signatures (kp/vp become per-stage lists), which leaves every
+        dispatch site untouched.
+
+        GPipe microbatching falls out of the call structure: prefill
+        chunks are the microbatches (the chunk loop keeps stage s busy on
+        chunk i+1 while stage s+1 runs chunk i, because every jit call
+        here is an async dispatch), and decode amortizes fill/drain over
+        ``decode_block`` iterations per dispatch × ``runahead`` dispatches
+        in flight."""
+        pp = self.pp
+        repl = self._repl
+        st_sh = self._st_shardings
+        stage_params = self._param_shardings["stages"]
+        self._stage_repl = [
+            NamedSharding(m, P()) for m in self._stage_meshes
+        ]
+        max_kv_pos = self._pages_per_seq * self.cfg.page_size
+
+        # --- per-stage (non-head) executables --------------------------
+        def stage_jit(fn, s, n_data, with_h):
+            kv_s = self._kv_formats[s]
+            repl_s = self._stage_repl[s]
+            n = n_data + (1 if with_h else 0)
+            return jax.jit(
+                fn,
+                in_shardings=(stage_params[s], kv_s, kv_s)
+                + (repl_s,) * n,
+                out_shardings=(repl_s, kv_s, kv_s),
+                donate_argnums=(1, 2),
+            )
+
+        def make_stage_fns(s):
+            model_s = self._stage_models[s]
+            first = s == 0
+
+            if first:
+                def dec(params, kp, vp, tokens, ctx, bt, active):
+                    return model_s.decode(
+                        params, tokens, ctx, kp, vp, bt, active,
+                        return_hidden=True,
+                    )
+
+                def pre(params, kp, vp, tokens, lengths, bt):
+                    return model_s.prefill(
+                        params, tokens, lengths, kp, vp, bt,
+                        return_hidden=True,
+                    )
+
+                def chk(params, kp, vp, tokens, positions, bt):
+                    return model_s._paged_chunk_trunk(
+                        params, tokens, positions, kp, vp, bt
+                    )
+
+                def mix(params, kp, vp, tokens, ctx, active, bt,
+                        seg_tokens, seg_positions, seg_last, m_bt, m_slots):
+                    slot = m_slots[0]
+                    qtok, qpos, is_chunk = mixed_query_grid(
+                        tokens, ctx, active, seg_tokens, seg_positions,
+                        slot, max_kv_pos,
+                    )
+                    gather = jnp.where(is_chunk, seg_last, 0)
+                    bt_used = bt.at[slot].set(m_bt[0])
+                    return model_s.mixed(
+                        params, qtok, qpos, kp, vp, bt_used, gather,
+                        return_hidden=True,
+                    )
+            else:
+                def dec(params, kp, vp, tokens, ctx, bt, active, h):
+                    return model_s.decode(
+                        params, tokens, ctx, kp, vp, bt, active, h=h,
+                        return_hidden=True,
+                    )
+
+                def pre(params, kp, vp, tokens, lengths, bt, h):
+                    return model_s.prefill(
+                        params, tokens, lengths, kp, vp, bt, h=h,
+                        return_hidden=True,
+                    )
+
+                def chk(params, kp, vp, tokens, positions, bt, h):
+                    return model_s._paged_chunk_trunk(
+                        params, tokens, positions, kp, vp, bt, h=h
+                    )
+
+                def mix(params, kp, vp, tokens, ctx, active, bt,
+                        seg_tokens, seg_positions, seg_last, m_bt,
+                        m_slots, h):
+                    slot = m_slots[0]
+                    qtok, qpos, is_chunk = mixed_query_grid(
+                        tokens, ctx, active, seg_tokens, seg_positions,
+                        slot, max_kv_pos,
+                    )
+                    gather = jnp.where(is_chunk, seg_last, 0)
+                    bt_used = bt.at[slot].set(m_bt[0])
+                    return model_s.mixed(
+                        params, qtok, qpos, kp, vp, bt_used, gather, h=h,
+                        return_hidden=True,
+                    )
+            return dec, pre, chk, mix
+
+        self._pp_decode_stage = []
+        self._pp_prefill_stage = []
+        self._pp_chunk_stage = []
+        self._pp_mixed_stage = []
+        for s in range(pp - 1):
+            dec, pre, chk, mix = make_stage_fns(s)
+            with_h = s > 0
+            self._pp_decode_stage.append(stage_jit(dec, s, 4, with_h))
+            self._pp_prefill_stage.append(stage_jit(pre, s, 3, with_h))
+            self._pp_chunk_stage.append(stage_jit(chk, s, 3, with_h))
+            self._pp_mixed_stage.append(stage_jit(mix, s, 9, with_h))
+
+        # --- head-stage executables (per sampler mode) -----------------
+        head_sh = stage_params[-1]
+        kv = self._kv_format  # head stage pool format
+
+        def head_decode(params, kp, vp, h, st, *, mode):
+            return decode_step(params, kp, vp, st, mode=mode, h=h)
+
+        def head_prefill(params, kp, vp, h, *rest, mode):
+            *data, st = rest
+            return prefill_step(params, kp, vp, *data, st, mode=mode, h=h)
+
+        def head_chunkfill(params, kp, vp, h, *rest, mode):
+            *data, st = rest
+            return chunkfill_step(
+                params, kp, vp, *data, st, mode=mode, h=h
+            )
+
+        modes = ("greedy", "stochastic", "filtered")
+        self._pp_decode_head = {
+            mode: jax.jit(
+                partial(head_decode, mode=mode),
+                in_shardings=(head_sh, kv, kv, repl, st_sh),
+                out_shardings=(self._slot1, kv, kv, st_sh),
+                donate_argnums=(1, 2, 4),
+            )
+            for mode in modes
+        }
+        nP = len(self._prefill_arg_shardings)  # 12 (spec gated off)
+        self._pp_prefill_head = {
+            mode: jax.jit(
+                partial(head_prefill, mode=mode),
+                in_shardings=(head_sh, kv, kv, repl)
+                + (repl,) * nP
+                + (st_sh,),
+                out_shardings=(repl, kv, kv, st_sh),
+                donate_argnums=(1, 2, 4 + nP),
+            )
+            for mode in modes
+        }
+        nC = nP + 3
+        self._pp_chunkfill_head = {
+            mode: jax.jit(
+                partial(head_chunkfill, mode=mode),
+                in_shardings=(head_sh, kv, kv, repl)
+                + (repl,) * nC
+                + (st_sh,),
+                out_shardings=(repl, kv, kv, st_sh),
+                donate_argnums=(1, 2, 4 + nC),
+            )
+            for mode in modes
+        }
+        nM = nP + 3  # 4 per-iteration seg args + m_bt + 10 piggy-row args
+        self._pp_mixed_head = {
+            mode: jax.jit(
+                partial(mixed_iter, mode=mode),
+                in_shardings=(head_sh, kv, kv, repl)
+                + (repl,) * nM
+                + (st_sh,),
+                out_shardings=(self._slot1, kv, kv, st_sh),
+                donate_argnums=(1, 2, 4 + nM),
+            )
+            for mode in modes
+        }
+        # Per-stage KV whole-page scatter (restore/prefix-ingest path).
+        self._kv_insert_jits = [
+            jax.jit(
+                _dispatch.insert_kv_pages,
+                in_shardings=(
+                    self._kv_formats[s],
+                    self._stage_repl[s],
+                    self._stage_repl[s],
+                ),
+                out_shardings=self._kv_formats[s],
+                donate_argnums=(0,),
+            )
+            for s in range(pp)
+        ]
+
+        # --- host drivers (installed under the pp=1 jit-dict names) ----
+        K = self.cfg.decode_block
+
+        def decode_driver(params, kps, vps, st, *, mode):
+            outs = []
+            for _ in range(K):
+                h = None
+                for s in range(pp - 1):
+                    t_s, c_s, b_s, a_s = self._ship(
+                        (st[0], st[1], st[2], st[3]), s
+                    )
+                    if s == 0:
+                        h, kps[0], vps[0] = self._pp_decode_stage[0](
+                            params["stages"][0], kps[0], vps[0],
+                            t_s, c_s, b_s, a_s,
+                        )
+                    else:
+                        h, kps[s], vps[s] = self._pp_decode_stage[s](
+                            params["stages"][s], kps[s], vps[s],
+                            t_s, c_s, b_s, a_s, self._ship_h(h, s),
+                        )
+                out, kps[-1], vps[-1], st = self._pp_decode_head[mode](
+                    params["stages"][-1], kps[-1], vps[-1],
+                    self._ship_h(h, pp - 1), st,
+                )
+                outs.append(out)
+            block = outs[0] if K == 1 else jnp.stack(outs)
+            return block, kps, vps, st
+
+        def prefill_driver(params, kps, vps, *rest, mode):
+            *data, st = rest
+            p_tokens, p_lengths, p_bt = data[0], data[1], data[2]
+            h = None
+            for s in range(pp - 1):
+                t_s, l_s, b_s = self._ship((p_tokens, p_lengths, p_bt), s)
+                if s == 0:
+                    h, kps[0], vps[0] = self._pp_prefill_stage[0](
+                        params["stages"][0], kps[0], vps[0], t_s, l_s, b_s
+                    )
+                else:
+                    h, kps[s], vps[s] = self._pp_prefill_stage[s](
+                        params["stages"][s], kps[s], vps[s],
+                        t_s, l_s, b_s, self._ship_h(h, s),
+                    )
+            out, kps[-1], vps[-1], st = self._pp_prefill_head[mode](
+                params["stages"][-1], kps[-1], vps[-1],
+                self._ship_h(h, pp - 1), *data, st,
+            )
+            return out, kps, vps, st
+
+        def chunkfill_driver(params, kps, vps, *rest, mode):
+            *data, st = rest
+            c_tokens, c_positions, c_bt = data[0], data[1], data[2]
+            h = None
+            for s in range(pp - 1):
+                t_s, p_s, b_s = self._ship((c_tokens, c_positions, c_bt), s)
+                if s == 0:
+                    h, kps[0], vps[0] = self._pp_chunk_stage[0](
+                        params["stages"][0], kps[0], vps[0], t_s, p_s, b_s
+                    )
+                else:
+                    h, kps[s], vps[s] = self._pp_chunk_stage[s](
+                        params["stages"][s], kps[s], vps[s],
+                        t_s, p_s, b_s, self._ship_h(h, s),
+                    )
+            out, kps[-1], vps[-1], st = self._pp_chunkfill_head[mode](
+                params["stages"][-1], kps[-1], vps[-1],
+                self._ship_h(h, pp - 1), *data, st,
+            )
+            return out, kps, vps, st
+
+        def mixedfill_driver(params, kps, vps, m_tokens, m_positions,
+                             m_final, m_last, m_bt, *rest, mode):
+            *inv, st = rest  # m_lengths, m_slots, ... m_stopids (10)
+            m_slots = inv[1]
+            outs = []
+            for k in range(m_tokens.shape[0]):
+                seg_t = m_tokens[k]
+                seg_p = m_positions[k]
+                seg_f = m_final[k]
+                seg_l = m_last[k]
+                h = None
+                for s in range(pp - 1):
+                    args_s = self._ship(
+                        (st[0], st[1], st[3], st[2],
+                         seg_t, seg_p, seg_l, m_bt, m_slots),
+                        s,
+                    )
+                    tok_s, ctx_s, act_s, bt_s = args_s[:4]
+                    sT, sP, sL, mb_s, ms_s = args_s[4:]
+                    if s == 0:
+                        h, kps[0], vps[0] = self._pp_mixed_stage[0](
+                            params["stages"][0], kps[0], vps[0],
+                            tok_s, ctx_s, act_s, bt_s,
+                            sT, sP, sL, mb_s, ms_s,
+                        )
+                    else:
+                        h, kps[s], vps[s] = self._pp_mixed_stage[s](
+                            params["stages"][s], kps[s], vps[s],
+                            tok_s, ctx_s, act_s, bt_s,
+                            sT, sP, sL, mb_s, ms_s, self._ship_h(h, s),
+                        )
+                out, kps[-1], vps[-1], st = self._pp_mixed_head[mode](
+                    params["stages"][-1], kps[-1], vps[-1],
+                    self._ship_h(h, pp - 1),
+                    seg_t, seg_p, seg_f, seg_l, m_bt, *inv, st,
+                )
+                outs.append(out)
+            return jnp.stack(outs), kps, vps, st
+
+        self._decode_jits = {
+            mode: partial(decode_driver, mode=mode) for mode in modes
+        }
+        self._prefill_jits = {
+            mode: partial(prefill_driver, mode=mode) for mode in modes
+        }
+        self._chunkfill_jits = {
+            mode: partial(chunkfill_driver, mode=mode) for mode in modes
+        }
+        if self.mixed_step == "on":
+            self._mixedfill_jits = {
+                mode: partial(mixedfill_driver, mode=mode)
+                for mode in modes
+            }
+
+    def _ship(self, arrays: tuple, s: int) -> tuple:
+        """Copy per-dispatch data args onto stage ``s``'s submesh
+        (replicated). Small control tensors — tokens, positions, block
+        tables — not the activation payload; those go via _ship_h."""
+        repl_s = self._stage_repl[s]
+        return tuple(jax.device_put(a, repl_s) for a in arrays)
+
+    def _ship_h(self, h, s: int):
+        """Move a hidden-state grid across the stage boundary onto stage
+        ``s``'s submesh. This is THE pipeline wire: device-to-device
+        inside one process; with LLMQ_PP_WIRE=1 the grid round-trips
+        through the snapshot wire codec first (serialize → frame →
+        digest-check → decode), the in-process stand-in for the tcp://
+        hop between stage hosts. Boundary accounting feeds the bench pp
+        rung's bytes/token metric."""
+        self.pp_boundary_transfers += 1
+        self.pp_boundary_bytes += int(h.size) * int(h.dtype.itemsize)
+        if self.pp_wire:
+            # Runs inside the caller's dispatch watchdog bracket
+            # (_wd("prefill"/"decode_block"/"mixed")), which times the
+            # whole stage loop including this fetch.
+            h = snapshot_mod.tensor_from_wire(  # llmq: ignore[unguarded-device-fetch]
+                snapshot_mod.tensor_to_wire(np.asarray(h))
+            )
+        return jax.device_put(h, self._stage_repl[s])
+
+    def _kv_gather_np(self, pages) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather pool pages to host as FULL-layer-stack (k, v) blobs.
+        ``pages`` stays a host/numpy index so each eager gather follows
+        its own pool's devices; under pp the per-stage layer slabs
+        concatenate back to [L, n, page, H, D], so snapshots, swap blobs
+        and prefix chunks are byte-identical to pp=1 (the wire format is
+        pipeline-degree-agnostic). np.asarray blocks until each gather
+        lands, so the host buffers are safe against later donation."""
+        idx = np.asarray(pages, np.int32)  # llmq: ignore[unguarded-device-fetch]
+        # Every call site holds _wd("snapshot_gather"), so these blocking
+        # fetches are already inside a watchdog bracket.
+        if self.pp == 1:
+            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))  # llmq: ignore[unguarded-device-fetch]
+            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))  # llmq: ignore[unguarded-device-fetch]
+            return k, v
+        ks = [
+            np.asarray(_dispatch.gather_kv_pages(kp, idx))  # llmq: ignore[unguarded-device-fetch]
+            for kp in self.k_pages
+        ]
+        vs = [
+            np.asarray(_dispatch.gather_kv_pages(vp, idx))  # llmq: ignore[unguarded-device-fetch]
+            for vp in self.v_pages
+        ]
+        return np.concatenate(ks, axis=0), np.concatenate(vs, axis=0)
+
+    def _kv_insert_np(self, pages, k: np.ndarray, v: np.ndarray) -> None:
+        """Scatter full-layer-stack host KV back into the pool(s),
+        rebinding ``self.k_pages``/``self.v_pages`` to the donated
+        results. Under pp the [L, ...] blob splits into per-stage slabs
+        along the layer axis (the inverse of ``_kv_gather_np``)."""
+        idx = np.asarray(pages, np.int32)  # llmq: ignore[unguarded-device-fetch]
+        if self.pp == 1:
+            self.k_pages = self._kv_insert_jit(
+                self.k_pages, idx, np.ascontiguousarray(k)
+            )
+            self.v_pages = self._kv_insert_jit(
+                self.v_pages, idx, np.ascontiguousarray(v)
+            )
+            return
+        for s, (lo, hi) in enumerate(self._stage_ranges):
+            self.k_pages[s] = self._kv_insert_jits[s](
+                self.k_pages[s], idx, np.ascontiguousarray(k[lo:hi])
+            )
+            self.v_pages[s] = self._kv_insert_jits[s](
+                self.v_pages[s], idx, np.ascontiguousarray(v[lo:hi])
+            )
+
     def _optimize_param_layouts(self) -> None:
         """Pin parameters to the decode executable's PREFERRED layouts
         (LLMQ_PARAM_AUTO_LAYOUT=1). With default row-major inputs XLA
@@ -1604,6 +2189,12 @@ class EngineCore:
         measured round 4); compiling once with AUTO input layouts and
         re-putting the params in whatever XLA chose removes those copies
         for every subsequent step. Costs one extra compile at startup."""
+        if self.pp > 1:
+            # The probe lowers the single-executable decode step; under
+            # pp there is no such executable (per-stage programs + host
+            # driver), so keep the default layouts.
+            logger.info("param auto-layout skipped: pp > 1 engine")
+            return
         auto_ps = jax.tree.map(
             lambda sh: Format(Layout.AUTO, sh), self._param_shardings
         )
@@ -2073,22 +2664,25 @@ class EngineCore:
             return
         if not self._admit_swap_capture(n):
             return  # recompute fallback: re-admission re-prefills
-        idx = jnp.asarray(pages[:n], jnp.int32)
-        # np.asarray blocks until the gather lands, so the fresh host
-        # buffers are safe against the pools' later donation.
+        # The gather helper blocks until the copies land, so the fresh
+        # host buffers are safe against the pools' later donation.
         with self._wd("snapshot_gather"):
-            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
-            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+            k, v = self._kv_gather_np(pages[:n])
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
 
     def _page_host_bytes(self) -> int:
         """Host bytes one swapped KV page costs (K + V)."""
-        per_page = (
-            int(self.k_pages.size)
-            * int(jnp.dtype(self.k_pages.dtype).itemsize)
-        ) // max(1, self.scheduler.config.num_pages)
-        return 2 * per_page
+        if self.pp > 1:
+            k_bytes = sum(
+                int(kp.size) * int(jnp.dtype(kp.dtype).itemsize)
+                for kp in self.k_pages
+            )
+        else:
+            k_bytes = int(self.k_pages.size) * int(
+                jnp.dtype(self.k_pages.dtype).itemsize
+            )
+        return 2 * (k_bytes // max(1, self.scheduler.config.num_pages))
 
     def _admit_swap_capture(self, n_pages: int) -> bool:
         """Ask the host-memory governor before buffering ``n_pages`` of
@@ -2143,10 +2737,8 @@ class EngineCore:
             return
         if not self._admit_swap_capture(n):
             return  # recompute fallback: re-admission re-prefills
-        idx = jnp.asarray(seq.pages[:n], jnp.int32)
         with self._wd("snapshot_gather"):
-            k = np.asarray(_dispatch.gather_kv_pages(self.k_pages, idx))
-            v = np.asarray(_dispatch.gather_kv_pages(self.v_pages, idx))
+            k, v = self._kv_gather_np(seq.pages[:n])
         seq.restore = snapshot_mod.KVRestore(k=k, v=v, valid=valid)
         self.swap_preempts += 1
 
@@ -2192,12 +2784,7 @@ class EngineCore:
             idx = np.asarray([page for page, _, _ in hr], np.int32)  # llmq: ignore[unguarded-device-fetch]
             k = np.concatenate([e.k for _, _, e in hr], axis=1)
             v = np.concatenate([e.v for _, _, e in hr], axis=1)
-            self.k_pages = self._kv_insert_jit(
-                self.k_pages, idx, np.ascontiguousarray(k)
-            )
-            self.v_pages = self._kv_insert_jit(
-                self.v_pages, idx, np.ascontiguousarray(v)
-            )
+            self._kv_insert_np(idx, k, v)
             self.prefix_promotes += len(hr)
 
     def flush_prefix_to_host(self) -> int:
@@ -2233,14 +2820,8 @@ class EngineCore:
             else:
                 page = self.scheduler._prefix_cache.get(key)
                 if page is not None:
-                    idx = jnp.asarray([page], jnp.int32)
                     with self._wd("snapshot_gather"):
-                        k = np.asarray(
-                            _dispatch.gather_kv_pages(self.k_pages, idx)
-                        )
-                        v = np.asarray(
-                            _dispatch.gather_kv_pages(self.v_pages, idx)
-                        )
+                        k, v = self._kv_gather_np([page])
             if k is None:
                 continue
             blob = prefix_mod.chunk_to_bytes(
@@ -3222,14 +3803,8 @@ class EngineCore:
             kv_valid = seq.num_tokens - 1
             n = snapshot_mod.pages_for(kv_valid, self.cfg.page_size)
             if 0 < n <= len(seq.pages):
-                idx = jnp.asarray(seq.pages[:n], jnp.int32)
                 with self._wd("snapshot_gather"):
-                    kv_k = np.asarray(
-                        _dispatch.gather_kv_pages(self.k_pages, idx)
-                    )
-                    kv_v = np.asarray(
-                        _dispatch.gather_kv_pages(self.v_pages, idx)
-                    )
+                    kv_k, kv_v = self._kv_gather_np(seq.pages[:n])
             else:
                 kv_valid = 0
         return RequestSnapshot(
@@ -3541,13 +4116,7 @@ class EngineCore:
             # always covers the ceil(valid/page) pages of data.
             assert n <= len(seq.pages), (n, len(seq.pages))
             # Host page-index list → numpy; no device value involved.
-            idx = np.asarray(seq.pages[:n], np.int32)  # llmq: ignore[unguarded-device-fetch]
-            self.k_pages = self._kv_insert_jit(
-                self.k_pages, idx, np.ascontiguousarray(r.k)
-            )
-            self.v_pages = self._kv_insert_jit(
-                self.v_pages, idx, np.ascontiguousarray(r.v)
-            )
+            self._kv_insert_np(seq.pages[:n], r.k, r.v)
             seq.prefilled = True
             if seq.t_prefill_start == 0.0:
                 seq.t_prefill_start = time.monotonic()
@@ -3678,10 +4247,13 @@ class EngineCore:
         idx = np.asarray(sample, np.int32)  # llmq: ignore[unguarded-device-fetch]
         self.kv_spot_checks += 1
         mismatched: List[str] = []
-        for name, pool in (("k", self.k_pages), ("v", self.v_pages)):
-            with self._wd("kv_spot"):
-                first = np.asarray(_dispatch.gather_kv_pages(pool, idx))
-                second = np.asarray(_dispatch.gather_kv_pages(pool, idx))
+        with self._wd("kv_spot"):
+            # Two independent full gathers (per-stage under pp: the
+            # helper concatenates stage slabs back to the full layer
+            # stack, so one digest still covers every stage's HBM).
+            k1, v1 = self._kv_gather_np(idx)
+            k2, v2 = self._kv_gather_np(idx)
+        for name, first, second in (("k", k1, k2), ("v", v1, v2)):
             # gather returns [L, n, page, kv, d]; digest per sampled page.
             da = integrity_mod.page_digests(np.moveaxis(first, 1, 0))
             db = integrity_mod.page_digests(np.moveaxis(second, 1, 0))
@@ -3773,6 +4345,30 @@ class EngineCore:
         # buffers deleted). KV contents are irrelevant now — every
         # sequence is gone — but the buffers must exist for the next
         # prefill, so rebuild any that died with the failed executable.
+        if self.pp > 1:
+            for s, (lo, hi) in enumerate(self._stage_ranges):
+                try:
+                    dead = (
+                        self.k_pages[s].is_deleted()
+                        or self.v_pages[s].is_deleted()
+                    )
+                except Exception:  # noqa: BLE001
+                    dead = True
+                if dead:
+                    k_s, v_s = make_kv_pages(
+                        self.model_config,
+                        self.scheduler.config.num_pages,
+                        self.cfg.page_size,
+                        dtype=self.cfg.kv_dtype,
+                        num_layers=hi - lo,
+                    )
+                    self.k_pages[s] = jax.device_put(
+                        k_s, self._kv_formats[s]
+                    )
+                    self.v_pages[s] = jax.device_put(
+                        v_s, self._kv_formats[s]
+                    )
+            return
         try:
             dead = self.k_pages.is_deleted() or self.v_pages.is_deleted()
         except Exception:  # noqa: BLE001
@@ -3843,7 +4439,7 @@ class EngineCore:
             prefix_chunks_exported=self.prefix_chunks_exported,
             prefix_chunks_ingested=self.prefix_chunks_ingested,
             tokens_per_sec=self.total_generated_tokens / elapsed,
-            devices=int(np.prod(list(self.mesh.shape.values()))),
+            devices=int(np.prod(list(self.full_mesh.shape.values()))),
             # What this engine actually runs — the autotuned kernel and
             # the pool dtype — so operators can see the calibration in
             # heartbeats instead of guessing from env vars.
@@ -3886,6 +4482,25 @@ class EngineCore:
             )[0]
         if self.prefix_store is not None:
             s.update(self.prefix_store.stats())
+        # Pipeline parallelism (superset-only: pp=1 engines publish
+        # byte-identical heartbeats). The bubble fraction is the GPipe
+        # analytic (pp-1)/(m+pp-1) with the decode run-ahead depth (K
+        # iterations per dispatch × runahead dispatches in flight) as
+        # the microbatch count — the number the bench pp rung reports.
+        if self.pp > 1:
+            m = max(1, self.cfg.decode_block * self.cfg.runahead)
+            s["pp_stages"] = self.pp
+            s["pp_boundary_bytes"] = self.pp_boundary_bytes
+            s["pp_boundary_transfers"] = self.pp_boundary_transfers
+            s["pp_bubble_fraction"] = round(
+                pp_mod.bubble_fraction(m, self.pp), 6
+            )
+            s["pp_boundary_bytes_per_token"] = (
+                pp_mod.boundary_bytes_per_token(
+                    self.model_config.hidden_size
+                )
+            )
+            s["pp_wire"] = "codec" if self.pp_wire else "device"
         # Disaggregated serving (superset-only: appears once this engine
         # has finished a prefill-only request at the phase boundary).
         if self.prefill_done:

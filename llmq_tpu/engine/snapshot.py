@@ -33,15 +33,21 @@ import binascii
 import dataclasses
 import hashlib
 import json
+import os
 import struct
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from llmq_tpu.engine.sampling import SamplingParams
 
 MAGIC = b"LLMQSNAP"
+#: Magic of the transport-level wire frames (length-prefixed binary
+#: snapshot frames and the pipeline-stage tensor frames). Distinct from
+#: the snapshot MAGIC so a decoder can sniff which layer it was handed.
+WIRE_MAGIC = b"LLMQWIRE"
 SNAPSHOT_VERSION = 1
+WIRE_VERSION = 1
 DIGEST_SIZE = 16
 _VER_STRUCT = struct.Struct("<H")
 _LEN_STRUCT = struct.Struct("<I")
@@ -243,6 +249,132 @@ def snapshot_from_b64(data: str) -> RequestSnapshot:
     except (binascii.Error, ValueError) as exc:
         raise SnapshotError(f"snapshot base64 undecodable: {exc}") from None
     return RequestSnapshot.from_bytes(raw)
+
+
+# --- transport wire frames ------------------------------------------------
+#
+# Two encodings of a snapshot for the broker/DCN hop:
+#
+#   b64 (default)  — the base64 string that embeds in JSON bodies; works
+#                    with every transport but costs 4/3 in bytes plus a
+#                    host encode/parse pass (~48 MB for a 1k-token prompt,
+#                    measured PERF_NOTES round 16).
+#   binary         — a length-prefixed frame (WIRE_MAGIC | u32 LE length |
+#                    raw snapshot bytes) for transports that carry bytes
+#                    natively (the tcp:// tier, pipeline-stage hops).
+#
+# ``LLMQ_WIRE_FORMAT=binary`` flips the ENCODER; the decoder is always
+# self-describing (it sniffs magic/type), so mixed fleets can migrate one
+# worker at a time.
+
+
+def wire_format() -> str:
+    fmt = os.environ.get("LLMQ_WIRE_FORMAT", "b64").strip().lower() or "b64"
+    if fmt not in ("b64", "binary"):
+        raise ValueError(
+            f"LLMQ_WIRE_FORMAT={fmt!r} (expected 'b64' or 'binary')"
+        )
+    return fmt
+
+
+def snapshot_to_wire(snap: RequestSnapshot) -> Union[str, bytes]:
+    """Encode for the wire in the configured format (str = b64, bytes =
+    length-prefixed binary frame)."""
+    if wire_format() == "binary":
+        raw = snap.to_bytes()
+        return WIRE_MAGIC + _LEN_STRUCT.pack(len(raw)) + raw
+    return snapshot_to_b64(snap)
+
+
+def snapshot_from_wire(data: Union[str, bytes, bytearray, memoryview]) -> RequestSnapshot:
+    """Decode either wire encoding — the format is sniffed, never
+    configured, so a b64 worker can read a binary peer's frame and vice
+    versa (the integrity digest inside the snapshot bytes still gates
+    every field)."""
+    if isinstance(data, str):
+        return snapshot_from_b64(data)
+    raw = bytes(data)
+    if raw[: len(WIRE_MAGIC)] == WIRE_MAGIC:
+        off = len(WIRE_MAGIC)
+        if len(raw) < off + _LEN_STRUCT.size:
+            raise SnapshotIntegrityError(
+                f"wire frame truncated: {len(raw)} bytes"
+            )
+        (n,) = _LEN_STRUCT.unpack_from(raw, off)
+        off += _LEN_STRUCT.size
+        if off + n > len(raw):
+            raise SnapshotIntegrityError(
+                f"wire frame body truncated: {n} declared, "
+                f"{len(raw) - off} present"
+            )
+        return RequestSnapshot.from_bytes(raw[off : off + n])
+    # Bare snapshot bytes (no transport frame) are also accepted.
+    return RequestSnapshot.from_bytes(raw)
+
+
+def tensor_to_wire(arr: np.ndarray, *, name: str = "h") -> bytes:
+    """One array as an integrity-hashed binary frame — the pipeline
+    stage-boundary format (hidden states over DCN between stage hosts).
+    Same layout discipline as the snapshot codec: magic | u16 version |
+    16-byte blake2b | u32 header length | JSON header | raw buffer."""
+    arr = np.ascontiguousarray(arr)
+    body = arr.tobytes()
+    header = json.dumps(
+        {
+            "kind": "tensor",
+            "name": name,
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+        },
+        separators=(",", ":"),
+    ).encode("utf-8")
+    ver = _VER_STRUCT.pack(WIRE_VERSION)
+    hlen = _LEN_STRUCT.pack(len(header))
+    digest = hashlib.blake2b(
+        ver + hlen + header + body, digest_size=DIGEST_SIZE
+    ).digest()
+    return WIRE_MAGIC + ver + digest + hlen + header + body
+
+
+def tensor_from_wire(data: Union[bytes, bytearray, memoryview]) -> np.ndarray:
+    """Decode a :func:`tensor_to_wire` frame (digest-checked)."""
+    raw = bytes(data)
+    prefix = len(WIRE_MAGIC) + _VER_STRUCT.size + DIGEST_SIZE + _LEN_STRUCT.size
+    if len(raw) < prefix:
+        raise SnapshotIntegrityError(f"tensor frame truncated: {len(raw)} bytes")
+    if raw[: len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise SnapshotError("not a wire frame (bad magic)")
+    off = len(WIRE_MAGIC)
+    (version,) = _VER_STRUCT.unpack_from(raw, off)
+    ver_bytes = raw[off : off + _VER_STRUCT.size]
+    off += _VER_STRUCT.size
+    digest = raw[off : off + DIGEST_SIZE]
+    off += DIGEST_SIZE
+    if version > WIRE_VERSION:
+        raise SnapshotVersionError(
+            f"wire frame version {version} is newer than supported "
+            f"{WIRE_VERSION}"
+        )
+    rest = raw[off:]
+    want = hashlib.blake2b(ver_bytes + rest, digest_size=DIGEST_SIZE).digest()
+    if digest != want:
+        raise SnapshotIntegrityError("tensor frame digest mismatch")
+    (hlen,) = _LEN_STRUCT.unpack_from(raw, off)
+    off += _LEN_STRUCT.size
+    try:
+        header = json.loads(raw[off : off + hlen].decode("utf-8"))
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise SnapshotIntegrityError(
+            f"tensor frame header unparseable: {exc}"
+        ) from None
+    off += hlen
+    if header.get("kind") != "tensor":
+        raise SnapshotError(
+            f"wire frame kind {header.get('kind')!r} is not a tensor"
+        )
+    dtype = _dtype_from_name(header["dtype"])
+    arr = np.frombuffer(raw, dtype=dtype, offset=off)
+    return arr.reshape(header["shape"]).copy()
 
 
 def repack_pages(

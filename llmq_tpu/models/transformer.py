@@ -352,12 +352,37 @@ class Transformer:
     instead of GSPMD's per-layer all-reduces; "off" traces the literal
     pre-existing programs. Static (a frozen field), so every iteration
     of the layer scan — and every jit variant — sees the same choice.
+
+    ``stage``: optional ``(lo, hi)`` GLOBAL layer range for pipeline
+    parallelism. When set, the model executes only layers ``lo..hi-1``
+    over a per-stage param tree (``parallel/pipeline.slice_stage_params``
+    — ``params["layers"]`` leaves carry ``hi - lo`` layers) and a
+    per-stage KV pool of the same depth; every forward method then
+    accepts an upstream hidden state ``h`` (skipping the embedding
+    unless this is the first stage) and can return the full hidden grid
+    instead of logits (``return_hidden`` — any stage but the last).
+    ``stage=None`` traces byte-identical programs to before the field
+    existed.
     """
 
     config: ModelConfig
     mesh: Any = None
     attn_backend: str = "auto"
     tp_overlap: str = "off"
+    stage: Optional[Tuple[int, int]] = None
+
+    def _stage_range(self) -> Tuple[int, int]:
+        return self.stage if self.stage is not None else (
+            0, self.config.num_layers
+        )
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self._stage_range()[0] == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self._stage_range()[1] == self.config.num_layers
 
     # --- shared layer body -------------------------------------------------
     def _qkv(
@@ -417,18 +442,27 @@ class Transformer:
         return _tap(h + mlp_out, "layer.out", layer)
 
     def _window_for_layers(self) -> jnp.ndarray:
-        """Per-layer effective sliding window ([L]); 'disabled' = max ctx."""
+        """Per-layer effective sliding window ([L] — this stage's layers,
+        indexed by GLOBAL layer id); 'disabled' = max ctx."""
         cfg = self.config
+        lo, hi = self._stage_range()
         disabled = cfg.max_position_embeddings + 1
         return jnp.array(
             [
                 cfg.sliding_window
                 if cfg.layer_uses_sliding_window(i)
                 else disabled
-                for i in range(cfg.num_layers)
+                for i in range(lo, hi)
             ],
             dtype=jnp.int32,
         )
+
+    def _layer_idx(self) -> jnp.ndarray:
+        """Scan xs: LOCAL layer indices — they address the (per-stage)
+        KV pool stack, whose leading axis is this stage's layers only.
+        With ``stage=None`` local == global."""
+        lo, hi = self._stage_range()
+        return jnp.arange(hi - lo, dtype=jnp.int32)
 
     def _embed(self, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
@@ -461,9 +495,16 @@ class Transformer:
         k_pages: jnp.ndarray,  # [L, P, page, n_kv, d]
         v_pages: jnp.ndarray,
         block_tables: jnp.ndarray,  # [B, pages_per_seq]
+        *,
+        h: Optional[jnp.ndarray] = None,  # [B, T, H] upstream stage hidden
+        return_hidden: bool = False,  # stage output: full grid, no logits
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Full-prompt forward. Returns (last-token logits [B, V], k_pages,
-        v_pages) with the prompt's K/V written into the cache pages."""
+        v_pages) with the prompt's K/V written into the cache pages.
+
+        Pipeline stages thread ``h`` in (non-first stages skip the
+        embedding) and set ``return_hidden`` (non-last stages return the
+        [B, T, H] grid instead of logits)."""
         cfg = self.config
         B, T = tokens.shape
         inv_freq = compute_rope_inv_freq(cfg)
@@ -471,7 +512,8 @@ class Transformer:
         positions = jnp.where(
             pos_grid < lengths[:, None], jnp.broadcast_to(pos_grid, (B, T)), -1
         )
-        h = self._embed(params, tokens)
+        if h is None:
+            h = self._embed(params, tokens)
         windows = self._window_for_layers()
         one_plus = cfg.model_type.startswith("gemma")
 
@@ -512,12 +554,13 @@ class Transformer:
             h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
-        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
         (h, k_pages, v_pages), _ = jax.lax.scan(
             layer_fn,
             (h, k_pages, v_pages),
-            (params["layers"], windows, layer_idx),
+            (params["layers"], windows, self._layer_idx()),
         )
+        if return_hidden:
+            return h, k_pages, v_pages
         last_idx = jnp.maximum(lengths - 1, 0)
         last_h = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
         return self._logits(params, last_h), k_pages, v_pages
@@ -533,6 +576,7 @@ class Transformer:
         block_tables: jnp.ndarray,  # [B, pages_per_seq]
         *,
         backend: Optional[str] = None,
+        h: Optional[jnp.ndarray] = None,  # [B, C, H] upstream stage hidden
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """The write-then-attend layer scan shared by chunked prefill,
         speculative verify, and the fused mixed step: write each row's
@@ -544,7 +588,8 @@ class Transformer:
         ``ops/dispatch.chunked_prefill_attention``."""
         cfg = self.config
         inv_freq = compute_rope_inv_freq(cfg)
-        h = self._embed(params, tokens)  # [B, C, H]
+        if h is None:
+            h = self._embed(params, tokens)  # [B, C, H]
         windows = self._window_for_layers()
         one_plus = cfg.model_type.startswith("gemma")
         attn_backend = self.attn_backend if backend is None else backend
@@ -573,11 +618,10 @@ class Transformer:
             h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
-        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
         return jax.lax.scan(
             layer_fn,
             (h, k_pages, v_pages),
-            (params["layers"], windows, layer_idx),
+            (params["layers"], windows, self._layer_idx()),
         )[0]
 
     # --- chunked prefill ---------------------------------------------------
@@ -591,6 +635,9 @@ class Transformer:
         block_tables: jnp.ndarray,  # [B, pages_per_seq]
         last_in_chunk: jnp.ndarray,  # [B] index of each row's final valid
         #                              position within this chunk (0 if none)
+        *,
+        h: Optional[jnp.ndarray] = None,  # [B, C, H] upstream stage hidden
+        return_hidden: bool = False,  # stage output: full grid, no logits
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One fixed-size chunk of prompt positions through all layers:
         writes the chunk's K/V into the cache and attends each query
@@ -603,8 +650,10 @@ class Transformer:
         final chunk) plus the updated pages.
         """
         h, k_pages, v_pages = self._paged_chunk_trunk(
-            params, tokens, positions, k_pages, v_pages, block_tables
+            params, tokens, positions, k_pages, v_pages, block_tables, h=h
         )
+        if return_hidden:
+            return h, k_pages, v_pages
         last_h = jnp.take_along_axis(
             h, last_in_chunk[:, None, None], axis=1
         )[:, 0]
@@ -622,6 +671,9 @@ class Transformer:
         gather_idx: jnp.ndarray,  # [S] which chunk position becomes the
         #                           row's logits (decode rows: 0; the
         #                           piggy row: its segment's last valid)
+        *,
+        h: Optional[jnp.ndarray] = None,  # [S, C, H] upstream stage hidden
+        return_hidden: bool = False,  # stage output: full grid, no logits
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One fused mixed step: every active decode slot scores its
         single next position while ONE pending request's prefill chunk
@@ -645,7 +697,10 @@ class Transformer:
             v_pages,
             block_tables,
             backend="xla" if kernel == "xla" else self.attn_backend,
+            h=h,
         )
+        if return_hidden:
+            return h, k_pages, v_pages
         row_h = jnp.take_along_axis(
             h, gather_idx[:, None, None], axis=1
         )[:, 0]
@@ -688,6 +743,9 @@ class Transformer:
         v_pages: jnp.ndarray,
         block_tables: jnp.ndarray,  # [S, pages_per_seq]
         active: jnp.ndarray,  # [S] bool — slot holds a live sequence
+        *,
+        h: Optional[jnp.ndarray] = None,  # [S, H] upstream stage hidden
+        return_hidden: bool = False,  # stage output: hidden, no logits
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """One decode step for every active slot. Returns (logits [S, V],
         k_pages, v_pages).
@@ -705,7 +763,8 @@ class Transformer:
         S = tokens.shape[0]
         inv_freq = compute_rope_inv_freq(cfg)
         positions = jnp.where(active, context_lens, -1).astype(jnp.int32)  # [S]
-        h = self._embed(params, tokens)  # [S, H]
+        if h is None:
+            h = self._embed(params, tokens)  # [S, H]
         windows = self._window_for_layers()
         one_plus = cfg.model_type.startswith("gemma")
         ctx_incl = jnp.where(active, context_lens + 1, 0)
@@ -755,12 +814,13 @@ class Transformer:
             h = self._finish_layer(lp, h, attn_out, li)
             return (h, kps, vps), None
 
-        layer_idx = jnp.arange(cfg.num_layers, dtype=jnp.int32)
         (h, k_pages, v_pages), _ = jax.lax.scan(
             layer_fn,
             (h, k_pages, v_pages),
-            (params["layers"], windows, layer_idx),
+            (params["layers"], windows, self._layer_idx()),
         )
+        if return_hidden:
+            return h, k_pages, v_pages
         return self._logits(params, h), k_pages, v_pages
 
 
@@ -888,10 +948,15 @@ def make_kv_pages(
     num_pages: int,
     page_size: int,
     dtype=jnp.bfloat16,
+    *,
+    num_layers: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Allocate the paged KV cache: [L, P, page, n_kv, d] ×2."""
+    """Allocate the paged KV cache: [L, P, page, n_kv, d] ×2.
+
+    ``num_layers`` overrides the leading depth for per-stage pools under
+    pipeline parallelism (each stage caches only its own layers)."""
     shape = (
-        config.num_layers,
+        config.num_layers if num_layers is None else num_layers,
         num_pages,
         page_size,
         config.num_kv_heads,

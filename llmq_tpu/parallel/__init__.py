@@ -7,7 +7,13 @@ expressed the XLA way: one SPMD program over a ``jax.sharding.Mesh``, with
 ICI collectives.
 """
 
-from llmq_tpu.parallel.mesh import make_mesh, auto_tensor_parallel
+from llmq_tpu.parallel.mesh import make_mesh, auto_tensor_parallel, mesh_pp
+from llmq_tpu.parallel.pipeline import (
+    bubble_fraction,
+    slice_stage_params,
+    stage_layer_ranges,
+    stage_submeshes,
+)
 from llmq_tpu.parallel.sharding import (
     kv_page_pspec,
     param_pspecs,
@@ -18,6 +24,11 @@ from llmq_tpu.parallel.sharding import (
 __all__ = [
     "make_mesh",
     "auto_tensor_parallel",
+    "mesh_pp",
+    "stage_layer_ranges",
+    "stage_submeshes",
+    "slice_stage_params",
+    "bubble_fraction",
     "param_pspecs",
     "param_shardings",
     "kv_page_pspec",

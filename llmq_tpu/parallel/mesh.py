@@ -4,6 +4,15 @@ Auto-TP parity with the reference (``vllm_worker.py:62-89``): when no
 ``tensor_parallel`` is given, the worker claims *all* visible devices —
 there it was every GPU in ``CUDA_VISIBLE_DEVICES``, here every chip JAX
 exposes on the slice, divided by the requested data-parallel degree.
+
+Pipeline parallelism adds an optional OUTER ``pp`` axis: a
+``pipeline_parallel > 1`` mesh is ``(pp, dp, sp, tp)``, where each
+``pp`` slice is one contiguous block of devices (one host's ICI domain
+in a multi-host deployment — the pp axis is the DCN tier). The engine
+never shards a tensor over ``pp``; it carves the 4-axis mesh into
+``pp`` independent 3-axis stage submeshes (``parallel/pipeline.py``)
+and moves activations across the boundary explicitly, so the inner
+``dp/sp/tp`` machinery is untouched.
 """
 
 from __future__ import annotations
@@ -17,22 +26,36 @@ from jax.sharding import Mesh
 DP_AXIS = "dp"
 SP_AXIS = "sp"  # sequence/context parallel (ring attention over ICI)
 TP_AXIS = "tp"
+PP_AXIS = "pp"  # pipeline stages (outer tier: hosts over DCN)
 
 #: The ONLY mesh axis names this codebase defines. Every axis-name string
 #: in a PartitionSpec / NamedSharding / with_sharding_constraint /
 #: shard_map spec must reference these constants (the ``sharding-axis``
 #: lint rule enforces it), so renaming an axis — or threading a submesh —
 #: is a one-line change here instead of a grep-and-pray across every
-#: sharding annotation.
-AXIS_NAMES = (DP_AXIS, SP_AXIS, TP_AXIS)
+#: sharding annotation. ``pp`` is registered here for that rule's sake
+#: but no PartitionSpec may ever name it: stage submeshes are 3-axis and
+#: stage-boundary movement is explicit host-driven transfer, which is
+#: what the spmd gate's no-``pp``-collective assertion checks.
+AXIS_NAMES = (DP_AXIS, SP_AXIS, TP_AXIS, PP_AXIS)
+
+#: Axis order of a single-stage (or per-stage) compute mesh. Kept as its
+#: own tuple because the lint registry above now also carries ``pp``,
+#: which inner shardings must never reference.
+INNER_AXIS_NAMES = (DP_AXIS, SP_AXIS, TP_AXIS)
 
 
 def auto_tensor_parallel(
-    data_parallel: int = 1, devices=None, sequence_parallel: int = 1
+    data_parallel: int = 1,
+    devices=None,
+    sequence_parallel: int = 1,
+    pipeline_parallel: int = 1,
 ) -> int:
-    """TP degree when unspecified: all visible devices / (dp*sp)."""
+    """TP degree when unspecified: all visible devices / (pp*dp*sp)."""
     n = len(devices if devices is not None else jax.devices())
-    return max(1, n // max(1, data_parallel * sequence_parallel))
+    return max(
+        1, n // max(1, data_parallel * sequence_parallel * pipeline_parallel)
+    )
 
 
 def make_mesh(
@@ -40,23 +63,38 @@ def make_mesh(
     data_parallel: int = 1,
     sequence_parallel: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    pipeline_parallel: int = 1,
 ) -> Mesh:
-    """A ``(dp, sp, tp)`` mesh over the first ``dp*sp*tp`` visible devices.
+    """A ``(dp, sp, tp)`` mesh over the first ``dp*sp*tp`` visible devices
+    — or ``(pp, dp, sp, tp)`` when ``pipeline_parallel > 1``.
 
     The tp axis is innermost so tensor-parallel collectives ride the
     fastest links (ICI neighbours on a TPU slice); sp sits next to it —
     ring-attention ppermute hops are neighbour-to-neighbour; dp is the
     outer axis (per-replica traffic is batch-disjoint and needs no
-    bandwidth).
+    bandwidth). pp, when present, is outermost of all: consecutive
+    device blocks of ``dp*sp*tp`` form the stages, so a stage never
+    straddles a host boundary when hosts enumerate their local devices
+    contiguously (the jax.devices() order).
     """
     devs = list(devices if devices is not None else jax.devices())
+    pp = max(1, pipeline_parallel)
     dp = max(1, data_parallel)
     sp = max(1, sequence_parallel)
-    tp = tensor_parallel or auto_tensor_parallel(dp, devs, sp)
-    if dp * sp * tp > len(devs):
+    tp = tensor_parallel or auto_tensor_parallel(dp, devs, sp, pp)
+    need = pp * dp * sp * tp
+    if need > len(devs):
         raise ValueError(
-            f"Mesh dp={dp} x sp={sp} x tp={tp} needs {dp * sp * tp} "
+            f"Mesh pp={pp} x dp={dp} x sp={sp} x tp={tp} needs {need} "
             f"devices, only {len(devs)} visible"
         )
-    grid = np.asarray(devs[: dp * sp * tp]).reshape(dp, sp, tp)
-    return Mesh(grid, (DP_AXIS, SP_AXIS, TP_AXIS))
+    if pp == 1:
+        grid = np.asarray(devs[: dp * sp * tp]).reshape(dp, sp, tp)
+        return Mesh(grid, INNER_AXIS_NAMES)
+    grid = np.asarray(devs[:need]).reshape(pp, dp, sp, tp)
+    return Mesh(grid, (PP_AXIS,) + INNER_AXIS_NAMES)
+
+
+def mesh_pp(mesh: Mesh) -> int:
+    """Pipeline degree of a mesh (1 for the classic 3-axis meshes)."""
+    return int(mesh.shape.get(PP_AXIS, 1))

@@ -1,0 +1,125 @@
+"""Pipeline-stage planning: carve a ``(pp, dp, sp, tp)`` mesh into
+per-stage compute submeshes and slice the stacked param tree per stage.
+
+GPipe-style inference pipelining (Pope et al. 2022's inter-stage bubble
+analysis): the layer stack splits into ``pp`` contiguous stages, each
+compiled as its own executable over one 3-axis ICI submesh. Nothing is
+ever sharded over the ``pp`` axis — stage-to-stage activations move by
+explicit host-driven transfer (device-to-device over ICI in one
+process, the snapshot-codec wire frame over ``tcp://`` between hosts),
+so GSPMD sees ``pp`` nowhere and the spmd gate can assert that no
+collective crosses a stage boundary.
+
+The helpers here are deliberately engine-agnostic (pure functions over
+meshes and pytrees) so the spmd gate, the bench rung, and the probes
+can reuse them without constructing an engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from llmq_tpu.parallel.mesh import INNER_AXIS_NAMES, PP_AXIS
+
+Params = Dict[str, Any]
+
+
+def stage_layer_ranges(num_layers: int, pp: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[lo, hi)`` layer ranges for ``pp`` stages.
+
+    Near-even split; when layers don't divide evenly the EARLIER stages
+    take the extra layer — the last stage also owns the final norm +
+    lm_head matmul (the [H, V] matmul is the single biggest non-layer
+    cost), so biasing remainders forward balances wall-clock per stage.
+    """
+    if pp < 1:
+        raise ValueError(f"pp={pp} must be >= 1")
+    if num_layers < pp:
+        raise ValueError(
+            f"cannot split {num_layers} layers into {pp} pipeline stages"
+        )
+    base, extra = divmod(num_layers, pp)
+    ranges: List[Tuple[int, int]] = []
+    lo = 0
+    for s in range(pp):
+        hi = lo + base + (1 if s < extra else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    assert lo == num_layers
+    return ranges
+
+
+def stage_submeshes(mesh: Mesh) -> List[Mesh]:
+    """One 3-axis ``(dp, sp, tp)`` Mesh per pp slice of a 4-axis mesh.
+
+    Each submesh is a contiguous device block (one ICI domain in the
+    two-tier deployment shape); inner shardings carry over unchanged
+    because the axis names and extents match the classic single-stage
+    mesh exactly.
+    """
+    if PP_AXIS not in mesh.axis_names:
+        return [mesh]
+    pp_index = mesh.axis_names.index(PP_AXIS)
+    if pp_index != 0:
+        raise ValueError(
+            f"pp must be the outermost mesh axis, got {mesh.axis_names}"
+        )
+    grid = np.asarray(mesh.devices)
+    return [Mesh(grid[s], INNER_AXIS_NAMES) for s in range(grid.shape[0])]
+
+
+def slice_stage_params(
+    params: Params,
+    lo: int,
+    hi: int,
+    *,
+    num_layers: int,
+    tied_embeddings: bool,
+) -> Params:
+    """The param subtree stage ``[lo, hi)`` of ``num_layers`` executes.
+
+    Layer-stacked leaves slice on their leading [L, ...] axis (nested
+    quant {q, scale} dicts slice leaf-wise for free via tree.map); the
+    non-layer leaves place by role: ``embed`` on the first stage (token
+    lookup) AND on the last when embeddings are tied (the lm_head
+    matmul reads it), ``final_norm``/``lm_head`` on the last stage only.
+    Duplicating the tied embed across two stages costs one [V, H] copy
+    of HBM — the price of not shipping hidden states back to stage 0
+    for every logits computation.
+    """
+    first = lo == 0
+    last = hi == num_layers
+    out: Params = {
+        "layers": jax.tree.map(lambda x: x[lo:hi], params["layers"])
+    }
+    if first or (last and tied_embeddings) or (last and "lm_head" not in params):
+        out["embed"] = params["embed"]
+    if last:
+        out["final_norm"] = params["final_norm"]
+        if "lm_head" in params:
+            out["lm_head"] = params["lm_head"]
+    return out
+
+
+def bubble_fraction(microbatches: int, stages: int) -> float:
+    """GPipe bubble fraction ``(pp - 1) / (m + pp - 1)``.
+
+    ``m`` microbatches through ``pp`` stages take ``m + pp - 1`` stage
+    slots of which ``pp - 1`` are fill/drain bubbles (Pope et al. 2022,
+    §3.3). For decode the run-ahead pipeline plays the role of ``m``:
+    K-deep dispatch (``decode_block`` iterations per dispatch, runahead
+    dispatches in flight) amortizes the same way.
+    """
+    m = max(1, int(microbatches))
+    pp = max(1, int(stages))
+    return (pp - 1) / (m + pp - 1)
+
+
+def boundary_bytes_per_token(hidden_size: int, itemsize: int = 4) -> int:
+    """Activation bytes one token's hidden state ships per stage
+    boundary (the DCN-vs-ICI planning number: [H] * itemsize)."""
+    return int(hidden_size) * int(itemsize)

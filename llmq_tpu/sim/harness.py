@@ -40,6 +40,7 @@ from llmq_tpu.broker.manager import (
 from llmq_tpu.broker.memory import reset_namespace
 from llmq_tpu.core.config import get_config
 from llmq_tpu.core.models import Job
+from llmq_tpu.core.pipeline import PipelineConfig, PipelineStage
 from llmq_tpu.obs import TRACE_FIELD
 from llmq_tpu.sim.scenario import Scenario
 from llmq_tpu.sim.vloop import run_virtual
@@ -128,6 +129,25 @@ class FleetSim:
         scenario.validate()
         self.scenario = scenario
         self.queue = QUEUE
+        # pp_stages > 1 runs the fleet as a stage pipeline over the
+        # production ``pipeline.<name>.<stage>`` topology: traffic enters
+        # the first stage's queue, each stage worker routes its result to
+        # the next stage via publish_pipeline_result, and the final stage
+        # lands on the pipeline results queue.
+        self.pipeline: Optional[PipelineConfig] = None
+        if scenario.fleet.pp_stages > 1:
+            self.pipeline = PipelineConfig(
+                name="twin",
+                stages=[
+                    PipelineStage(name=f"s{i}", worker="sim")
+                    for i in range(scenario.fleet.pp_stages)
+                ],
+            )
+        self._entry_queue = (
+            self.pipeline.get_stage_queue_name(self.pipeline.stages[0].name)
+            if self.pipeline is not None
+            else self.queue
+        )
         ns = f"sim-{scenario.name}-{scenario.seed}"
         self.namespace = "".join(
             ch if ch.isalnum() or ch in "-_" else "-" for ch in ns
@@ -142,6 +162,11 @@ class FleetSim:
         self._left: List[str] = []  # llmq: ignore[unbounded-host-buffer]
         self._submitted: Dict[str, dict] = {}  # llmq: ignore[unbounded-host-buffer]
         self._stopped_workers: List[SimWorker] = []
+        # Pipeline-mode observability: which stage each worker serves and
+        # the highest ready-depth each stage queue reached (sampled on the
+        # completion poller's cadence) — the twin's bubble/imbalance view.
+        self._worker_stage: Dict[int, str] = {}  # llmq: ignore[unbounded-host-buffer]
+        self._stage_depth_peak: Dict[str, int] = {}
 
     # --- env plumbing -----------------------------------------------------
     def _broker_url(self) -> str:
@@ -215,7 +240,10 @@ class FleetSim:
             get_config(), url=f"memory://{self.namespace}"
         )
         await submitter.connect()
-        await submitter.setup_queue_infrastructure(self.queue)
+        if self.pipeline is not None:
+            await submitter.setup_pipeline_infrastructure(self.pipeline)
+        else:
+            await submitter.setup_queue_infrastructure(self.queue)
         try:
             spinup = asyncio.ensure_future(self._spin_up_fleet())
             traffic = asyncio.ensure_future(self._generate_traffic(submitter))
@@ -234,28 +262,58 @@ class FleetSim:
             await self._stop_fleet()
             report.submitted = self._submitted
             report.results = await self._drain_results(submitter)
-            report.failed = await self._drain_dead(
-                submitter, self.queue + FAILED_SUFFIX
-            )
-            report.quarantined = await self._drain_dead(
-                submitter, self.queue + QUARANTINE_SUFFIX
-            )
+            report.failed = []
+            report.quarantined = []
+            for qname in self._job_queues():
+                report.failed += await self._drain_dead(
+                    submitter, qname + FAILED_SUFFIX
+                )
+                report.quarantined += await self._drain_dead(
+                    submitter, qname + QUARANTINE_SUFFIX
+                )
             report.counters = self._collect_counters(submitter)
             report.virtual_s = loop.time()
         finally:
             await submitter.disconnect()
         return report
 
+    # --- queue topology ---------------------------------------------------
+    def _job_queues(self) -> List[str]:
+        """Every queue jobs are consumed from (one per pipeline stage, or
+        the single shared queue) — the dead-letter drains cover each."""
+        if self.pipeline is not None:
+            return self.pipeline.stage_queue_names()
+        return [self.queue]
+
+    def _results_qname(self) -> str:
+        if self.pipeline is not None:
+            return self.pipeline.get_pipeline_results_queue_name()
+        return results_queue_name(self.queue)
+
     # --- fleet ------------------------------------------------------------
     def _start_worker(self) -> int:
         index = self._next_index
         self._next_index += 1
-        worker = SimWorker(
-            self.queue,
-            index,
-            seed=self.scenario.seed,
-            concurrency=self.scenario.fleet.concurrency,
-        )
+        if self.pipeline is not None:
+            # Round-robin stage binding: joins keep the stages balanced
+            # the same deterministic way the initial spin-up does.
+            stage = self.pipeline.stages[index % len(self.pipeline.stages)]
+            worker = SimWorker(
+                self.pipeline.get_stage_queue_name(stage.name),
+                index,
+                seed=self.scenario.seed,
+                concurrency=self.scenario.fleet.concurrency,
+                pipeline=self.pipeline,
+                stage_name=stage.name,
+            )
+            self._worker_stage[index] = stage.name
+        else:
+            worker = SimWorker(
+                self.queue,
+                index,
+                seed=self.scenario.seed,
+                concurrency=self.scenario.fleet.concurrency,
+            )
         self._workers[index] = worker
         self._worker_tasks[index] = asyncio.ensure_future(worker.run())
         return index
@@ -358,7 +416,7 @@ class FleetSim:
         if traffic.deadline_ms:
             payload["deadline_ms"] = traffic.deadline_ms
         job = Job.model_validate(payload)
-        await submitter.publish_job(self.queue, job)
+        await submitter.publish_job(self._entry_queue, job)
         # publish_job stamps deadline_at in place (and may shed).
         self._submitted[job_id] = {
             "deadline_at": job.deadline_at,
@@ -424,22 +482,31 @@ class FleetSim:
             if not traffic.done():
                 continue
             settled = 0
-            for qname in (
-                results_queue_name(self.queue),
-                self.queue + FAILED_SUFFIX,
-                self.queue + QUARANTINE_SUFFIX,
-            ):
+            outcome_queues = [self._results_qname()]
+            for qname in self._job_queues():
+                outcome_queues.append(qname + FAILED_SUFFIX)
+                outcome_queues.append(qname + QUARANTINE_SUFFIX)
+            for qname in outcome_queues:
                 # MemoryBroker.stats never raises for a queue that does
                 # not exist yet; it reports None counts ("unavailable").
                 stats = await submitter.broker.stats(qname)
                 settled += stats.message_count_ready or 0
+            if self.pipeline is not None:
+                # Sample stage-queue depth peaks on the same cadence —
+                # the twin's view of pipeline imbalance (a slow stage
+                # shows up as its queue's high-water mark).
+                for qname in self._job_queues():
+                    stats = await submitter.broker.stats(qname)
+                    depth = stats.message_count_ready or 0
+                    if depth > self._stage_depth_peak.get(qname, 0):
+                        self._stage_depth_peak[qname] = depth
             if settled >= total:
                 return True
 
     # --- collection -------------------------------------------------------
     async def _drain_results(self, submitter: BrokerManager) -> List[dict]:
         out: List[dict] = []
-        qname = results_queue_name(self.queue)
+        qname = self._results_qname()
         while True:
             msg = await submitter.broker.get(qname)
             if msg is None:
@@ -512,6 +579,17 @@ class FleetSim:
             ),
             "swap_recomputes": sum(w.swap_recomputes for w in workers),
         }
+        if self.pipeline is not None:
+            per_stage: Dict[str, int] = {
+                s.name: 0 for s in self.pipeline.stages
+            }
+            for idx, worker in self._workers.items():
+                stage = self._worker_stage.get(idx)
+                if stage is not None:
+                    per_stage[stage] += worker.jobs_processed
+            counters["pp_stages"] = len(self.pipeline.stages)
+            counters["stage_jobs_processed"] = per_stage
+            counters["stage_queue_depth_peak"] = dict(self._stage_depth_peak)
         return counters
 
 

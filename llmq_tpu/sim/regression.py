@@ -35,6 +35,12 @@ The scenarios map to the policy planes grown in PRs 11–18:
   (``LLMQ_ROLE_DWELL_S``): zeroing the dwell lets the auto controller
   re-decide roles on every depth check, so the prefill/decode cohorts
   flap instead of converging.
+- ``pp-stage-flow`` — the pipeline-stage plane under the watchdog
+  (``LLMQ_WATCHDOG_MULT``): a 2-stage fleet over
+  ``pipeline.<name>.<stage>`` queues with hang jobs; disabling the
+  watchdog lets a hang wedge a stage worker for its full duration
+  instead of tripping, so trips vanish and the run's virtual span
+  triples.
 """
 
 from __future__ import annotations
@@ -73,6 +79,16 @@ def report_metrics(report: SimReport) -> Dict[str, float]:
             report.counters.get("handoffs_fallback", 0)
         ),
         "jobs_adopted": float(report.counters.get("jobs_adopted", 0)),
+        # Pipeline-mode runs: highest ready-depth any stage queue reached
+        # (0 outside pipeline mode) — the twin's stage-imbalance signal.
+        "stage_depth_peak": float(
+            max(
+                (
+                    report.counters.get("stage_queue_depth_peak") or {}
+                ).values(),
+                default=0,
+            )
+        ),
         "slo": (
             report.slo_attainment()
             if report.slo_attainment() is not None
@@ -179,6 +195,24 @@ def _roleflap_scenario() -> Scenario:
     )
 
 
+def _pp_stage_scenario() -> Scenario:
+    # Two-stage pipeline fleet: jobs enter pipeline.twin.s0, stage
+    # workers route results to s1 via the production pipeline path, and
+    # the hang jobs test that the watchdog policy holds per stage (each
+    # stage pays 1/2 the unified latency, so deadlines engage at the
+    # stage scale, not the unified one).
+    return Scenario(
+        name="pp-stage-flow",
+        seed=15,
+        traffic=TrafficShape(
+            jobs=150, rate_jobs_s=40.0, output_tokens=(64, 256)
+        ),
+        fleet=FleetShape(workers=8, concurrency=2, pp_stages=2),
+        faults=FaultSchedule(hang_jobs=2, hang_s=600.0),
+        env={"LLMQ_WATCHDOG_MULT": "8", "LLMQ_WATCHDOG_MIN_S": "1.0"},
+    )
+
+
 def _quarantine_scenario() -> Scenario:
     return Scenario(
         name="quarantine-poison",
@@ -282,6 +316,32 @@ REGRESSIONS: Dict[str, RegressionSpec] = {
                 "check re-decides the role, the prefill/decode cohorts "
                 "chase the see-sawing queue depths, and fleet-wide role "
                 "switches blow past the flap bound (recorded: 22 vs 10)."
+            ),
+        ),
+        RegressionSpec(
+            name="pp-stage-flow",
+            description=(
+                "Stage-pipeline fleet completes every job with the "
+                "watchdog containing hangs at stage scale."
+            ),
+            build=_pp_stage_scenario,
+            # Recorded from seed 15: 15 trips = 2 genuine hangs + 13
+            # warmup-floor trips before per-kind history engages; stage-0
+            # depth peaks at 136 (arrival burst drains through the
+            # prefill-heavy first stage), stage-1 at 12.
+            baseline={
+                "results": (150, 150),
+                "watchdog_trips": (2, 20),
+                "engine_rebuilds": (2, 20),
+                "stage_depth_peak": (1, 400),
+            },
+            detune={"LLMQ_WATCHDOG_MULT": "0"},
+            detune_doc=(
+                "Watchdog disabled: the two hang jobs wedge their stage "
+                "workers for the full 600 s instead of tripping at the "
+                "stage-scale deadline — trips/rebuilds drop to 0 "
+                "(recorded) and the run's virtual span triples "
+                "(~400 s -> ~1235 s)."
             ),
         ),
         RegressionSpec(

@@ -67,6 +67,11 @@ class FleetShape:
     # heartbeat cadences don't phase-lock.
     join_spread_s: float = 5.0
     prefix_affinity: bool = False
+    # >1 organizes the fleet as a stage pipeline: workers bind round-robin
+    # to ``pipeline.<name>.<stage>`` queues, jobs flow stage -> stage via
+    # the production pipeline-routing path, and per-stage latencies scale
+    # by 1/pp_stages (the twin of splitting one model across stage hosts).
+    pp_stages: int = 1
 
 
 @dataclass
@@ -137,6 +142,13 @@ class Scenario:
         self.traffic.validate()
         if self.fleet.workers <= 0:
             raise ValueError("fleet.workers must be > 0")
+        if self.fleet.pp_stages < 1:
+            raise ValueError("fleet.pp_stages must be >= 1")
+        if self.fleet.workers < self.fleet.pp_stages:
+            raise ValueError(
+                "fleet.workers must cover every pipeline stage "
+                f"({self.fleet.workers} workers < {self.fleet.pp_stages} stages)"
+            )
         total_special = self.faults.poison_jobs + self.faults.hang_jobs
         if total_special > self.traffic.jobs:
             raise ValueError(

@@ -129,6 +129,12 @@ class SimWorker(BaseWorker):
         self._index = index
         super().__init__(queue, **kwargs)
         self._seed = seed
+        # Stage-role workers (pipeline + stage_name set) serve 1/pp_stages
+        # of the model, so every dispatch costs that fraction of the
+        # unified latency — total compute is conserved across the chain.
+        self._stage_scale = (
+            1.0 / len(self.pipeline.stages) if self.pipeline is not None else 1.0
+        )
         self.model = LatencyModel(f"{seed}:lat:{index}")
         self.engine: Optional[StubEngine] = None
         self._crashed = False
@@ -188,7 +194,8 @@ class SimWorker(BaseWorker):
             self._handoff_ms.append(latency_ms)
         else:
             await engine.dispatch(
-                "prefill", self.model.prefill_s(prompt_tokens)
+                "prefill",
+                self.model.prefill_s(prompt_tokens) * self._stage_scale,
             )
             if self.role_active == "prefill":
                 # Prompt KV complete — the base loop hands the job to the
@@ -203,7 +210,7 @@ class SimWorker(BaseWorker):
                 DECODE_BLOCK_TOKENS,
                 output_tokens - i * DECODE_BLOCK_TOKENS,
             ) or DECODE_BLOCK_TOKENS
-            duration = self.model.decode_block_s(tokens)
+            duration = self.model.decode_block_s(tokens) * self._stage_scale
             if i == hang_block:
                 await engine.dispatch(
                     "decode", max(hang_s, duration), retry_s=duration

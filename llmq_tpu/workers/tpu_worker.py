@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import itertools
 import json
 import os
 import socket
@@ -76,6 +77,7 @@ class TPUWorker(BaseWorker):
         tensor_parallel: Optional[int] = None,
         data_parallel: int = 1,
         sequence_parallel: int = 1,
+        pipeline_parallel: Optional[int] = None,
         max_num_seqs: Optional[int] = None,
         max_model_len: Optional[int] = None,
         dtype: str = "bfloat16",
@@ -96,6 +98,14 @@ class TPUWorker(BaseWorker):
         self.tensor_parallel = tensor_parallel
         self.data_parallel = data_parallel
         self.sequence_parallel = sequence_parallel
+        # Stage count of the two-tier (pp outer over hosts, dp/sp/tp
+        # inner per host) deployment shape; flag > LLMQ_PIPELINE_PARALLEL
+        # env > 1 (classic single-stage mesh).
+        self.pipeline_parallel = int(
+            pipeline_parallel
+            or os.environ.get("LLMQ_PIPELINE_PARALLEL", "1")
+            or 1
+        )
         self._max_num_seqs = max_num_seqs
         self._max_model_len = max_model_len
         self._dtype = dtype
@@ -177,11 +187,22 @@ class TPUWorker(BaseWorker):
             )
 
     # --- identity (reference vllm_worker.py:39-50) ------------------------
+
+    # In-process instance counter: host+pid alone is NOT unique — disagg
+    # tests (and any embedder) run a prefill and a decode worker in one
+    # process, and identical ids made peer discovery treat the pair as
+    # one worker, so KV handoff silently took the snapshot fallback
+    # every time (PERF_NOTES round 16). Role + a per-process nonce keeps
+    # the id unique AND self-describing in heartbeat/queue names.
+    _instance_counter = itertools.count()
+
     def _generate_worker_id(self) -> str:
         tp = self.tensor_parallel or "auto"
+        role = (self.config.worker_role or "unified").lower()
+        nonce = next(TPUWorker._instance_counter)
         return (
             f"tpu-worker-{socket.gethostname()}-{os.getpid()}"
-            f"-tp{tp}-dp{self.data_parallel}"
+            f"-tp{tp}-dp{self.data_parallel}-{role}-i{nonce}"
         )
 
     # --- engine lifecycle -------------------------------------------------
@@ -308,6 +329,7 @@ class TPUWorker(BaseWorker):
             tensor_parallel=self.tensor_parallel,
             data_parallel=self.data_parallel,
             sequence_parallel=self.sequence_parallel,
+            pipeline_parallel=self.pipeline_parallel,
         )
         # int8 = weight-only quantization: weights stored int8 (half the
         # HBM footprint/bandwidth — what fits a ~9B model on one 16 GB
@@ -929,13 +951,16 @@ class TPUWorker(BaseWorker):
         None to process from scratch — on any codec/compat problem the
         prompt is still in the payload, so re-running from token zero is
         always available and always correct."""
-        from llmq_tpu.engine.snapshot import SnapshotError, snapshot_from_b64
+        from llmq_tpu.engine.snapshot import SnapshotError, snapshot_from_wire
 
         resume = job.extras().get(RESUME_FIELD)
         if not isinstance(resume, dict) or not resume.get("snapshot"):
             return None
         try:
-            return snapshot_from_b64(resume["snapshot"])
+            # Wire-format agnostic: accepts the default base64 string as
+            # well as a length-prefixed binary frame (LLMQ_WIRE_FORMAT=
+            # binary senders on bytes-capable transports).
+            return snapshot_from_wire(resume["snapshot"])
         except SnapshotError as exc:
             self.logger.warning(
                 "Job %s resume snapshot unusable (%s); re-running from "

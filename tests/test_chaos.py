@@ -353,6 +353,61 @@ class TestChaosKillResume:
                 f"job {p['id']} diverged from kill-free run"
             )
 
+    async def test_pp_stage_kill_resumes_across_topology(self, mem_ns):
+        """Pipeline-parallel chaos: the killed worker drives a pp=2
+        staged engine (two ICI submeshes chained by host stage hops);
+        the resuming worker is plain pp=1. Snapshot KV blobs concatenate
+        the per-stage layer slabs back to the full [L, ...] stack, so
+        the wire format is pipeline-degree-agnostic and the mid-stream
+        resume lands on a DIFFERENT topology — every job still yields
+        exactly one result, token-identical to a kill-free single-stage
+        run."""
+        jobs = _kill_jobs()
+        want_ids = {j.id for j in jobs}
+        baseline = await _baseline_texts(f"{mem_ns}-base", jobs, {})
+        assert set(baseline) == want_ids
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=1000)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("ppq")
+            for j in jobs:
+                await mgr.publish_job("ppq", j)
+
+            w1 = _tpu_worker(mem_ns, "ppq", pipeline_parallel=2)
+            switch = WorkerKillSwitch(
+                "decode", w1.request_shutdown, seed=17, after_range=(2, 4)
+            )
+            orig_build = w1._build_engine
+
+            def build_with_switch():
+                engine = orig_build()
+                assert engine.core.pp == 2, "worker did not build a pp mesh"
+                engine.core.on_dispatch = switch
+                return engine
+
+            w1._build_engine = build_with_switch
+            t1 = asyncio.ensure_future(w1.run())
+            await asyncio.wait_for(t1, timeout=180.0)
+            assert switch.fired, "no decode dispatch before completion"
+
+            w2 = _tpu_worker(mem_ns, "ppq")  # pp=1: cross-topology resume
+            t2 = asyncio.ensure_future(w2.run())
+            try:
+                payloads = await _collect_all_payloads(
+                    mgr, "ppq.results", want_ids
+                )
+            finally:
+                w2.request_shutdown()
+                await asyncio.wait_for(t2, timeout=60.0)
+
+        ids = [p["id"] for p in payloads]
+        assert sorted(ids) == sorted(set(ids)), f"duplicate results: {ids}"
+        assert set(ids) == want_ids
+        for p in payloads:
+            assert p["result"] == baseline[p["id"]], (
+                f"job {p['id']} diverged after pp stage kill"
+            )
+
     async def test_drain_handoff_resumes_mid_stream(self, mem_ns):
         """Deterministic handoff: shut a worker down while long greedy
         generations are mid-decode. The republished jobs must carry

@@ -1,0 +1,237 @@
+"""Pipeline parallelism: stage planning units + engine parity.
+
+The planning layer (``parallel/pipeline.py``) is pure functions over
+meshes and pytrees, tested directly. The engine legs prove the load-
+bearing property end to end: a pp-staged engine — per-stage executables
+over ICI submeshes, chained by host drivers, prefill chunks / fused
+decode blocks as the GPipe microbatches — is TOKEN-IDENTICAL to the
+single-stage engine for every row, greedy and seeded alike, because the
+head stage compiles the exact pp=1 sampling programs with the upstream
+hidden threaded in.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from __graft_entry__ import _engine_run
+from llmq_tpu.parallel import make_mesh, mesh_pp
+from llmq_tpu.parallel.mesh import INNER_AXIS_NAMES, PP_AXIS
+from llmq_tpu.parallel.pipeline import (
+    boundary_bytes_per_token,
+    bubble_fraction,
+    slice_stage_params,
+    stage_layer_ranges,
+    stage_submeshes,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# --- stage planning ----------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_stage_layer_ranges_even_and_remainder():
+    assert stage_layer_ranges(4, 2) == [(0, 2), (2, 4)]
+    assert stage_layer_ranges(4, 1) == [(0, 4)]
+    # Remainder biases FORWARD: the last stage also pays the lm_head
+    # matmul, so earlier stages take the extra layers.
+    assert stage_layer_ranges(7, 2) == [(0, 4), (4, 7)]
+    assert stage_layer_ranges(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+
+
+@pytest.mark.unit
+def test_stage_layer_ranges_rejects_bad_degrees():
+    with pytest.raises(ValueError):
+        stage_layer_ranges(4, 0)
+    with pytest.raises(ValueError):
+        stage_layer_ranges(2, 3)  # more stages than layers
+
+
+@pytest.mark.unit
+def test_bubble_fraction_gpipe_math():
+    # (pp - 1) / (m + pp - 1), Pope et al. 2022 §3.3.
+    assert bubble_fraction(1, 1) == 0.0
+    assert bubble_fraction(1, 2) == 0.5
+    assert bubble_fraction(4, 2) == pytest.approx(1 / 5)
+    assert bubble_fraction(8, 4) == pytest.approx(3 / 11)
+    # More microbatches amortize the fixed fill/drain cost.
+    assert bubble_fraction(16, 4) < bubble_fraction(4, 4)
+
+
+@pytest.mark.unit
+def test_boundary_bytes_per_token():
+    assert boundary_bytes_per_token(128) == 512
+    assert boundary_bytes_per_token(4096, itemsize=2) == 8192
+
+
+@pytest.mark.unit
+def test_make_mesh_pp_axis_order_and_submeshes():
+    mesh = make_mesh(tensor_parallel=2, pipeline_parallel=2)
+    assert mesh.axis_names == (PP_AXIS,) + INNER_AXIS_NAMES
+    assert mesh_pp(mesh) == 2
+    subs = stage_submeshes(mesh)
+    assert len(subs) == 2
+    for sub in subs:
+        assert sub.axis_names == INNER_AXIS_NAMES
+        assert sub.shape["tp"] == 2
+    # Stage blocks are contiguous, disjoint device runs (the ICI domain
+    # of one host in the two-tier shape).
+    flat = [d.id for s in subs for d in np.asarray(s.devices).flat]
+    assert flat == sorted(flat)
+    assert len(set(flat)) == 4
+
+
+@pytest.mark.unit
+def test_stage_submeshes_passthrough_and_pp_position():
+    mesh = make_mesh(tensor_parallel=2)
+    assert stage_submeshes(mesh) == [mesh]
+    from jax.sharding import Mesh
+
+    grid = np.asarray(jax.devices()[:4]).reshape(1, 1, 2, 2)
+    bad = Mesh(grid, INNER_AXIS_NAMES[:1] + (PP_AXIS,) + INNER_AXIS_NAMES[1:3])
+    with pytest.raises(ValueError, match="outermost"):
+        stage_submeshes(bad)
+
+
+@pytest.mark.unit
+def test_slice_stage_params_placement():
+    L = 4
+    params = {
+        "embed": jnp.zeros((8, 2)),
+        "layers": {"w": jnp.arange(L * 3.0).reshape(L, 3),
+                   "q": {"q": jnp.zeros((L, 2)), "scale": jnp.ones((L, 1))}},
+        "final_norm": jnp.ones((2,)),
+        "lm_head": jnp.zeros((2, 8)),
+    }
+    first = slice_stage_params(params, 0, 2, num_layers=L,
+                               tied_embeddings=False)
+    last = slice_stage_params(params, 2, 4, num_layers=L,
+                              tied_embeddings=False)
+    assert "embed" in first and "embed" not in last
+    assert "lm_head" in last and "lm_head" not in first
+    assert "final_norm" in last and "final_norm" not in first
+    # Stacked leaves (incl. nested quant dicts) slice on the leading axis.
+    assert first["layers"]["w"].shape == (2, 3)
+    assert last["layers"]["q"]["q"].shape == (2, 2)
+    np.testing.assert_array_equal(
+        np.asarray(last["layers"]["w"]), np.arange(12.0).reshape(4, 3)[2:]
+    )
+    # Tied embeddings: the last stage also needs the embed for lm_head.
+    tied = dict(params)
+    del tied["lm_head"]
+    t_last = slice_stage_params(tied, 2, 4, num_layers=L,
+                                tied_embeddings=True)
+    assert "embed" in t_last
+
+
+# --- engine parity -----------------------------------------------------------
+
+
+def test_pp2_greedy_and_seeded_parity():
+    """pp=2 must be token-identical to pp=1 for EVERY row — greedy,
+    seeded stochastic, and filtered sampling — across plain bucketed
+    prefill and decode."""
+    ref, _ = _engine_run(1, 1, 1)
+    got, _ = _engine_run(1, 1, 1, pp=2)
+    stats = _engine_run.engine_stats
+    assert stats["pp_stages"] == 2
+    assert stats["pp_boundary_transfers"] > 0
+    assert stats["pp_boundary_bytes"] > 0
+    assert stats["pp_wire"] == "device"
+    for rid in ref:
+        assert got[rid] == ref[rid], (
+            f"pp=2 diverged for {rid!r}: {ref[rid]} -> {got[rid]}"
+        )
+
+
+@pytest.mark.slow
+def test_pp2_chunked_block_and_mixed_parity():
+    """The three microbatched dispatch shapes — chunked prefill, fused
+    decode blocks, piggyback mixed — hold full-row parity under pp=2."""
+    ref, _ = _engine_run(1, 1, 1)
+    for kwargs in (
+        dict(prefill_chunk=8),
+        dict(decode_block=4),
+        dict(prefill_chunk=8, mixed_step="on"),
+    ):
+        got, _ = _engine_run(1, 1, 1, pp=2, **kwargs)
+        for rid in ref:
+            assert got[rid] == ref[rid], (
+                f"pp=2 {kwargs} diverged for {rid!r}: "
+                f"{ref[rid]} -> {got[rid]}"
+            )
+
+
+@pytest.mark.slow
+def test_pp2_tp2_two_tier_parity():
+    """The two-tier shape (pp outer over hosts, tp inner per host):
+    4 devices, 2 stages x tp=2 submeshes."""
+    ref, _ = _engine_run(1, 1, 1)
+    got, _ = _engine_run(1, 1, 2, pp=2)
+    for rid in ("a", "long"):
+        assert got[rid] == ref[rid], (
+            f"pp=2 x tp=2 diverged for {rid!r}: {ref[rid]} -> {got[rid]}"
+        )
+
+
+@pytest.mark.slow
+def test_pp_wire_codec_parity():
+    """LLMQ_PP_WIRE=1 routes every stage-boundary activation through the
+    snapshot wire codec (serialize → frame → digest check → decode) —
+    the in-process stand-in for the tcp:// hop between stage hosts. The
+    codec is lossless, so parity must be exact. Subprocess: the env var
+    is read at engine construction."""
+    code = (
+        "from __graft_entry__ import _engine_run\n"
+        "ref, _ = _engine_run(1, 1, 1)\n"
+        "got, _ = _engine_run(1, 1, 1, pp=2)\n"
+        "st = _engine_run.engine_stats\n"
+        "assert st['pp_wire'] == 'codec', st['pp_wire']\n"
+        "assert st['pp_boundary_transfers'] > 0\n"
+        "bad = [rid for rid in ref if got[rid] != ref[rid]]\n"
+        "print('DIVERGED' if bad else 'MATCHED', bad)\n"
+    )
+    env = dict(os.environ)
+    env["LLMQ_PP_WIRE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MATCHED" in proc.stdout, proc.stdout
+
+
+@pytest.mark.unit
+def test_pp_gates_unsupported_features():
+    """Features whose device state lives entirely on the head mesh in a
+    way pp cannot yet shard raise at construction, not mid-serve."""
+    from llmq_tpu.engine.engine import EngineConfig, EngineCore
+    from llmq_tpu.engine.tokenizer import ByteTokenizer
+    from llmq_tpu.models.presets import get_preset
+    from llmq_tpu.models.transformer import init_params
+
+    config = get_preset("tiny")
+    params = init_params(config, jax.random.key(0), dtype=jnp.float32)
+    mesh = make_mesh(tensor_parallel=1, pipeline_parallel=2)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        EngineCore(
+            config, params, ByteTokenizer(), mesh=mesh,
+            engine_config=EngineConfig(
+                max_num_seqs=4, max_model_len=64, page_size=8,
+                num_pages=32, spec_tokens=2,
+            ),
+        )

@@ -182,6 +182,7 @@ def test_ladders_parse():
     assert "sim_probe" in joined
     assert "shardcheck_probe" in joined
     assert "disagg_probe" in joined
+    assert "pp_probe" in joined
 
 
 def test_referenced_files_exist():
@@ -405,6 +406,49 @@ def test_sim_probe_runs():
     assert "replay leg ok" in proc.stdout
     assert "regression leg ok" in proc.stdout
     assert "metric: sim_probe_ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_bench_tiny_pp_rung_runs():
+    """The bench's pipeline-parallel rung runs on 2 CPU devices and the
+    metric line carries the staged-engine diagnostics: stage count,
+    GPipe bubble fraction, and stage-boundary activation bytes/token.
+    The deadline is lifted (TINY_ENV's 240 s budget trims the pp rung
+    first by design) and the other diagnostic rungs are opted out to
+    keep the run cheap."""
+    proc = _run(
+        {
+            **TINY_ENV,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "LLMQ_BENCH_DEADLINE": "100000",
+            "LLMQ_BENCH_TRY_PREFIX": "0",
+            "LLMQ_BENCH_TRY_DISAGG": "0",
+        },
+        ["python", "bench.py"],
+        timeout=580,
+    )
+    _assert_ran("bench:tiny-pp", proc)
+    assert '"pp_stages": 2' in proc.stdout
+    assert '"pp_vs_unified"' in proc.stdout
+    assert '"pp_bubble_fraction"' in proc.stdout
+    assert '"pp_boundary_bytes_per_token"' in proc.stdout
+
+
+@pytest.mark.slow
+def test_pp_probe_runs():
+    """The pipeline-parallel rung runs end to end on CPU (8 virtual
+    devices): pp=2 staged-engine token parity on every row, the two-tier
+    pp-outer x tp-inner mesh, and the stage-boundary wire-codec leg."""
+    proc = _run(
+        {**TINY_ENV},
+        ["python", "tools/pp_probe.py"],
+        timeout=400,
+    )
+    _assert_ran("tools:pp_probe", proc)
+    assert "parity leg ok" in proc.stdout
+    assert "two-tier leg ok" in proc.stdout
+    assert "wire leg ok" in proc.stdout
+    assert "metric: pp_probe_ok legs=3" in proc.stdout
 
 
 @pytest.mark.slow

@@ -183,6 +183,22 @@ class TestScenario:
                 faults=FaultSchedule(poison_jobs=3),
             ).validate()
 
+    def test_pp_stages_round_trips_and_validates(self):
+        scn = Scenario(
+            name="pp", fleet=FleetShape(workers=4, pp_stages=2)
+        )
+        back = Scenario.from_dict(scn.to_dict())
+        assert back == scn
+        assert back.fleet.pp_stages == 2
+        with pytest.raises(ValueError, match="pp_stages"):
+            Scenario(
+                name="pp", fleet=FleetShape(workers=4, pp_stages=0)
+            ).validate()
+        with pytest.raises(ValueError, match="cover every pipeline stage"):
+            Scenario(
+                name="pp", fleet=FleetShape(workers=2, pp_stages=3)
+            ).validate()
+
     def test_get_scenario_registry(self):
         scn = get_scenario("quarantine-poison")
         assert scn.faults.poison_jobs == 5
@@ -372,6 +388,42 @@ class TestFleetSim:
         assert len(report.results) + len(report.failed) == 400
         assert report.counters["workers_started"] == 200
         assert wall < 60.0, f"200-worker smoke took {wall:.1f}s wall"
+
+    def test_pipeline_stage_flow(self):
+        """pp_stages=2 runs the fleet over pipeline.<name>.<stage> queues
+        with the production stage-routing path: every job passes both
+        stages exactly once, poison still quarantines (at its stage),
+        per-stage counters land, and replay stays digest-identical."""
+        scenario = Scenario(
+            name="pp-flow",
+            seed=19,
+            traffic=TrafficShape(jobs=80, rate_jobs_s=40.0),
+            fleet=FleetShape(workers=6, concurrency=2, pp_stages=2),
+            faults=FaultSchedule(poison_jobs=1),
+            env={
+                "LLMQ_MAX_REDELIVERIES": "50",
+                "LLMQ_QUARANTINE_ATTEMPTS": "3",
+            },
+        )
+        report = FleetSim(scenario).run()
+        assert not report.timed_out
+        violations = check_invariants(report)
+        assert not violations, "\n".join(violations)
+        assert len(report.results) == 79
+        assert len(report.quarantined) == 1
+        assert report.counters["pp_stages"] == 2
+        # Each surviving job is processed once per stage; the poison job
+        # never clears stage 0, so s1 only sees the survivors.
+        assert report.counters["stage_jobs_processed"] == {
+            "s0": 79,
+            "s1": 79,
+        }
+        peaks = report.counters["stage_queue_depth_peak"]
+        assert set(peaks) == {"pipeline.twin.s0", "pipeline.twin.s1"}
+        # Results carry the final stage's output format.
+        assert all(str(r["result"]).startswith("sim:") for r in report.results)
+        replay = FleetSim(scenario).run()
+        assert replay.digest == report.digest
 
     def test_affinity_routing_and_reclaim(self):
         scenario = Scenario(

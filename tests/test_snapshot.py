@@ -32,10 +32,16 @@ from llmq_tpu.engine.snapshot import (
     SnapshotError,
     SnapshotIntegrityError,
     SnapshotVersionError,
+    WIRE_MAGIC,
     pages_for,
     repack_pages,
     snapshot_from_b64,
+    snapshot_from_wire,
     snapshot_to_b64,
+    snapshot_to_wire,
+    tensor_from_wire,
+    tensor_to_wire,
+    wire_format,
 )
 from llmq_tpu.engine.tokenizer import ByteTokenizer
 from llmq_tpu.models.config import ModelConfig
@@ -244,6 +250,97 @@ class TestCodec:
         kv = np.zeros((1, 2, 8, 1, 4), np.float32)
         with pytest.raises(SnapshotCompatError):
             repack_pages(kv, 16, 4, 3)
+
+
+class TestWireFormat:
+    """The transport framing layer: b64-in-JSON (default) vs the
+    length-prefixed binary frame (LLMQ_WIRE_FORMAT=binary), plus the
+    single-tensor frame the pipeline stage boundary ships."""
+
+    def test_wire_format_selection(self, monkeypatch):
+        monkeypatch.delenv("LLMQ_WIRE_FORMAT", raising=False)
+        assert wire_format() == "b64"
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "binary")
+        assert wire_format() == "binary"
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "msgpack")
+        with pytest.raises(ValueError, match="LLMQ_WIRE_FORMAT"):
+            wire_format()
+
+    def test_b64_wire_round_trip(self, monkeypatch):
+        monkeypatch.delenv("LLMQ_WIRE_FORMAT", raising=False)
+        snap = _codec_snapshot()
+        encoded = snapshot_to_wire(snap)
+        assert isinstance(encoded, str)  # JSON-embeddable
+        assert snapshot_from_wire(encoded).to_bytes() == snap.to_bytes()
+
+    def test_binary_wire_round_trip(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "binary")
+        snap = _codec_snapshot()
+        encoded = snapshot_to_wire(snap)
+        assert isinstance(encoded, bytes)
+        assert encoded.startswith(WIRE_MAGIC)
+        # No 4/3 base64 inflation: frame overhead is magic + u32 length.
+        assert len(encoded) == len(WIRE_MAGIC) + 4 + len(snap.to_bytes())
+        assert snapshot_from_wire(encoded).to_bytes() == snap.to_bytes()
+
+    def test_decoder_sniffs_both_formats(self, monkeypatch):
+        """Mixed-fleet migration: a decoder must read either encoding
+        regardless of its own LLMQ_WIRE_FORMAT setting."""
+        snap = _codec_snapshot()
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "binary")
+        binary = snapshot_to_wire(snap)
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "b64")
+        b64 = snapshot_to_wire(snap)
+        for encoded in (binary, b64, snap.to_bytes()):  # bare bytes too
+            assert snapshot_from_wire(encoded).to_bytes() == snap.to_bytes()
+
+    def test_binary_frame_truncation_rejected(self, monkeypatch):
+        monkeypatch.setenv("LLMQ_WIRE_FORMAT", "binary")
+        encoded = snapshot_to_wire(_codec_snapshot())
+        with pytest.raises(SnapshotIntegrityError):
+            snapshot_from_wire(encoded[: len(WIRE_MAGIC) + 2])
+        with pytest.raises(SnapshotIntegrityError):
+            snapshot_from_wire(encoded[: len(encoded) // 2])
+
+    @pytest.mark.parametrize(
+        "dtype", ["float32", "bfloat16", "int32"], ids=str
+    )
+    def test_tensor_frame_round_trip(self, dtype):
+        import ml_dtypes
+
+        np_dtype = (
+            np.dtype(getattr(ml_dtypes, dtype))
+            if dtype == "bfloat16"
+            else np.dtype(dtype)
+        )
+        rng = np.random.default_rng(11)
+        arr = rng.standard_normal((3, 4, 5)).astype(np_dtype)
+        back = tensor_from_wire(tensor_to_wire(arr, name="h"))
+        assert back.dtype == np_dtype and back.shape == arr.shape
+        assert np.array_equal(
+            back.view(np.uint8), arr.view(np.uint8)
+        )
+        # The decoded array owns its buffer (the frame may be reused).
+        assert back.flags["WRITEABLE"]
+
+    def test_tensor_frame_tamper_and_magic_rejected(self):
+        frame = bytearray(tensor_to_wire(np.arange(12.0).reshape(3, 4)))
+        frame[-1] ^= 0xFF
+        with pytest.raises(SnapshotIntegrityError, match="digest"):
+            tensor_from_wire(bytes(frame))
+        with pytest.raises(SnapshotError, match="magic"):
+            tensor_from_wire(b"XXXXXXXX" + bytes(frame[8:]))
+        with pytest.raises(SnapshotIntegrityError):
+            tensor_from_wire(bytes(frame[:10]))
+
+    def test_tensor_frame_rejects_snapshot_kind(self):
+        """A snapshot binary frame must not decode as a tensor (and the
+        version gate guards future layouts)."""
+        arr_frame = bytearray(tensor_to_wire(np.zeros(3)))
+        off = len(WIRE_MAGIC)
+        arr_frame[off] = 0xFF  # version u16 LE low byte
+        with pytest.raises(SnapshotVersionError):
+            tensor_from_wire(bytes(arr_frame))
 
 
 # --------------------------------------------------------------------------

@@ -72,6 +72,51 @@ def test_axes_label_multi_axis_and_self():
     assert spmd._axes_label([[0], [1]], shape) == "self"
 
 
+@pytest.mark.unit
+def test_axes_label_pp_boundary_crossing():
+    """Per-stage executables hold participant ids in [0, dp*sp*tp); an
+    id beyond that range means a group straddles a stage boundary and
+    must label ``pp`` — the signature the gate refuses to baseline."""
+    shape = (1, 1, 2, 2)  # inner = 2 devices per stage
+    assert spmd._axes_label([[0, 1]], shape) == "tp"  # intra-stage
+    assert spmd._axes_label([[0, 2]], shape) == "pp"  # cross-stage
+    assert spmd._axes_label([[0, 3]], shape) == "tp+pp"
+    # 3-component shapes never see a pp coordinate.
+    assert spmd._axes_label([[0, 1]], (1, 1, 2)) == "tp"
+
+
+@pytest.mark.unit
+def test_diff_pp_collective_always_fails():
+    """A ``pp``-labelled collective fails the diff even when a baseline
+    count would otherwise cover it: stage boundaries move data by host
+    transfer, never by collective."""
+    cur = {
+        "decode@1x1x2x2": _cur(
+            {"all-gather@pp": 1},
+            {"all-gather@pp": "jit(step)/x (transformer.py:1)"},
+        )
+    }
+    failures, _ = spmd.diff_signatures(
+        cur, {"decode@1x1x2x2": {"all-gather@pp": 1}}
+    )
+    assert len(failures) == 1
+    assert "pipeline-stage boundary" in failures[0]
+
+
+@pytest.mark.unit
+def test_parse_mesh_key_shapes():
+    assert spmd.parse_mesh_key("2x2x2") == (2, 2, 2)
+    assert spmd.parse_mesh_key("1x1x2x2") == (1, 1, 2, 2)
+    with pytest.raises(ValueError):
+        spmd.parse_mesh_key("2x2")
+    assert spmd.programs_for_shape((1, 1, 2, 2), spmd.PROGRAMS) == [
+        "prefill", "prefill1", "decode", "mixed"
+    ]
+    assert spmd.programs_for_shape((2, 2, 2), spmd.PROGRAMS) == list(
+        spmd.PROGRAMS
+    )
+
+
 # --- HLO signature extraction ------------------------------------------------
 
 _SYNTHETIC_HLO = """\
@@ -173,7 +218,7 @@ def test_committed_baseline_covers_matrix():
     payload = json.loads(spmd.BASELINE_PATH.read_text())
     keys = set(payload["signatures"])
     for shape in spmd.MESH_MATRIX:
-        for program in spmd.PROGRAMS:
+        for program in spmd.programs_for_shape(shape, spmd.PROGRAMS):
             assert spmd.program_key(program, shape) in keys
     # Degenerate meshes legitimately record empty signatures (prefill
     # on pure-DP replicates everything), but the load-bearing program —
@@ -183,6 +228,16 @@ def test_committed_baseline_covers_matrix():
     assert sig["prefill1@2x2x2"], "prefill1@2x2x2 recorded no collectives"
     assert any(k.startswith("collective-permute") for k in sig["prefill1@2x2x2"])
     assert sig["decode@2x2x2"] and sig["mixed@2x2x2"] and sig["verify@2x2x2"]
+    # pp rows: the tp=2 stages carry ordinary intra-stage tp collectives,
+    # and NO recorded key may ever carry a pp axis label (stage-boundary
+    # traffic is host-driven transfer, not a collective).
+    assert sig["decode@1x1x2x2"] and all(
+        key.endswith("@tp") for key in sig["decode@1x1x2x2"]
+    )
+    for key in sig:
+        for ckey in sig[key]:
+            axes = ckey.split("@", 1)[1]
+            assert "pp" not in axes.split("+"), f"{key}: {ckey}"
 
 
 # --- end-to-end subprocess legs ---------------------------------------------

@@ -74,6 +74,29 @@ async def test_tpu_worker_end_to_end(mem_url):
         assert r.model_dump()["word"].startswith("w")
 
 
+def test_worker_id_unique_in_process(mem_url):
+    """Two workers in ONE process (the disagg prefill/decode pair) must
+    not share a worker_id: host+pid alone collided, which made peer
+    discovery see the pair as one worker and the KV handoff silently
+    take the snapshot fallback every time (PERF_NOTES round 16). The id
+    also carries the configured role so heartbeats and queue names are
+    self-describing."""
+    a = make_worker(mem_url)
+    b = make_worker(mem_url)
+    assert a.worker_id != b.worker_id
+    assert a.worker_id.startswith("tpu-worker-")
+    assert "-unified-i" in a.worker_id
+    # Role rides in the id: a prefill-role worker is distinguishable
+    # from a decode-role worker on the same host+pid at a glance.
+    config = Config(broker_url=mem_url, worker_role="prefill")
+    c = TPUWorker(
+        "tpu-q", config=config, model="preset://tiny", tensor_parallel=1,
+        dtype="float32", max_num_seqs=4,
+    )
+    assert "-prefill-i" in c.worker_id
+    assert len({a.worker_id, b.worker_id, c.worker_id}) == 3
+
+
 async def test_tpu_worker_messages_job(mem_url):
     jobs = [
         Job(

@@ -65,6 +65,10 @@ run 900 disagg_probe python tools/disagg_probe.py
 #     diff + detune teeth (CPU subprocesses; cheap, guards the mesh
 #     matrix the benches below depend on).
 run 900 shardcheck_probe env JAX_PLATFORMS=cpu python tools/shardcheck_probe.py
+# 1k. Pipeline-parallel plane: pp=2 staged-engine parity + two-tier
+#     mesh + stage-boundary wire codec on the real devices (single-chip
+#     sessions note-and-skip; cheap, stays ahead of the long benches).
+run 900 pp_probe python tools/pp_probe.py
 # 2. Driver-style run: quant-first attempt + canary + fallback, exactly
 #    what the end-of-round BENCH will execute.
 run 3900 bench_driver_style python bench.py

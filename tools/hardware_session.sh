@@ -80,6 +80,10 @@ run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
 # diff vs the committed baseline + MoE token-pin detune teeth (runs its
 # jax legs in CPU subprocesses; never touches the accelerator).
 run 900 shardcheck_probe env JAX_PLATFORMS=cpu python tools/shardcheck_probe.py
+# Pipeline-parallel plane: pp=2 staged-engine token parity, the two-tier
+# pp-outer x tp-inner mesh, and the stage-boundary wire codec — on the
+# real ICI/DCN domains here (single-chip sessions note-and-skip).
+run 900 pp_probe python tools/pp_probe.py
 run 1800 bench_bf16   python bench.py
 run 1800 bench_int8_3b env LLMQ_BENCH_DTYPE=int8 python bench.py
 run 1800 bench_int8_9b env LLMQ_BENCH_DTYPE=int8 \
