@@ -518,6 +518,7 @@ def trim_plan(
     prefix_s: float = 0.0,
     disagg_s: float = 0.0,
     pp_s: float = 0.0,
+    serve_s: float = 0.0,
 ) -> dict:
     """Budget-aware phase trimming (pure — unit-tested in
     tests/test_bench.py). Given the seconds left on LLMQ_BENCH_DEADLINE
@@ -542,11 +543,17 @@ def trim_plan(
       reference pass + the pipelined handoff pass),
     - ``pp_rung``: the pipeline-parallel staged-engine rung at the
       winning point (``pp_s``: one extra build over the pp=2 mesh + a
-      measure pass; a no-op rung on single-device meshes).
+      measure pass; a no-op rung on single-device meshes),
+    - ``serve_rung``: the SLO priority-scheduling rung at the winning
+      point (``serve_s``: one extra build + a FIFO-baseline pass and a
+      priority pass over the same co-scheduled interactive+batch
+      arrival trace).
 
     The proven bf16 headline (``proven_s``) is the floor and is never
     dropped — a bench that measures *something* always beats a watchdog
-    0.0. Drop order is by speculation: the pp rung first (the model
+    0.0. Drop order is by speculation: the serve rung first (it prices
+    the latency plane — interactive TTFT under batch load — and never
+    touches the headline throughput number), then the pp rung (the model
     FITS one host here by construction — the rung only prices the
     bubble fraction and stage-boundary bytes a real multi-host pipeline
     would pay, never the headline number), then the disagg rung (purely
@@ -569,6 +576,7 @@ def trim_plan(
     """
     # (name, cost) in DROP order: most speculative first.
     phases = (
+        ("serve_rung", serve_s),
         ("pp_rung", pp_s),
         ("disagg_rung", disagg_s),
         ("prefix_rung", prefix_s),
@@ -769,6 +777,9 @@ def main() -> None:
         # The pipeline-parallel rung is one extra build (pp=2 staged
         # mesh, per-stage executables) + measure at the winning point.
         pp_s=300.0,
+        # The serve rung is one extra build + two short co-scheduled
+        # passes (FIFO baseline, then priority) at the winning point.
+        serve_s=240.0,
         proven_s=300.0,
     )
     if not all(plan.values()):
@@ -1676,6 +1687,106 @@ def main() -> None:
 
         gc.collect()
 
+    # SLO serve rung at the winning (slots, K) point: co-schedule a
+    # saturating batch workload with a trickle of short interactive
+    # requests over the SAME arrival trace twice — once with every
+    # request labeled batch (FIFO baseline) and once with the trickle
+    # labeled interactive (priority admission + preemption). The product
+    # is the interactive TTFT p95 under load and what the priority path
+    # costs the batch plane — diagnostics, never the headline. The FIFO
+    # pass runs FIRST so the engine's lazily-enabled priority plane
+    # can't leak into the baseline.
+    serve_metrics: dict = {}
+    if (
+        plan["serve_rung"]
+        and os.environ.get("LLMQ_BENCH_TRY_SERVE", "1").lower()
+        not in ("0", "false")
+    ):
+        try:
+            core = build_core(max_seqs, best_block, 0, mixed=mixed_resolved)
+            run(1, "warmup-single")
+            run(min(core.cfg.max_prefill_batch, n_requests), "warmup-batch")
+
+            def serve_pass(tag, interactive):
+                srng = np.random.default_rng(7)
+                n_batch = max(max_seqs * 2, 8)
+                n_int = 16
+                int_prompt = max(8, prompt_len // 4)
+                for i in range(n_batch):
+                    ids = srng.integers(
+                        1, config.vocab_size, size=prompt_len
+                    ).tolist()
+                    core.add_request(f"{tag}-b{i}", prompt_ids=ids, params=sp())
+                ttfts, added, steps = [], 0, 0
+                gen_before = core.total_generated_tokens
+                start = time.monotonic()
+                while core.has_work or added < n_int:
+                    if added < n_int and steps % 8 == 0:
+                        ids = srng.integers(
+                            1, config.vocab_size, size=int_prompt
+                        ).tolist()
+                        core.add_request(
+                            f"{tag}-i{added}",
+                            prompt_ids=ids,
+                            params=SamplingParams(
+                                temperature=0.0, max_tokens=16,
+                                ignore_eos=True,
+                            ),
+                            priority=(
+                                "interactive" if interactive else "batch"
+                            ),
+                        )
+                        added += 1
+                    for out in core.step():
+                        t = out.timing or {}
+                        if out.rid.startswith(f"{tag}-i") and (
+                            "first_token" in t and "enqueued" in t
+                        ):
+                            ttfts.append(t["first_token"] - t["enqueued"])
+                    steps += 1
+                elapsed = time.monotonic() - start
+                out_tok = core.total_generated_tokens - gen_before
+                batch_tok_s = (out_tok - n_int * 16) / elapsed
+                ttfts.sort()
+                p95 = (
+                    ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
+                    if ttfts
+                    else 0.0
+                )
+                return p95 * 1000.0, batch_tok_s
+
+            fifo_ttft_ms, fifo_tok_s = serve_pass("sf", interactive=False)
+            prio_ttft_ms, prio_tok_s = serve_pass("sp", interactive=True)
+            serve_metrics = {
+                "ttft_p95_interactive": round(prio_ttft_ms, 1),
+                "ttft_p95_interactive_fifo": round(fifo_ttft_ms, 1),
+                "batch_tok_s": round(prio_tok_s, 1),
+                "batch_tok_s_fifo": round(fifo_tok_s, 1),
+                "priority_preemptions": int(
+                    core.stats().get("priority_preemptions", 0)
+                ),
+            }
+            print(
+                f"bench: serve rung -> interactive ttft p95 "
+                f"{prio_ttft_ms:.0f} ms (fifo {fifo_ttft_ms:.0f} ms), "
+                f"batch {prio_tok_s:.1f} tok/s "
+                f"(fifo {fifo_tok_s:.1f}), "
+                f"{serve_metrics['priority_preemptions']} preemptions",
+                file=sys.stderr,
+            )
+        except Exception as exc:  # noqa: BLE001 — skip only on OOM
+            if not is_oom(exc):
+                raise
+            exc.__traceback__ = None
+            print(
+                "bench: serve rung exhausted HBM; skipping",
+                file=sys.stderr,
+            )
+        core = None
+        import gc
+
+        gc.collect()
+
     tok_s_chip = tok_s / len(devices)
     # MoE presets: throughput scales with ACTIVE params per token (the
     # FLOPs actually spent), not the total parameter count.
@@ -1734,6 +1845,10 @@ def main() -> None:
         # device): staged-engine throughput vs single-stage, GPipe
         # bubble fraction, and stage-boundary bytes/token — diagnostics.
         **pp_metrics,
+        # SLO serve rung (absent when trimmed/opted out): interactive
+        # TTFT p95 under co-scheduled batch load, priority vs FIFO, and
+        # the batch-throughput cost of priority — diagnostics.
+        **serve_metrics,
         **(
             {"kv_dtype": kv_env}
             if kv_env not in ("", "auto")

@@ -50,6 +50,7 @@ FAILED_SUFFIX = ".failed"
 HEALTH_SUFFIX = ".health"
 QUARANTINE_SUFFIX = ".quarantine"
 DECODE_SUFFIX = ".decode"
+INTERACTIVE_SUFFIX = ".interactive"
 
 # Heartbeat cadence (workers publish WorkerHealth this often) and the
 # fleet-wide staleness threshold derived from it: a worker that missed two
@@ -105,6 +106,30 @@ def kv_fetch_queue_name(queue: str, worker_id: str) -> str:
     (and, in a disaggregated fleet, KV adoption offers at the
     prefill→decode phase boundary)."""
     return f"{queue}.kv.{worker_id}"
+
+
+def interactive_queue_name(queue: str) -> str:
+    """Per-queue SLO fast lane: jobs submitted with ``priority:
+    interactive`` publish here instead of the shared queue. Workers
+    consume both and drain this one first, so interactive work never
+    waits behind a deep batch backlog at the broker."""
+    return queue + INTERACTIVE_SUFFIX
+
+
+def ctl_queue_name(queue: str, worker_id: str) -> str:
+    """Per-worker control queue (cancellation). The streaming gateway
+    publishes ``{"cancel": job_id}`` here when a client disconnects
+    mid-stream; the worker cancels the request in-engine, freeing its
+    pages and settling the job."""
+    return f"{queue}.ctl.{worker_id}"
+
+
+def stream_queue_name(queue: str, job_id: str) -> str:
+    """Per-request token-delta stream queue. Workers publish incremental
+    text frames here while the request decodes; the gateway consumes and
+    forwards them as SSE chunks. Short-TTL and best-effort — the final
+    ``Result`` on ``<q>.results`` remains the settlement of record."""
+    return f"{queue}.stream.{job_id}"
 
 
 def decode_queue_name(queue: str) -> str:
@@ -175,6 +200,8 @@ class BrokerManager:
         self.affinity_fallback = 0
         self.affinity_reclaimed = 0
         self.jobs_shed = 0
+        self.jobs_shed_interactive = 0
+        self.interactive_routed = 0
 
     @property
     def broker(self) -> Broker:
@@ -240,6 +267,15 @@ class BrokerManager:
             results_queue_name(queue), max_redeliveries=1_000_000_000
         )
         await self.broker.declare_queue(queue + FAILED_SUFFIX)
+        if self.config.priority_classes:
+            # SLO fast lane: same retention policy as the shared queue.
+            # Jobs that never set priority never land here, so a
+            # priority-free fleet sees only one extra (empty) queue.
+            await self.broker.declare_queue(
+                interactive_queue_name(queue),
+                ttl_ms=self.config.job_ttl_ms,
+                max_redeliveries=self.config.max_redeliveries,
+            )
         if self.config.quarantine_attempts > 0:
             await self.broker.declare_queue(queue + QUARANTINE_SUFFIX)
         if self.config.worker_role != "unified":
@@ -533,11 +569,16 @@ class BrokerManager:
         self._fleet_rate[queue] = (now, result)
         return result
 
-    async def _should_shed(self, queue: str, deadline_at: float) -> bool:
+    async def _should_shed(
+        self, queue: str, deadline_at: float, depth_queue: Optional[str] = None
+    ) -> bool:
         """Publish-side load shedding: when queue depth divided by the
         observed fleet service rate cannot meet this job's deadline, fail
         it NOW as a dead-letter instead of letting it queue, time out,
-        and waste a worker slot discovering that."""
+        and waste a worker slot discovering that. ``depth_queue`` lets a
+        fast-lane job be judged against ITS lane's backlog (the service
+        rate still comes from the base queue's heartbeats) — an
+        interactive job must not shed because the batch lane is deep."""
         budget_s = deadline_at - clock.wall()
         if budget_s <= 0:
             return True  # already expired at submit
@@ -545,7 +586,9 @@ class BrokerManager:
         if rate is None:
             return False  # no observed service rate: don't guess
         try:
-            depth = (await self.get_queue_stats(queue)).message_count_ready
+            depth = (
+                await self.get_queue_stats(depth_queue or queue)
+            ).message_count_ready
         except Exception:  # noqa: BLE001
             depth = None
         if depth is None:
@@ -573,6 +616,8 @@ class BrokerManager:
             },
         )
         self.jobs_shed += 1
+        if job.priority_class == "interactive":
+            self.jobs_shed_interactive += 1
 
     # --- publish ----------------------------------------------------------
     async def publish_job(self, queue: str, job: Job) -> None:
@@ -584,16 +629,33 @@ class BrokerManager:
             budget_ms = job.deadline_ms or self.config.deadline_ms or 0
             if budget_ms > 0:
                 job.deadline_at = clock.wall() + budget_ms / 1000.0
+        interactive = (
+            self.config.priority_classes
+            and job.priority_class == "interactive"
+            and not queue.endswith(INTERACTIVE_SUFFIX)
+        )
         if job.deadline_at is not None:
             try:
-                shed = await self._should_shed(queue, job.deadline_at)
+                shed = await self._should_shed(
+                    queue,
+                    job.deadline_at,
+                    depth_queue=(
+                        interactive_queue_name(queue) if interactive else None
+                    ),
+                )
             except Exception:  # noqa: BLE001 — admission control best-effort
                 shed = False
             if shed:
                 await self.shed_job(queue, job, reason="admission_control")
                 return
         target = queue
-        if self.config.prefix_affinity:
+        if interactive:
+            # Fast lane beats affinity: the interactive queue is drained
+            # ahead of the shared backlog by every worker, which bounds
+            # TTFT better than landing behind one worker's private queue.
+            target = interactive_queue_name(queue)
+            self.interactive_routed += 1
+        elif self.config.prefix_affinity:
             try:
                 target = await self._route_for_affinity(queue, job)
             except Exception:  # noqa: BLE001 — routing is best-effort

@@ -57,7 +57,11 @@ def cli() -> None:
 @click.option("--split", default="train", show_default=True, help="HF dataset split")
 @click.option("--subset", default=None, help="HF dataset subset/config name")
 @click.option("--limit", type=int, default=None, help="Submit at most N jobs")
-def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subset, limit):
+@click.option("--priority", type=click.Choice(["interactive", "batch"]),
+              default=None,
+              help="SLO class stamped on every job (row-level fields win); "
+                   "interactive rides the fast lane and preempts batch work")
+def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subset, limit, priority):
     """Submit jobs from a JSONL file, '-' (stdin), or an HF dataset.
 
     QUEUE_OR_PIPELINE is a queue name, or with -p a pipeline YAML path.
@@ -66,6 +70,8 @@ def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subs
 
     mapping = _parse_maps(map_args)
     if is_pipeline:
+        if priority is not None:
+            mapping.setdefault("priority", priority)
         asyncio.run(
             run_pipeline_submit(
                 queue_or_pipeline, source, mapping,
@@ -77,6 +83,7 @@ def submit(queue_or_pipeline, source, map_args, is_pipeline, stream, split, subs
             run_submit(
                 queue_or_pipeline, source, mapping,
                 stream=stream, split=split, subset=subset, limit=limit,
+                priority=priority,
             )
         )
 
@@ -209,6 +216,48 @@ def trace(job_id, queue):
     from llmq_tpu.cli.monitor import trace_job
 
     asyncio.run(trace_job(queue, job_id))
+
+
+@cli.command()
+@click.argument("queue")
+@click.option("--host", default="127.0.0.1", show_default=True,
+              help="Bind address for the HTTP server")
+@click.option("--port", type=int, default=None,
+              help="Bind port (default: config serve_port / LLMQ_SERVE_PORT; "
+                   "0 = ephemeral)")
+@click.option("--model-name", default="llmq-tpu", show_default=True,
+              help="Model id reported by /v1/models and in responses")
+@click.option("--priority", type=click.Choice(["interactive", "batch"]),
+              default="interactive", show_default=True,
+              help="Default SLO class for requests that don't set one")
+def serve(queue, host, port, model_name, priority):
+    """Run the OpenAI-compatible HTTP/SSE gateway in front of QUEUE.
+
+    Endpoints: POST /v1/completions, POST /v1/chat/completions
+    (stream=true for SSE token deltas), GET /v1/models, GET /healthz.
+    Requests default to the interactive SLO class, so they ride the
+    fast lane ahead of the batch backlog.
+    """
+    import time as _time
+
+    from llmq_tpu.gateway import ServingGateway
+
+    gw = ServingGateway(
+        queue,
+        host=host,
+        port=port,
+        model_name=model_name,
+        default_priority=priority,
+    )
+    gw.start()
+    click.echo(f"Serving {queue} on http://{host}:{gw.port} (Ctrl-C to stop)")
+    try:
+        while True:
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
 
 
 @cli.group()

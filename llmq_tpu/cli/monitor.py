@@ -22,6 +22,7 @@ from llmq_tpu.broker.manager import (
     QUARANTINE_SUFFIX,
     BrokerManager,
     decode_queue_name,
+    interactive_queue_name,
     results_queue_name,
 )
 from llmq_tpu.core.config import get_config
@@ -415,6 +416,7 @@ def _render_top(
     quarantine_depth: Optional[int] = None,
     top: int = 40,
     decode_depth: Optional[int] = None,
+    interactive_depth: Optional[int] = None,
 ):
     """One refresh frame: fleet summary line + per-worker table, built
     from the freshest heartbeat per worker. At fleet scale (thousands of
@@ -455,6 +457,18 @@ def _render_top(
         header += f" | [red]suspect {suspects}[/red]"
     if quarantine_depth:
         header += f" | [red]quarantined {quarantine_depth}[/red]"
+    # SLO priority plane, superset-only: the fast-lane depth and fleet
+    # preemption count render only for a fleet actually serving
+    # interactive traffic — a priority-free fleet's summary line stays
+    # byte-identical to the pre-priority one.
+    if interactive_depth is not None:
+        header += f" | interactive ready {interactive_depth}"
+    preempts = sum(
+        (h.engine_stats or {}).get("priority_preemptions") or 0
+        for h in fresh.values()
+    )
+    if preempts:
+        header += f" | preempts {preempts}"
     role_line = _role_summary(fresh, decode_depth)
     if role_line:
         header += "\n" + role_line
@@ -485,6 +499,15 @@ def _render_top(
         "reconnects",
         "last seen",
     ]
+    # Per-class latency column, superset-only: appears once any worker
+    # heartbeats the interactive SLO series (first interactive request
+    # seen); shows that worker's interactive-class ttft/itl p95.
+    show_priority = any(
+        "ttft_p95_ms_interactive" in (h.engine_stats or {})
+        for h in beats.values()
+    )
+    if show_priority:
+        cols.insert(8, "int ttft/itl p95 ms")
     if show_integrity:
         cols.insert(8, "integrity")
     if show_selfheal:
@@ -533,6 +556,13 @@ def _render_top(
             str(health.reconnects) if health.reconnects is not None else "-",
             health.last_seen.strftime("%H:%M:%S"),
         ]
+        if show_priority:
+            cells.insert(
+                8,
+                _fmt_pcts(
+                    es, "ttft_p95_ms_interactive", "itl_p95_ms_interactive"
+                ),
+            )
         if show_integrity:
             cells.insert(8, _integrity_cell(health, es))
         if show_selfheal:
@@ -580,11 +610,29 @@ async def monitor_top(
                     if dstats.stats_source != "unavailable"
                     else None
                 )
+                # Fast-lane depth, superset-only: rendered only when the
+                # lane has backlog or some worker already serves the
+                # interactive class — an idle (or priority-free) fleet's
+                # dashboard keeps its exact pre-priority shape.
+                istats = await mgr.get_queue_stats(
+                    interactive_queue_name(queue)
+                )
+                idepth = (
+                    istats.message_count_ready
+                    if istats.stats_source != "unavailable"
+                    else None
+                )
+                if not idepth and not any(
+                    "ttft_p95_ms_interactive" in (h.engine_stats or {})
+                    for h in beats.values()
+                ):
+                    idepth = None
                 live.update(
                     _render_top(
                         queue, beats, stats,
                         quarantine_depth=qdepth, top=top,
                         decode_depth=ddepth,
+                        interactive_depth=idepth,
                     ),
                     refresh=True,
                 )

@@ -22,7 +22,7 @@ from typing import Any, Dict, Iterator, Optional
 
 from llmq_tpu.broker.manager import BrokerManager
 from llmq_tpu.core.config import get_config
-from llmq_tpu.core.models import Job, Result
+from llmq_tpu.core.models import JOB_PRIORITIES, Job, Result
 from llmq_tpu.core.pipeline import PipelineConfig, load_pipeline_config
 from llmq_tpu.core.template import create_job_from_row
 
@@ -170,7 +170,12 @@ class JobSubmitter:
         limit: Optional[int] = None,
         broker: Optional[BrokerManager] = None,
         stream_idle_timeout: float = 30.0,
+        priority: Optional[str] = None,
     ) -> None:
+        if priority is not None and priority not in JOB_PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {JOB_PRIORITIES}, got {priority!r}"
+            )
         self.queue = queue
         self.source = source
         self.mapping = mapping or {}
@@ -178,6 +183,10 @@ class JobSubmitter:
         self.split = split
         self.subset = subset
         self.limit = limit
+        # SLO class stamped onto every submitted job (row-level priority
+        # fields win); None stamps nothing — payloads stay byte-identical
+        # to a pre-priority submit.
+        self.priority = priority
         self.config = get_config()
         self.broker = broker or BrokerManager(self.config)
         self._owns_broker = broker is None
@@ -230,6 +239,8 @@ class JobSubmitter:
                 job_dict = create_job_from_row(
                     row, self.mapping or None, job_id=f"{run_id}-{seq}"
                 )
+                if self.priority is not None:
+                    job_dict.setdefault("priority", self.priority)
                 chunk.append(Job(**job_dict))
             except Exception as exc:  # noqa: BLE001 — skip bad rows, keep going
                 logger.warning("Skipping invalid row %d: %s", seq, exc)
@@ -423,12 +434,14 @@ async def run_submit(
     split: str = "train",
     subset: Optional[str] = None,
     limit: Optional[int] = None,
+    priority: Optional[str] = None,
 ) -> None:
     from llmq_tpu.utils.logging import setup_logging
 
     setup_logging(structured=False)
     submitter = JobSubmitter(
-        queue, source, mapping, stream=stream, split=split, subset=subset, limit=limit
+        queue, source, mapping, stream=stream, split=split, subset=subset,
+        limit=limit, priority=priority,
     )
     await submitter.run()
 

@@ -258,6 +258,47 @@ class Config(BaseModel):
         "default: result JSON stays byte-identical.",
     )
 
+    # --- SLO priority classes / online serving ----------------------------
+    priority_classes: bool = Field(
+        default_factory=lambda: (_env("LLMQ_PRIORITY_CLASSES") or "1").lower()
+        not in ("0", "false", "no", "off"),
+        description="SLO priority classes: jobs carrying priority="
+        "'interactive' route to the per-queue fast lane <q>.interactive "
+        "and are admitted ahead of batch work at the engine. On by "
+        "default; a fleet that never sets Job.priority is unaffected "
+        "(the fast lane stays empty and admission order is FIFO). "
+        "Set LLMQ_PRIORITY_CLASSES=0 to force pure FIFO everywhere "
+        "(the detune the policy regression documents).",
+    )
+
+    priority_preempt: bool = Field(
+        default_factory=lambda: (_env("LLMQ_PRIORITY_PREEMPT") or "1").lower()
+        not in ("0", "false", "no", "off"),
+        description="Allow the engine to preempt a running batch sequence "
+        "(swap-preempt under preempt_mode=swap, else recompute) when an "
+        "interactive sequence would otherwise queue for a slot. Greedy "
+        "outputs stay token-identical either way — preemption changes "
+        "only scheduling order, never a sequence's token stream.",
+    )
+
+    interactive_decode_block: int = Field(
+        default_factory=lambda: _env_int(
+            "LLMQ_INTERACTIVE_DECODE_BLOCK", default=0
+        ),
+        description="Fused-decode K for steps whose batch contains an "
+        "interactive row: the engine compiles a second small-K decode "
+        "executable and dispatches it whenever interactive work is "
+        "resident, so interactive ITL is bounded by K_small iterations "
+        "while pure-batch steps keep the big fused decode_block. "
+        "0 = off (every step uses decode_block).",
+    )
+
+    serve_port: int = Field(
+        default_factory=lambda: _env_int("LLMQ_SERVE_PORT", default=8100),
+        description="HTTP port for the OpenAI-compatible streaming "
+        "gateway (llmq-tpu serve). 0 binds an ephemeral port.",
+    )
+
     # --- queue/job policy -------------------------------------------------
     job_ttl_minutes: int = Field(
         default_factory=lambda: _env_int("LLMQ_JOB_TTL_MINUTES", default=30),

@@ -38,6 +38,12 @@ _RESERVED_JOB_FIELDS = {
     "deadline_at",
 }
 
+# SLO classes a job may declare via the ``priority`` passthrough extra.
+# "interactive" rides the per-queue fast lane and preempts batch work at
+# the engine; absent/None means "batch" (the pre-priority behavior, and
+# the payload stays byte-identical to a pre-priority submit).
+JOB_PRIORITIES = ("interactive", "batch")
+
 
 def utcnow() -> datetime:
     """Current UTC time through the injectable clock — heartbeats,
@@ -108,7 +114,19 @@ class Job(BaseModel):
             )
         if self.prompt is None and self.messages is None:
             raise ValueError("Must specify either 'prompt' or 'messages'.")
+        priority = (self.__pydantic_extra__ or {}).get("priority")
+        if priority is not None and priority not in JOB_PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {JOB_PRIORITIES}, got {priority!r}"
+            )
         return self
+
+    @property
+    def priority_class(self) -> str:
+        """Effective SLO class: the ``priority`` extra, defaulting to
+        ``batch``. Kept an extra (not a declared field) so a job that
+        never set it publishes byte-identical pre-priority payloads."""
+        return (self.__pydantic_extra__ or {}).get("priority") or "batch"
 
     def extras(self) -> Dict[str, Any]:
         """Extra (non-schema) fields — template variables / passthrough data."""

@@ -135,6 +135,11 @@ logger = logging.getLogger(__name__)
 #: the common case there.
 ITL_BUCKETS: Tuple[float, ...] = (0.0001, 0.00025, 0.0005) + DEFAULT_BUCKETS
 
+#: Cancellation requests for rids the engine doesn't hold (result already
+#: emitted, or a disconnect raced the publish) age out of the sweep map
+#: after this many seconds so it can never grow unboundedly.
+_CANCEL_TTL_S = 5.0
+
 
 @dataclasses.dataclass
 class RequestOutput:
@@ -261,6 +266,28 @@ class EngineConfig:
     # restored pages are the exact bytes the uninterrupted run would have
     # read). LLMQ_PREEMPT_MODE pins over this.
     preempt_mode: str = "recompute"
+    # SLO priority classes: interactive sequences are admitted before
+    # batch waiters and (priority_preempt) may swap/recompute-preempt
+    # the youngest prefilled batch victim when they would otherwise
+    # queue for a slot. Scheduling-order only — no sequence's own token
+    # stream ever changes, so greedy outputs are token-identical with
+    # the knob off. The admission order itself changes only once the
+    # first interactive request arrives (lazily enabled, like
+    # deadlines), so priority-free deployments are byte-identical.
+    # LLMQ_PRIORITY_CLASSES pins over this.
+    priority_classes: bool = True
+    # Allow interactive admission to preempt a running batch sequence
+    # (rides preempt_mode: swap gathers the victim's KV to host, else
+    # recompute). LLMQ_PRIORITY_PREEMPT pins over this.
+    priority_preempt: bool = True
+    # Small-K interactive decode: when > 0 (and < decode_block) the
+    # engine compiles a SECOND fused decode/verify executable at this
+    # many scan iterations and dispatches it whenever an interactive
+    # row is resident, so interactive ITL is bounded by the small K
+    # while pure-batch steps keep the big fused decode_block. 0 = off
+    # (every step uses decode_block — the pre-priority executables,
+    # bit-for-bit). LLMQ_INTERACTIVE_DECODE_BLOCK pins over this.
+    interactive_decode_block: int = 0
     # Host-RAM prefix cold tier (GiB of host blobs; 0 = off; requires
     # enable_prefix_caching): cache-registered pages evicted from the
     # device pool park in host RAM keyed by their chain digest, and a
@@ -346,6 +373,12 @@ class EngineConfig:
         if self.preempt_mode not in ("recompute", "swap"):
             raise ValueError(
                 f"preempt_mode={self.preempt_mode!r} (want recompute|swap)"
+            )
+        self.interactive_decode_block = int(self.interactive_decode_block)
+        if self.interactive_decode_block < 0:
+            raise ValueError(
+                f"interactive_decode_block={self.interactive_decode_block} "
+                f"(want >= 0)"
             )
         self.prefix_host_gb = float(self.prefix_host_gb)
         if self.prefix_host_gb < 0:
@@ -707,6 +740,38 @@ class EngineCore:
             self.preempt_mode = preempt
         else:
             self.preempt_mode = self.cfg.preempt_mode
+        # SLO priority classes: env pins over config like the knobs
+        # above. interactive_decode_block is a trace-time constant (it
+        # sizes the second small-K executable), so it must resolve
+        # before _build_steps below.
+        pcls = os.environ.get("LLMQ_PRIORITY_CLASSES", "").lower()
+        if pcls in ("0", "false", "no", "off"):
+            self.priority_classes = False
+        elif pcls in ("1", "true", "yes", "on"):
+            self.priority_classes = True
+        else:
+            self.priority_classes = self.cfg.priority_classes
+        ppre = os.environ.get("LLMQ_PRIORITY_PREEMPT", "").lower()
+        if ppre in ("0", "false", "no", "off"):
+            self.priority_preempt = False
+        elif ppre in ("1", "true", "yes", "on"):
+            self.priority_preempt = True
+        else:
+            self.priority_preempt = self.cfg.priority_preempt
+        ik = self.cfg.interactive_decode_block
+        env_ik = os.environ.get("LLMQ_INTERACTIVE_DECODE_BLOCK", "").strip()
+        if env_ik:
+            try:
+                ik = int(env_ik)
+            except ValueError:
+                raise ValueError(
+                    f"LLMQ_INTERACTIVE_DECODE_BLOCK={env_ik!r} is not an int"
+                ) from None
+        if ik < 0:
+            raise ValueError(
+                f"interactive_decode_block={ik} (want >= 0)"
+            )
+        self.interactive_decode_block = ik if self.priority_classes else 0
         # Host-RAM prefix cold tier: env pins over config like the knobs
         # above. Resolved before hook attachment so the scheduler's
         # eviction path demotes from the very first request.
@@ -841,6 +906,10 @@ class EngineCore:
         self._buckets = _prefill_buckets(
             self.cfg, sp=int(self.mesh.shape.get(SP_AXIS, 1))
         )
+        # Small-K interactive decode executables; _make_jits populates
+        # this when interactive_decode_block is on (pp=1 only — the pp
+        # drivers keep the single big-K pipeline).
+        self._decode_jits_small: Optional[Dict[str, Any]] = None
         self._build_steps()
 
         # Host-side mirrors of the device decode state, rebuilt wholesale
@@ -919,6 +988,25 @@ class EngineCore:
         self.prefix_chunks_exported = 0  # pages serialized for peers
         self.prefix_chunks_ingested = 0  # shipped pages accepted
         self.deadline_expirations = 0  # sequences expired by the sweep
+        # SLO priority plane. _priority_enabled flips at the first
+        # interactive request (like _deadlines_enabled): a fleet that
+        # never sets Job.priority keeps the exact pre-priority admission
+        # order AND byte-identical stats payloads.
+        self._priority_enabled = False
+        self.priority_preemptions = 0  # batch victims evicted for interactive
+        # Per-class finish accounting for goodput: requests that finished
+        # cleanly ("stop"/"length"/EOS) vs shed/expired/cancelled ones.
+        self.class_finished = {"interactive": 0, "batch": 0}
+        self.class_tokens = {"interactive": 0, "batch": 0}
+        # Client-disconnect cancellation: rid → monotonic enqueue time.
+        # Swept between steps; unknown rids (result already out, or a
+        # request this engine never saw) age out after _CANCEL_TTL_S.
+        self._cancel_rids: Dict[str, float] = {}
+        self.cancellations = 0  # sequences finished by the cancel sweep
+        # Per-token host callback (streaming): called on the engine
+        # thread as (seq, token) for every token that SURVIVES the stop
+        # check (popped stop tokens never stream). Must be cheap.
+        self.on_token: Optional[Any] = None
         self.swap_refused = 0  # captures the host-memory governor declined
         self.hbm_oom_events = 0  # allocation faults the ladder absorbed
         # Numerics-integrity counters (superset-only in stats: all stay
@@ -983,6 +1071,22 @@ class EngineCore:
             "Inter-token latency at the host boundary",
             buckets=ITL_BUCKETS,
         )
+        # Per-class SLO latency series: interactive requests observe into
+        # BOTH the all-class hists above and these labeled ones, so the
+        # unlabeled series keeps its pre-priority meaning. Batch gets no
+        # extra series (it IS the unlabeled series minus interactive, and
+        # a priority-free fleet's export stays identical).
+        self.ttft_hist_interactive = Histogram(
+            "llmq_ttft_seconds",
+            "Enqueue-to-first-token latency (interactive class)",
+            labels={"class": "interactive"},
+        )
+        self.itl_hist_interactive = Histogram(
+            "llmq_itl_seconds",
+            "Inter-token latency at the host boundary (interactive class)",
+            buckets=ITL_BUCKETS,
+            labels={"class": "interactive"},
+        )
         # Keyed by dispatch kind ("prefill"/"decode"/"mixed") — a fixed
         # set; the ring deques themselves carry maxlen.
         self._dispatch_rings: Dict[str, Deque[float]] = {}  # llmq: ignore[unbounded-host-buffer]
@@ -991,6 +1095,8 @@ class EngineCore:
         for metric in (
             self.ttft_hist,
             self.itl_hist,
+            self.ttft_hist_interactive,
+            self.itl_hist_interactive,
             self.scheduler.queue_wait_hist,
             self.scheduler.preempt_delay_hist,
             Gauge(
@@ -1056,6 +1162,35 @@ class EngineCore:
                 fn=lambda: (
                     len(self.prefix_store) if self.prefix_store else 0
                 ),
+            ),
+            Gauge(
+                "llmq_priority_preemptions",
+                "Batch sequences preempted so interactive work could admit",
+                fn=lambda: self.priority_preemptions,
+            ),
+            Gauge(
+                "llmq_class_tokens",
+                "Tokens generated for interactive-class requests",
+                labels={"class": "interactive"},
+                fn=lambda: self.class_tokens["interactive"],
+            ),
+            Gauge(
+                "llmq_class_tokens",
+                "Tokens generated for batch-class requests",
+                labels={"class": "batch"},
+                fn=lambda: self.class_tokens["batch"],
+            ),
+            Gauge(
+                "llmq_class_finished",
+                "Interactive-class requests finished cleanly (goodput)",
+                labels={"class": "interactive"},
+                fn=lambda: self.class_finished["interactive"],
+            ),
+            Gauge(
+                "llmq_class_finished",
+                "Batch-class requests finished cleanly (goodput)",
+                labels={"class": "batch"},
+                fn=lambda: self.class_finished["batch"],
             ),
             Gauge(
                 "llmq_integrity_guard_trips",
@@ -1237,7 +1372,7 @@ class EngineCore:
                 return (out, g), kp, vp, new_st
             return out, kp, vp, new_st
 
-        def decode_block_step(params, kp, vp, st, *, mode):
+        def decode_block_step(params, kp, vp, st, *, mode, k=None):
             """``decode_block`` fused decode iterations in ONE XLA
             computation: a ``lax.scan`` over ``decode_step`` carrying
             (kv pools, decode state) and stacking the per-iteration
@@ -1251,6 +1386,9 @@ class EngineCore:
             (positions route to -1 / ctx_incl 0). Rows that finish at
             iteration j still ride out iterations j+1..K-1 inactive —
             the host discards those tokens when it processes the block.
+            ``k`` overrides the scan length (the SLO scheduler's small-K
+            interactive executable); the host side is shape-driven, so
+            a [k, S] block processes exactly like a [K, S] one.
             """
 
             def body(carry, _):
@@ -1259,7 +1397,10 @@ class EngineCore:
                 return (kp, vp, st), out
 
             (kp, vp, st), outs = jax.lax.scan(
-                body, (kp, vp, st), None, length=self.cfg.decode_block
+                body,
+                (kp, vp, st),
+                None,
+                length=self.cfg.decode_block if k is None else k,
             )
             return outs, kp, vp, st
 
@@ -1404,11 +1545,12 @@ class EngineCore:
                 return (ys, g), kp, vp, st
             return ys, kp, vp, st
 
-        def verify_block_step(params, kp, vp, st, *, mode):
+        def verify_block_step(params, kp, vp, st, *, mode, k=None):
             """decode_block fused verify iterations in one XLA
             computation, mirroring decode_block_step. Always a lax.scan
             (even K=1) so the output block is uniformly ([K, S, Q]
-            tokens, [K, S] accept counts)."""
+            tokens, [K, S] accept counts). ``k`` overrides the scan
+            length for the small-K interactive executable."""
 
             def body(carry, _):
                 kp, vp, st = carry
@@ -1416,7 +1558,10 @@ class EngineCore:
                 return (kp, vp, st), ys
 
             (kp, vp, st), outs = jax.lax.scan(
-                body, (kp, vp, st), None, length=self.cfg.decode_block
+                body,
+                (kp, vp, st),
+                None,
+                length=self.cfg.decode_block if k is None else k,
             )
             return outs, kp, vp, st
 
@@ -1724,6 +1869,38 @@ class EngineCore:
             )
             for mode in ("greedy", "stochastic", "filtered")
         }
+        # SLO small-K interactive variant: the SAME block/verify scan at
+        # interactive_decode_block iterations — a second executable with
+        # identical sharding and donation contracts (out specs carry no
+        # shapes, so the [k, S] block reuses the big-K specs; the host
+        # side is shape-driven and processes either). Dispatch picks it
+        # whenever an interactive row is resident. Token parity per
+        # request holds by construction: the scan body is the identical
+        # decode_step, only the host-visit cadence changes.
+        self._decode_jits_small = None
+        ik = self.interactive_decode_block
+        if 0 < ik < self.cfg.decode_block:
+            s_fn = (
+                self._verify_block_fn
+                if self.cfg.spec_tokens > 0
+                else self._decode_block_fn
+            )
+            s_out0 = (
+                (self._spec_out, self._block1)
+                if self.cfg.spec_tokens > 0
+                else self._block1
+            )
+            if g_on:
+                s_out0 = (s_out0, guard_sh)
+            self._decode_jits_small = {
+                mode: jax.jit(
+                    partial(s_fn, mode=mode, k=ik),
+                    in_shardings=(param_spec, kv, kv, st_sh),
+                    out_shardings=(s_out0, kv, kv, st_sh),
+                    donate_argnums=(1, 2, 3),
+                )
+                for mode in ("greedy", "stochastic", "filtered")
+            }
         # Prefill data args grow by one (the per-row history) under
         # speculation; the trailing decode-state arg shifts with them.
         nP = len(self._prefill_arg_shardings)  # 13 if spec else 12
@@ -2292,6 +2469,7 @@ class EngineCore:
         params: Optional[SamplingParams] = None,
         deadline_at: Optional[float] = None,
         prefill_only: bool = False,
+        priority: str = "batch",
     ) -> Sequence:
         if prompt_ids is None:
             if messages is not None:
@@ -2311,15 +2489,28 @@ class EngineCore:
         )
         if need > self._stop_capacity:
             self._grow_stop_capacity(need)
+        if priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"priority={priority!r} (want interactive|batch)"
+            )
+        if not self.priority_classes:
+            priority = "batch"  # classes disabled: everything is FIFO batch
         seq = Sequence(
             rid=rid,
             prompt_ids=list(prompt_ids),
             params=params,
             deadline_at=deadline_at,
             prefill_only=prefill_only,
+            priority=priority,
         )
         if deadline_at is not None:
             self._deadlines_enabled = True
+        if priority == "interactive" and not self._priority_enabled:
+            # Lazily turn on priority-aware admission (like deadlines):
+            # a fleet that never submits interactive work keeps the
+            # exact pre-priority FIFO order and stats surface.
+            self._priority_enabled = True
+            self.scheduler.config.priority_aware = True
         self.total_prompt_tokens += len(seq.prompt_ids)
         self.scheduler.add(seq)
         return seq
@@ -2350,6 +2541,8 @@ class EngineCore:
         finished: List[RequestOutput] = []
         if self._deadlines_enabled:
             self._expire_deadlines(finished)
+        if self._cancel_rids:
+            self._sweep_cancels(finished)
         # Sequences decodable BEFORE this wave: only they justify
         # interleaving decode between admission chunks — a cold-start
         # wave decoding its own fresh rows would pay full-cost steps at
@@ -2401,6 +2594,62 @@ class EngineCore:
             )
             self.deadline_expirations += 1
 
+    def cancel_request(self, rid: str) -> None:
+        """Request cancellation of a waiting/running request (client
+        disconnected mid-stream). Takes effect at the next step's sweep:
+        the sequence finishes with ``finish_reason="cancelled"``, its
+        slot and KV pages free through the normal deferred-release path,
+        and the caller gets a RequestOutput like any other finish (so
+        the job settles instead of redelivering). Safe to call with a
+        rid this engine doesn't hold — the entry ages out."""
+        self._cancel_rids[rid] = time.monotonic()
+
+    def _sweep_cancels(self, finished: List[RequestOutput]) -> None:
+        """Between-steps cancellation sweep, mirroring the deadline
+        sweep: waiting sequences unqueue immediately; running prefilled
+        sequences finish through ``_finish_seq`` (pages deferred, slot
+        deactivated by the dirty resync). Mid-prefill rows are skipped —
+        their in-flight chunk loop may still write their pages — and
+        cancel on a later sweep once prefilled. Unknown rids age out
+        after ``_CANCEL_TTL_S``."""
+        now = time.monotonic()
+        for seq in [
+            s for s in self.scheduler.waiting if s.rid in self._cancel_rids
+        ]:
+            self.scheduler.waiting.remove(seq)
+            self.scheduler.finish(seq, "cancelled")
+            finished.append(self._output_for(seq))
+            del self._cancel_rids[seq.rid]
+            self.cancellations += 1
+        for seq in [
+            s
+            for s in self.scheduler.running.values()
+            if s.prefilled and s.rid in self._cancel_rids
+        ]:
+            self._finish_seq(
+                seq, "cancelled", device_detected=False, finished=finished
+            )
+            del self._cancel_rids[seq.rid]
+            self.cancellations += 1
+        for rid, t in list(self._cancel_rids.items()):
+            if now - t > _CANCEL_TTL_S:
+                del self._cancel_rids[rid]
+
+    def _interactive_victim(self) -> Optional[Sequence]:
+        """Youngest running prefilled BATCH sequence — the preemption
+        victim when interactive work would otherwise queue for a slot.
+        Mid-prefill rows are never victims (their in-flight chunk loop
+        would keep writing freed pages); interactive rows never evict
+        each other (FIFO within the class)."""
+        candidates = [
+            s
+            for s in self.scheduler.running.values()
+            if s.prefilled and s.priority != "interactive"
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.admitted_at)
+
     def _try_admit(self, finished: List[RequestOutput]) -> bool:
         """Admit + prefill up to one chunk; True if anything was admitted
         (the caller loops until the admissible backlog is drained)."""
@@ -2414,6 +2663,21 @@ class EngineCore:
             self._process_oldest(finished)
         self._flush_deferred()
         free = sum(s is None for s in self.scheduler.slots)
+        # SLO preemption: an interactive waiter facing a full slot table
+        # evicts the youngest prefilled batch victim (swap-preempt under
+        # preempt_mode=swap — its KV gathers to host and scatters back on
+        # re-admission) instead of queueing behind it. One victim per
+        # admission round; the dirty resync the preemption forces is
+        # paid by the prefill that follows anyway.
+        int_waiting = self._priority_enabled and any(
+            s.priority == "interactive" for s in self.scheduler.waiting
+        )
+        if int_waiting and free == 0 and self.priority_preempt:
+            victim = self._interactive_victim()
+            if victim is not None:
+                self._self_preempt_deferred(victim)
+                self.priority_preemptions += 1
+                free = 1
         want = (
             min(
                 self.cfg.max_prefill_batch,
@@ -2443,7 +2707,9 @@ class EngineCore:
             and time.monotonic() - self._defer_since
             > self.cfg.admit_max_wait_s
         )
-        if not (can_admit and (full or overdue)):
+        # Interactive waiters never sit out the batch-admission deferral:
+        # the latency that deferral trades away is exactly their SLO.
+        if not (can_admit and (full or overdue or int_waiting)):
             return False
         self._defer_since = None
         admitted = self.scheduler.admit(max_new=self.cfg.max_prefill_batch)
@@ -3510,14 +3776,25 @@ class EngineCore:
             return
         t0 = time.monotonic()
         kind = "verify" if self.cfg.spec_tokens > 0 else "decode_block"
+        jits, k_steps = self._decode_jits, self.cfg.decode_block
+        if self._decode_jits_small is not None and any(
+            seq.prefilled and seq.priority == "interactive"
+            for seq in self.scheduler.running.values()
+        ):
+            # An interactive row is resident: dispatch the small-K
+            # executable so its tokens reach the host (and the stream)
+            # every interactive_decode_block iterations instead of every
+            # decode_block. Pure-batch steps keep the big fused K.
+            jits, k_steps = self._decode_jits_small, self.interactive_decode_block
+            kind += "_small"
         with self._wd(kind):
             out, self.k_pages, self.v_pages, self._dev_state = (
-                self._decode_jits[self._mode](
+                jits[self._mode](
                     self.params, self.k_pages, self.v_pages, self._dev_state
                 )
             )
             self._record_dispatch(kind, time.monotonic() - t0)
-        self.decode_steps += self.cfg.decode_block
+        self.decode_steps += k_steps
         self.decode_dispatches += 1
         out, g = self._split_guard(out)
         self._push_pending(
@@ -3603,23 +3880,39 @@ class EngineCore:
             return
         seq.output_ids.append(token)
         self.total_generated_tokens += 1
+        interactive = seq.priority == "interactive"
+        self.class_tokens["interactive" if interactive else "batch"] += 1
         now = time.monotonic()
         if seq.t_first_token == 0.0:
             seq.t_first_token = now
             if seq.t_enqueue > 0.0:
                 self.ttft_hist.observe(now - seq.t_enqueue)
+                if interactive:
+                    self.ttft_hist_interactive.observe(now - seq.t_enqueue)
         elif seq.t_last_token > 0.0:
             # Host-boundary gap: tokens of one fused decode block arrive
             # in a burst, so sub-ms gaps are expected there (the
             # fine-grained ITL_BUCKETS low end exists for exactly this).
             self.itl_hist.observe(now - seq.t_last_token)
+            if interactive:
+                self.itl_hist_interactive.observe(now - seq.t_last_token)
         seq.t_last_token = now
         # Stops are checked BEFORE the page top-up: a stopping sequence
         # needs no more pages, and the pool-pressure retry below must not
         # swallow a stop/budget finish (a preempted-at-budget row would
         # re-prefill and sample one token past max_tokens).
+        n_before = len(seq.output_ids)
         reason = self._stop_reason(seq, token)
         if reason is not None:
+            # The token survived the stop check iff it is still in the
+            # output (length finishes keep it; stop tokens were popped;
+            # stop-string hits pre-truncate text, so nothing streams).
+            if (
+                self.on_token is not None
+                and len(seq.output_ids) == n_before
+                and seq.finish_text is None
+            ):
+                self.on_token(seq, token)
             # The device detects token-based stops and length caps itself
             # (advance_state); only host-exclusive finishes (stop strings)
             # force a resync.
@@ -3627,6 +3920,8 @@ class EngineCore:
             self._finish_seq(seq, reason, device_detected=device_detected,
                              finished=finished)
             return
+        if self.on_token is not None:
+            self.on_token(seq, token)
         try:
             # Pages were pre-allocated at dispatch time; this is a no-op
             # except under pool exhaustion (no preemption here — in-flight
@@ -3748,6 +4043,12 @@ class EngineCore:
                 return
 
     def _output_for(self, seq: Sequence) -> RequestOutput:
+        # Goodput accounting: a clean finish delivered useful work; a
+        # shed/expired/cancelled one did not (its tokens were wasted).
+        if (seq.finish_reason or "stop") in ("stop", "length"):
+            self.class_finished[
+                "interactive" if seq.priority == "interactive" else "batch"
+            ] += 1
         text = seq.finish_text
         if text is None:
             text = self.tokenizer.decode(seq.output_ids)
@@ -4501,6 +4802,32 @@ class EngineCore:
                 )
             )
             s["pp_wire"] = "codec" if self.pp_wire else "device"
+        # SLO priority plane (superset-only: appears once the first
+        # interactive request arrived — priority-free engines publish
+        # byte-identical stats).
+        if self._priority_enabled:
+            s["priority_preemptions"] = self.priority_preemptions
+            s["interactive_decode_block"] = self.interactive_decode_block
+            s["ttft_p50_ms_interactive"] = to_ms(
+                self.ttft_hist_interactive.percentile(0.50)
+            )
+            s["ttft_p95_ms_interactive"] = to_ms(
+                self.ttft_hist_interactive.percentile(0.95)
+            )
+            s["itl_p50_ms_interactive"] = to_ms(
+                self.itl_hist_interactive.percentile(0.50)
+            )
+            s["itl_p95_ms_interactive"] = to_ms(
+                self.itl_hist_interactive.percentile(0.95)
+            )
+            s["tokens_interactive"] = self.class_tokens["interactive"]
+            s["tokens_batch"] = self.class_tokens["batch"]
+            s["finished_interactive"] = self.class_finished["interactive"]
+            s["finished_batch"] = self.class_finished["batch"]
+        # Client-disconnect cancellation (superset-only: appears once a
+        # cancel actually landed).
+        if self.cancellations:
+            s["cancellations"] = self.cancellations
         # Disaggregated serving (superset-only: appears once this engine
         # has finished a prefill-only request at the phase boundary).
         if self.prefill_done:
@@ -4625,6 +4952,13 @@ class AsyncEngine:
         # Closures marshalled onto the engine thread (prefix-tier export/
         # ingest touch the device pools, which the step loop donates).
         self._calls: "queue.Queue[Tuple[Any, Future]]" = queue.Queue()
+        # rid -> per-token callback (streaming deltas). Fired on the
+        # ENGINE thread for every surviving token, so callbacks must be
+        # cheap and thread-safe (workers bridge with
+        # loop.call_soon_threadsafe). Keyed per-request: jobs that never
+        # register one cost a single dict miss per token.
+        self._token_cbs: Dict[str, Any] = {}
+        core.on_token = self._dispatch_token
         self._thread = threading.Thread(
             target=self._run, name="llmq-engine", daemon=True
         )
@@ -4641,6 +4975,7 @@ class AsyncEngine:
         params: Optional[SamplingParams] = None,
         deadline_at: Optional[float] = None,
         prefill_only: bool = False,
+        priority: str = "batch",
     ) -> RequestOutput:
         import asyncio
 
@@ -4650,7 +4985,7 @@ class AsyncEngine:
         self._futures[rid] = fut
         self._intake.put(
             (rid, prompt, messages, prompt_ids, params, None, deadline_at,
-             prefill_only)
+             prefill_only, priority)
         )
         self._wake.set()
         try:
@@ -4675,7 +5010,8 @@ class AsyncEngine:
         fut: Future = Future()
         self._futures[rid] = fut
         self._intake.put(
-            (rid, None, None, None, None, snapshot, deadline_at, False)
+            (rid, None, None, None, None, snapshot, deadline_at, False,
+             "batch")
         )
         self._wake.set()
         try:
@@ -4696,6 +5032,7 @@ class AsyncEngine:
                 kwargs.get("snapshot"),
                 kwargs.get("deadline_at"),
                 kwargs.get("prefill_only", False),
+                kwargs.get("priority", "batch"),
             )
         )
         self._wake.set()
@@ -4736,6 +5073,44 @@ class AsyncEngine:
         """Worker-lifetime watchdog trip count, across engine rebuilds."""
         wd = getattr(self.core, "watchdog", None)
         return self._prior_watchdog_trips + (wd.trips if wd else 0)
+
+    # --- streaming / cancellation ----------------------------------------
+    def _dispatch_token(self, seq: Any, token: int) -> None:
+        """EngineCore.on_token bridge (engine thread): route a surviving
+        token to the request's registered callback, if any. Callback
+        errors are swallowed — a broken stream consumer must not take
+        down the step loop or the other requests in the batch."""
+        cb = self._token_cbs.get(seq.rid)
+        if cb is None:
+            return
+        try:
+            cb(token, len(seq.output_ids))
+        except Exception:  # noqa: BLE001 — consumer bug, not engine fault
+            logger.exception("token callback for %s failed", seq.rid)
+
+    def set_token_callback(self, rid: str, cb: Any) -> None:
+        """Register ``cb(token, n_out)`` for one request's streaming
+        deltas. Fired on the engine thread for each token that survives
+        the stop check; ``n_out`` is the output length *including* this
+        token (its 1-based index), so consumers can place tokens by
+        absolute position and stay idempotent across fault-recovery
+        replays. Register before generate() to see every token."""
+        self._token_cbs[rid] = cb
+
+    def clear_token_callback(self, rid: str) -> None:
+        self._token_cbs.pop(rid, None)
+
+    def cancel(self, rid: str) -> None:
+        """Request cancellation of one in-flight request (thread-safe,
+        non-blocking). Marshalled onto the engine thread; the request
+        finishes with finish_reason='cancelled' through the normal output
+        path (pages freed, future resolved), or is silently dropped from
+        the waiting queue. Unknown rids are remembered briefly by the
+        core so a cancel racing the intake drain still lands."""
+        if not self._thread.is_alive():
+            return
+        self._calls.put((lambda: self.core.cancel_request(rid), Future()))
+        self._wake.set()
 
     def call_on_engine(self, fn, timeout: float = 30.0):
         """Run ``fn()`` on the engine thread and return its result.
@@ -4987,6 +5362,7 @@ class AsyncEngine:
                 )
                 return False
             self.core = new_core
+            new_core.on_token = self._dispatch_token  # streams survive rebuild
             del old  # free the faulted backend's buffers before stepping
             self.engine_rebuilds += 1
             lost_set = set(lost) - drop
@@ -5103,7 +5479,7 @@ class AsyncEngine:
                 if item is None:
                     continue
                 (rid, prompt, messages, prompt_ids, params, snapshot, dl,
-                 prefill_only) = item
+                 prefill_only, prio) = item
                 try:
                     if snapshot is not None:
                         self.core.insert_request(snapshot, deadline_at=dl)
@@ -5116,6 +5492,7 @@ class AsyncEngine:
                             params=params,
                             deadline_at=dl,
                             prefill_only=prefill_only,
+                            priority=prio,
                         )
                     drained = True
                 except Exception as exc:  # tokenization/validation error

@@ -15,8 +15,10 @@ Invariants (property-tested in tests/test_scheduler.py):
   - slots hold at most one sequence; finished/preempted sequences release
     their references immediately (cache-registered pages park in an
     evictable LRU pool instead of the free list),
-  - admission is FIFO; preemption evicts the *youngest* running sequence
-    (its re-prefill wastes the least work).
+  - admission is FIFO (priority-aware schedulers admit interactive
+    waiters first, FIFO within each class); preemption evicts the
+    *youngest* running sequence (its re-prefill wastes the least work;
+    priority-aware schedulers prefer batch victims).
 """
 
 from __future__ import annotations
@@ -167,6 +169,11 @@ class Sequence:
     params: SamplingParams
     output_ids: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
+    # SLO class ("interactive" | "batch"). Under a priority-aware
+    # scheduler interactive sequences are admitted first and may
+    # preempt batch victims; it never changes a sequence's own token
+    # stream (greedy parity with priority off holds per request).
+    priority: str = "batch"
     # Prefix caching: leading prompt positions whose KV is already in the
     # (shared) leading pages — prefill starts at prefix_len. cacheable_pages
     # counts the leading pages registered in the prefix cache (they park
@@ -243,6 +250,11 @@ class SchedulerConfig:
     # refcounts instead of recomputing — the engine then prefills only
     # from prefix_len on (requires chunked prefill).
     enable_prefix_caching: bool = False
+    # SLO-aware admission: interactive waiters are admitted before batch
+    # waiters (FIFO within each class). Off (default) = pure FIFO, the
+    # exact pre-priority order, and stats() omits the per-class keys so
+    # default payloads stay byte-identical.
+    priority_aware: bool = False
 
     @property
     def pages_per_seq(self) -> int:
@@ -435,18 +447,33 @@ class Scheduler:
         return -(-(num_tokens + 1) // self.config.page_size)
 
     # --- admission --------------------------------------------------------
+    def _next_admit_index(self) -> int:
+        """Index of the next waiting sequence to admit: FIFO head, unless
+        the scheduler is priority-aware and an interactive sequence waits
+        anywhere in the queue — then the OLDEST interactive waiter jumps
+        the line (FIFO within each class; a preempted interactive
+        sequence sits at the head already via appendleft)."""
+        if self.config.priority_aware:
+            for i, seq in enumerate(self.waiting):
+                if seq.priority == "interactive":
+                    return i
+        return 0
+
     def admit(self, max_new: Optional[int] = None) -> List[Sequence]:
         """Move waiting sequences into free slots while pages allow.
 
         Returns the newly admitted sequences (their ``slot`` and ``pages``
-        set); each needs a prefill pass before joining decode.
+        set); each needs a prefill pass before joining decode. Admission
+        is FIFO; a priority-aware scheduler admits interactive waiters
+        first (see ``_next_admit_index``).
         """
         admitted: List[Sequence] = []
         free_slots = [i for i, s in enumerate(self.slots) if s is None]
         while self.waiting and free_slots:
             if max_new is not None and len(admitted) >= max_new:
                 break
-            seq = self.waiting[0]
+            idx = self._next_admit_index()
+            seq = self.waiting[idx]
             matched: List[int] = []
             host: List[Any] = []
             hashes: List[bytes] = []
@@ -492,7 +519,7 @@ class Scheduler:
             seq.cacheable_pages = n_reused
             self.prefix_hits += n_reused
             self.prefix_misses += len(hashes) - n_reused
-            self.waiting.popleft()
+            del self.waiting[idx]
             seq.slot = free_slots.pop(0)
             seq.admitted_at = self._tick
             self._tick += 1
@@ -569,6 +596,12 @@ class Scheduler:
         ]
         if not candidates:
             return None
+        if self.config.priority_aware:
+            # Page pressure evicts batch work before interactive work:
+            # an interactive victim pays its whole SLO back in re-prefill.
+            batch = [s for s in candidates if s.priority != "interactive"]
+            if batch:
+                candidates = batch
         return max(candidates, key=lambda s: s.admitted_at)
 
     def preempt(
@@ -649,6 +682,15 @@ class Scheduler:
             / max(1, total_pages),
             "preemptions": self.preemptions,
         }
+        if self.config.priority_aware:
+            out["waiting_interactive"] = sum(
+                1 for s in self.waiting if s.priority == "interactive"
+            )
+            out["running_interactive"] = sum(
+                1
+                for s in self.running.values()
+                if s.priority == "interactive"
+            )
         qw = self.queue_wait_hist
         pd = self.preempt_delay_hist
         out["queue_wait_p50_ms"] = _ms(qw.percentile(0.50))

@@ -1,0 +1,5 @@
+"""OpenAI-compatible streaming serving gateway (HTTP/SSE front-end)."""
+
+from llmq_tpu.gateway.server import ServingGateway
+
+__all__ = ["ServingGateway"]

@@ -45,6 +45,7 @@ from llmq_tpu.obs import TRACE_FIELD
 from llmq_tpu.sim.scenario import Scenario
 from llmq_tpu.sim.vloop import run_virtual
 from llmq_tpu.sim.worker import SimWorker
+from llmq_tpu.utils import clock
 
 QUEUE = "simq"
 
@@ -102,6 +103,26 @@ class SimReport:
             if at is not None and res.get("_finished_wall", 0.0) <= at:
                 met += 1
         return met / len(deadlines)
+
+    def class_latency_p95(self, *, interactive: bool) -> Optional[float]:
+        """p95 submit→result latency (virtual seconds) for one SLO class;
+        None when the run had no finished jobs of that class. Unfinished
+        jobs (shed, dead-lettered) don't appear — pair this with
+        ``slo_attainment``, which counts them as misses."""
+        meta = {
+            jid: m
+            for jid, m in self.submitted.items()
+            if bool(m.get("interactive")) == interactive
+            and m.get("submitted_at") is not None
+        }
+        lats = sorted(
+            res.get("_finished_wall", 0.0) - meta[jid]["submitted_at"]
+            for res in self.results
+            if (jid := str(res.get("id"))) in meta
+        )
+        if not lats:
+            return None
+        return lats[min(len(lats) - 1, int(0.95 * len(lats)))]
 
     def summary(self) -> dict:
         return {
@@ -413,15 +434,26 @@ class FleetSim:
             "prompt": prompt,
             "sim": sim,
         }
-        if traffic.deadline_ms:
+        interactive = (
+            traffic.interactive_share > 0
+            and rng.random() < traffic.interactive_share
+        )
+        if interactive:
+            payload["priority"] = "interactive"
+            if traffic.interactive_deadline_ms:
+                payload["deadline_ms"] = traffic.interactive_deadline_ms
+        elif traffic.deadline_ms:
             payload["deadline_ms"] = traffic.deadline_ms
         job = Job.model_validate(payload)
+        submitted_at = clock.wall()
         await submitter.publish_job(self._entry_queue, job)
         # publish_job stamps deadline_at in place (and may shed).
         self._submitted[job_id] = {
             "deadline_at": job.deadline_at,
             "poison": bool(sim.get("poison")),
             "hang": "hang_s" in sim,
+            "interactive": interactive,
+            "submitted_at": submitted_at,
         }
 
     # --- faults / churn ---------------------------------------------------

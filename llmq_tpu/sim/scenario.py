@@ -38,6 +38,12 @@ class TrafficShape:
     # Per-job deadline budget (ms); 0 = no deadline (config may still
     # impose one via LLMQ_DEADLINE_MS in Scenario.env).
     deadline_ms: int = 0
+    # SLO priority mix: fraction of jobs submitted as class
+    # ``interactive`` (fast-lane routed, admitted first); they carry
+    # ``interactive_deadline_ms`` as their deadline budget when > 0, so
+    # slo_attainment measures the interactive class specifically.
+    interactive_share: float = 0.0
+    interactive_deadline_ms: int = 0
     # Optional warmup phase before the main arrival process: submit
     # ``warmup_jobs`` at ``warmup_rate_jobs_s``, then pause long enough
     # for a heartbeat cycle so the fleet's observed service rate exists

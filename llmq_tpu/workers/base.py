@@ -46,6 +46,7 @@ from llmq_tpu.broker.manager import (
     affinity_queue_name,
     decode_adopt_queue_name,
     decode_queue_name,
+    interactive_queue_name,
     kv_fetch_queue_name,
 )
 from llmq_tpu.core.config import Config, get_config
@@ -169,6 +170,10 @@ class BaseWorker(abc.ABC):
         self._role_checked_at = float("-inf")
         self._decode_consumer_tag: Optional[str] = None
         self._adopt_consumer_tag: Optional[str] = None
+        # SLO fast lane: consumer on <q>.interactive (priority_classes
+        # fleets only) + per-class shed accounting for goodput math.
+        self._interactive_consumer_tag: Optional[str] = None
+        self.jobs_deadline_exceeded_interactive = 0
 
     # --- abstract surface (reference base.py:57-75) -----------------------
     @abc.abstractmethod
@@ -259,7 +264,9 @@ class BaseWorker(abc.ABC):
         for attr in (
             "_consumer_tag",
             "_affinity_consumer_tag",
+            "_interactive_consumer_tag",
             "_kv_consumer_tag",
+            "_ctl_consumer_tag",
             "_decode_consumer_tag",
             "_adopt_consumer_tag",
         ):
@@ -398,6 +405,15 @@ class BaseWorker(abc.ABC):
                 aq, self._process_message, prefetch=self.concurrency
             )
             return
+        if self.config.priority_classes:
+            # Fast lane first: interactive deliveries race the shared
+            # queue's prefetch window, and the engine's priority-aware
+            # admission orders whatever lands concurrently.
+            self._interactive_consumer_tag = await self.broker.consume_jobs(
+                interactive_queue_name(self.queue),
+                self._process_message,
+                prefetch=self.concurrency,
+            )
         self._consumer_tag = await self.broker.consume_jobs(
             self.queue, self._process_message, prefetch=self.concurrency
         )
@@ -412,6 +428,7 @@ class BaseWorker(abc.ABC):
         for attr in (
             "_consumer_tag",
             "_affinity_consumer_tag",
+            "_interactive_consumer_tag",
             "_decode_consumer_tag",
             "_adopt_consumer_tag",
         ):
@@ -529,6 +546,8 @@ class BaseWorker(abc.ABC):
         ``deadline_exceeded`` — explicitly filed on ``<q>.failed``, never
         silently dropped, so the submitter can count and requeue it."""
         self.jobs_deadline_exceeded += 1
+        if job.priority_class == "interactive":
+            self.jobs_deadline_exceeded_interactive += 1
         trace_event(trace, "deadline_exceeded", worker_id=self.worker_id)
         emit_trace_event(
             job.id, "deadline_exceeded", worker_id=self.worker_id
@@ -1194,7 +1213,11 @@ class BaseWorker(abc.ABC):
         nothing is added until a counter moves, so pre-existing heartbeat
         consumers see unchanged payloads at default config)."""
         stats = dict(self._engine_stats() or {})
-        for name in ("jobs_deadline_exceeded", "jobs_quarantined"):
+        for name in (
+            "jobs_deadline_exceeded",
+            "jobs_deadline_exceeded_interactive",
+            "jobs_quarantined",
+        ):
             value = getattr(self, name, 0)
             if value:
                 stats[name] = value

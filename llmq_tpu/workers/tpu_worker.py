@@ -28,10 +28,12 @@ from pathlib import Path
 from typing import Any, Callable, List, Optional
 
 from llmq_tpu.broker.manager import (
+    ctl_queue_name,
     decode_adopt_queue_name,
     job_affinity_text,
     kv_fetch_queue_name,
     rendezvous_pick,
+    stream_queue_name,
 )
 from llmq_tpu.core.models import Job
 from llmq_tpu.obs import emit_trace_event, trace_event, trace_event_at
@@ -41,6 +43,7 @@ from llmq_tpu.utils.hashing import (
     token_fold,
     token_prefix_chain,
 )
+from llmq_tpu.utils.aio import spawn
 from llmq_tpu.utils.host_mem import get_governor
 from llmq_tpu.workers.base import BaseWorker, DeadlineExceeded
 from llmq_tpu.workers.resume import RESUME_FIELD, JobHandoff, PrefillDone
@@ -126,6 +129,11 @@ class TPUWorker(BaseWorker):
         self._engine_factory = engine_factory
         self.engine = None
         self._usage: dict = {}
+        # Terminal finish_reason held between generate() and
+        # _build_result, which pops it onto the result as an extra so the
+        # gateway's blocking path reports the same reason ("length",
+        # "cancelled", ...) the stream done frame carries.
+        self._finish_reasons: dict = {}
         # Result-payload integrity (LLMQ_RESULT_DIGEST): emitted token
         # ids held between generate() and _build_result, which pops them
         # onto the result with their blake2b digest.
@@ -151,6 +159,15 @@ class TPUWorker(BaseWorker):
         self._dead_peers: dict = {}
         self.kv_fetch_failures = 0
         self.kv_serve_busy_rejects = 0
+        # Online-serving plane: per-job token-delta stream state (jobs
+        # that carried a truthy ``stream`` extra), the control-queue
+        # consumer tag (gateway-published cancels), background flush
+        # tasks, and serving counters for heartbeats.
+        self._streams: dict = {}
+        self._stream_tasks: set = set()
+        self._ctl_consumer_tag: Optional[str] = None
+        self.stream_frames_published = 0
+        self.jobs_cancelled = 0
         super().__init__(queue, **kwargs)
         # Prefetch must exceed the continuous batch's slot count or the
         # engine starves: with slots=192 and the default prefetch=100,
@@ -543,7 +560,22 @@ class TPUWorker(BaseWorker):
 
         The same RPC queue carries KV adoption offers in a disaggregated
         fleet, so decode-capable workers (decode or auto role) attach it
-        even without prefix shipping."""
+        even without prefix shipping.
+
+        Priority-class fleets also attach the per-worker control queue
+        ``<q>.ctl.<worker_id>``: the streaming gateway publishes
+        ``{"cancel": job_id}`` there when a client disconnects mid-stream,
+        and the engine frees the request's pages instead of decoding for
+        nobody. Requests are ephemeral like kv fetches — a cancel that
+        outlives its 30 s TTL targets a job that already finished."""
+        if self.config.priority_classes:
+            ctl_q = ctl_queue_name(self.queue, self.worker_id)
+            await self.broker.broker.declare_queue(
+                ctl_q, ttl_ms=30_000, max_redeliveries=1
+            )
+            self._ctl_consumer_tag = await self.broker.broker.consume(
+                ctl_q, self._serve_ctl, prefetch=4
+            )
         if not (self._prefix_enabled() or self.role in ("decode", "auto")):
             return
         kv_q = kv_fetch_queue_name(self.queue, self.worker_id)
@@ -556,6 +588,32 @@ class TPUWorker(BaseWorker):
         self._kv_consumer_tag = await self.broker.broker.consume(
             kv_q, self._serve_kv_fetch, prefetch=4
         )
+
+    async def _serve_ctl(self, message) -> None:
+        """One control message: ``{"cancel": job_id}`` → ask the engine
+        to cancel that request. Best-effort and always acked — an
+        unknown id (job finished, or landed on a peer after a requeue)
+        ages out of the engine's pending-cancel map on its own."""
+        try:
+            req = json.loads(message.body)
+            job_id = req.get("cancel")
+            if (
+                job_id
+                and self.engine is not None
+                and hasattr(self.engine, "cancel")
+            ):
+                self.engine.cancel(str(job_id))
+                self.jobs_cancelled += 1
+                emit_trace_event(
+                    str(job_id), "cancel_requested", worker_id=self.worker_id
+                )
+        except Exception:  # noqa: BLE001 — control plane is best-effort
+            self.logger.debug("Control message failed", exc_info=True)
+        finally:
+            try:
+                await message.ack()
+            except Exception:  # noqa: BLE001 — already settled
+                pass
 
     async def _serve_kv_fetch(self, message) -> None:
         """One fetch request: ``{"want": [hex], "reply_to": q, "req": id,
@@ -921,6 +979,160 @@ class TPUWorker(BaseWorker):
         self._dead_peers[peer] = time.monotonic() + PEER_NEGATIVE_CACHE_S
         self._note_kv_fetch_failed(req_id, peer, "timeout")
 
+    # --- token-delta streaming -------------------------------------------
+    def _stream_tokenizer(self):
+        core = getattr(self.engine, "core", None)
+        return getattr(core, "tokenizer", None)
+
+    async def _stream_begin(self, job: Job) -> bool:
+        """Set up per-token streaming for a job that asked for it
+        (truthy ``stream`` extra): declare the per-request stream queue
+        and register an engine token callback that marshals each token
+        onto the event loop, where a flush task decodes the pending tail
+        and publishes character-offset text frames. Returns False (job
+        runs unstreamed) when the engine can't stream — stub engines
+        without the callback surface, or no tokenizer to decode with."""
+        if not job.extras().get("stream"):
+            return False
+        if (
+            self.engine is None
+            or not hasattr(self.engine, "set_token_callback")
+            or self._stream_tokenizer() is None
+        ):
+            return False
+        sq = stream_queue_name(self.queue, job.id)
+        try:
+            # Short-TTL: frames outliving their consumer by a minute are
+            # garbage (the Result on <q>.results is the settlement).
+            await self.broker.broker.declare_queue(
+                sq, ttl_ms=60_000, max_redeliveries=1_000_000_000
+            )
+        except Exception:  # noqa: BLE001 — no stream queue: run unstreamed
+            self.logger.debug("Stream queue declare failed", exc_info=True)
+            return False
+        loop = asyncio.get_running_loop()
+        self._streams[job.id] = {
+            "queue": sq,
+            "tokens": [],  # by absolute emit index (replays overwrite)
+            "sent": 0,  # characters already published
+            "flushed_n": 0,
+            "flushing": False,
+        }
+        job_id = job.id
+
+        def on_token(token: int, n_out: int) -> None:
+            # Engine thread — just marshal; the event loop owns the state.
+            loop.call_soon_threadsafe(
+                self._note_stream_token, job_id, token, n_out
+            )
+
+        self.engine.set_token_callback(job.id, on_token)
+        return True
+
+    def _note_stream_token(self, job_id: str, token: int, n_out: int) -> None:
+        st = self._streams.get(job_id)
+        if st is None:
+            return
+        idx = n_out - 1
+        if idx < len(st["tokens"]):
+            # Fault-recovery replay: greedy determinism re-emits the same
+            # value, so the decoded text (and the sent offset) is stable.
+            st["tokens"][idx] = token
+        else:
+            st["tokens"].append(token)
+        if not st["flushing"]:
+            st["flushing"] = True
+            spawn(
+                self._flush_stream(job_id),
+                registry=self._stream_tasks,
+                name=f"stream-{job_id}",
+            )
+
+    async def _flush_stream(self, job_id: str) -> None:
+        """Publish the undelivered decoded tail of one stream as a frame
+        ``{"text_offset": chars_already_sent, "text": delta}``. Offsets
+        are absolute character positions in the full decoded output, so
+        a consumer that sees overlapping frames (worker died and the job
+        resumed elsewhere, re-streaming from token zero) dedups by
+        skipping everything before its high-water mark."""
+        st = self._streams.get(job_id)
+        if st is None:
+            return
+        tokenizer = self._stream_tokenizer()
+        try:
+            while tokenizer is not None:
+                n = len(st["tokens"])
+                if n == st["flushed_n"]:
+                    break
+                text = tokenizer.decode(st["tokens"][:n])
+                st["flushed_n"] = n
+                delta = text[st["sent"] :]
+                if not delta:
+                    continue
+                frame = {
+                    "id": job_id,
+                    "text_offset": st["sent"],
+                    "text": delta,
+                    "worker_id": self.worker_id,
+                }
+                st["sent"] += len(delta)
+                await self.broker.broker.publish(
+                    st["queue"],
+                    json.dumps(frame).encode("utf-8"),
+                    message_id=f"{job_id}.{frame['text_offset']}",
+                )
+                self.stream_frames_published += 1
+        except Exception:  # noqa: BLE001 — streaming is best-effort
+            self.logger.debug("Stream flush failed", exc_info=True)
+        finally:
+            st["flushing"] = False
+
+    async def _stream_finish(self, job: Job, out: Any) -> None:
+        """Tear down a job's stream: unregister the callback, flush the
+        tail, and publish a terminal ``done`` frame when the request
+        actually finished here. A drain handoff (or a requeue-bound
+        error) publishes NO done frame — the job resumes on a peer whose
+        re-stream continues this one (offset dedup), and the final
+        Result settles whatever raced."""
+        st = self._streams.pop(job.id, None)
+        try:
+            if hasattr(self.engine, "clear_token_callback"):
+                self.engine.clear_token_callback(job.id)
+        except Exception:  # noqa: BLE001 — engine may be mid-teardown
+            pass
+        if st is None:
+            return
+        finish = getattr(out, "finish_reason", None) or (
+            "stop" if getattr(out, "text", None) is not None else None
+        )
+        if finish in (None, "prefill_done"):
+            return
+        tokenizer = self._stream_tokenizer()
+        delta = ""
+        try:
+            if tokenizer is not None and st["tokens"]:
+                text = tokenizer.decode(st["tokens"])
+                delta = text[st["sent"] :]
+        except Exception:  # noqa: BLE001
+            delta = ""
+        frame = {
+            "id": job.id,
+            "text_offset": st["sent"],
+            "text": delta,
+            "done": True,
+            "finish_reason": finish,
+            "worker_id": self.worker_id,
+        }
+        try:
+            await self.broker.broker.publish(
+                st["queue"],
+                json.dumps(frame).encode("utf-8"),
+                message_id=f"{job.id}.done",
+            )
+            self.stream_frames_published += 1
+        except Exception:  # noqa: BLE001 — Result still settles the job
+            self.logger.debug("Stream done frame failed", exc_info=True)
+
     # --- per-job processing (reference vllm_worker.py:136-195) ------------
     def _sampling_for(self, job: Job):
         """Job → SamplingParams: structured ``job.sampling`` wins, loose
@@ -983,6 +1195,11 @@ class TPUWorker(BaseWorker):
         gen_kw = (
             {} if job.deadline_at is None else {"deadline_at": job.deadline_at}
         )
+        # SLO class passthrough, superset-only: batch (the default) sends
+        # nothing, so engine stubs with pre-priority generate() signatures
+        # keep working and priority-free jobs take the identical path.
+        if job.priority_class == "interactive":
+            gen_kw["priority"] = "interactive"
         if job.deadline_at is not None and time.time() > job.deadline_at:
             # Claim-time check passed but the deadline has since lapsed
             # (e.g. slots were busy): fail before any engine work.
@@ -1000,110 +1217,129 @@ class TPUWorker(BaseWorker):
                     # whose remaining budget can't cover that goes
                     # straight to a local prefill.
                     await self._maybe_fetch_prefix(job, text)
-        if snapshot is not None:
-            trace = self._job_traces.get(job.id)
-            if trace is not None:
-                trace_event(
-                    trace, "resumed", offset=len(snapshot.output_ids)
-                )
-            # Phase-boundary adoption: a handoff_at stamp marks this
-            # resume as a prefill→decode handoff (drain handoffs don't
-            # carry one). Count it and sample the handoff latency.
-            resume = job.extras().get(RESUME_FIELD)
-            ho_at = (
-                resume.get("handoff_at") if isinstance(resume, dict) else None
-            )
-            if ho_at is not None:
-                try:
-                    latency_ms = max(
-                        0.0, (clock.wall() - float(ho_at)) * 1000.0
-                    )
-                except (TypeError, ValueError):
-                    latency_ms = 0.0
-                self.jobs_adopted += 1
-                self._handoff_ms.append(latency_ms)
+        streaming = await self._stream_begin(job)
+        try:
+            if snapshot is not None:
+                trace = self._job_traces.get(job.id)
                 if trace is not None:
                     trace_event(
-                        trace, "adopted", latency_ms=round(latency_ms, 3)
+                        trace, "resumed", offset=len(snapshot.output_ids)
                     )
-                emit_trace_event(
-                    job.id,
-                    "adopted",
-                    worker_id=self.worker_id,
-                    latency_ms=round(latency_ms, 3),
+                # Phase-boundary adoption: a handoff_at stamp marks this
+                # resume as a prefill→decode handoff (drain handoffs don't
+                # carry one). Count it and sample the handoff latency.
+                resume = job.extras().get(RESUME_FIELD)
+                ho_at = (
+                    resume.get("handoff_at")
+                    if isinstance(resume, dict)
+                    else None
                 )
-            try:
-                out = await self.engine.resume(
-                    rid=job.id, snapshot=snapshot, **gen_kw
+                if ho_at is not None:
+                    try:
+                        latency_ms = max(
+                            0.0, (clock.wall() - float(ho_at)) * 1000.0
+                        )
+                    except (TypeError, ValueError):
+                        latency_ms = 0.0
+                    self.jobs_adopted += 1
+                    self._handoff_ms.append(latency_ms)
+                    if trace is not None:
+                        trace_event(
+                            trace, "adopted", latency_ms=round(latency_ms, 3)
+                        )
+                    emit_trace_event(
+                        job.id,
+                        "adopted",
+                        worker_id=self.worker_id,
+                        latency_ms=round(latency_ms, 3),
+                    )
+                try:
+                    out = await self.engine.resume(
+                        rid=job.id, snapshot=snapshot, **gen_kw
+                    )
+                except SnapshotError as exc:
+                    # Valid blob, wrong engine (model signature / KV dtype
+                    # mismatch) — recompute from the prompt instead.
+                    self.logger.warning(
+                        "Job %s snapshot not insertable (%s); re-running "
+                        "from scratch",
+                        job.id,
+                        exc,
+                        extra={"job_id": job.id},
+                    )
+            if out is None:
+                if self.role_active == "prefill":
+                    # Prefill role: run the prompt phase only. The engine
+                    # finishes the request at the boundary with a
+                    # prompt-KV snapshot (finish_reason="prefill_done");
+                    # the PrefillDone raise below routes it to the decode
+                    # pool. Passed only for this role so unified call
+                    # sites (and engine stubs) keep their existing
+                    # signature.
+                    gen_kw["prefill_only"] = True
+                if job.messages is not None:
+                    out = await self.engine.generate(
+                        rid=job.id,
+                        messages=job.messages,
+                        params=params,
+                        **gen_kw,
+                    )
+                elif job.chat_mode:
+                    messages = [
+                        {"role": "user", "content": job.get_formatted_prompt()}
+                    ]
+                    out = await self.engine.generate(
+                        rid=job.id,
+                        messages=messages,
+                        params=params,
+                        **gen_kw,
+                    )
+                else:
+                    out = await self.engine.generate(
+                        rid=job.id,
+                        prompt=job.get_formatted_prompt(),
+                        params=params,
+                        **gen_kw,
+                    )
+            # Project any fault-recovery events the engine recorded for
+            # this request (device_fault → engine_rebuilt) onto its trace,
+            # whether it completed after a restore or comes back as a
+            # handoff below.
+            self._trace_fault_events(job.id)
+            if getattr(out, "finish_reason", None) == "deadline_exceeded":
+                # The engine's sweep expired the request between decode
+                # blocks: terminal dead-letter, not a (truncated) result.
+                raise DeadlineExceeded(job.id)
+            if isinstance(out, HandoffOutput):
+                # This worker is draining: surface the partial progress to
+                # the base loop, which republishes the job as resumable.
+                raise JobHandoff(
+                    snapshot_to_b64(out.snapshot)
+                    if out.snapshot is not None
+                    else None,
+                    out.emitted,
                 )
-            except SnapshotError as exc:
-                # Valid blob, wrong engine (model signature / KV dtype
-                # mismatch) — recompute from the prompt instead.
-                self.logger.warning(
-                    "Job %s snapshot not insertable (%s); re-running from "
-                    "scratch",
-                    job.id,
-                    exc,
-                    extra={"job_id": job.id},
-                )
-        if out is None:
-            if self.role_active == "prefill":
-                # Prefill role: run the prompt phase only. The engine
-                # finishes the request at the boundary with a prompt-KV
-                # snapshot (finish_reason="prefill_done"); the PrefillDone
-                # raise below routes it to the decode pool. Passed only
-                # for this role so unified call sites (and engine stubs)
-                # keep their existing signature.
-                gen_kw["prefill_only"] = True
-            if job.messages is not None:
-                out = await self.engine.generate(
-                    rid=job.id, messages=job.messages, params=params, **gen_kw
-                )
-            elif job.chat_mode:
-                messages = [
-                    {"role": "user", "content": job.get_formatted_prompt()}
-                ]
-                out = await self.engine.generate(
-                    rid=job.id, messages=messages, params=params, **gen_kw
-                )
-            else:
-                out = await self.engine.generate(
-                    rid=job.id,
-                    prompt=job.get_formatted_prompt(),
-                    params=params,
-                    **gen_kw,
-                )
-        # Project any fault-recovery events the engine recorded for this
-        # request (device_fault → engine_rebuilt) onto its trace, whether
-        # it completed after a restore or comes back as a handoff below.
-        self._trace_fault_events(job.id)
-        if getattr(out, "finish_reason", None) == "deadline_exceeded":
-            # The engine's sweep expired the request between decode
-            # blocks: terminal dead-letter, not a (truncated) result.
-            raise DeadlineExceeded(job.id)
-        if isinstance(out, HandoffOutput):
-            # This worker is draining: surface the partial progress to the
-            # base loop, which republishes the job as resumable.
-            raise JobHandoff(
-                snapshot_to_b64(out.snapshot)
-                if out.snapshot is not None
-                else None,
-                out.emitted,
-            )
-        if getattr(out, "finish_reason", None) == "prefill_done":
-            snap = getattr(out, "snapshot", None)
-            if snap is None:
-                # Must never happen (the engine snapshots before it
-                # finishes the sequence); RuntimeError — not ValueError —
-                # so the base loop requeues instead of dropping the job.
-                raise RuntimeError(
-                    f"prefill_done for job {job.id} carried no snapshot"
-                )
-            raise PrefillDone(snapshot_to_b64(snap))
+            if getattr(out, "finish_reason", None) == "prefill_done":
+                snap = getattr(out, "snapshot", None)
+                if snap is None:
+                    # Must never happen (the engine snapshots before it
+                    # finishes the sequence); RuntimeError — not
+                    # ValueError — so the base loop requeues instead of
+                    # dropping the job.
+                    raise RuntimeError(
+                        f"prefill_done for job {job.id} carried no snapshot"
+                    )
+                raise PrefillDone(snapshot_to_b64(snap))
+        finally:
+            if streaming:
+                await self._stream_finish(job, out)
         self._usage[job.id] = {
             "prompt_tokens": out.prompt_tokens,
             "completion_tokens": out.completion_tokens,
         }
+        finish = getattr(out, "finish_reason", None)
+        if finish is not None:
+            self._finish_reasons[job.id] = finish
         if self.config.result_digest:
             self._result_tokens[job.id] = list(out.token_ids)
         self._trace_engine_timing(job.id, out)
@@ -1157,6 +1393,9 @@ class TPUWorker(BaseWorker):
         usage = self._usage.pop(job.id, None)
         if usage is not None:
             result.usage = usage
+        finish = self._finish_reasons.pop(job.id, None)
+        if finish is not None:
+            result.finish_reason = finish
         tokens = self._result_tokens.pop(job.id, None)
         if tokens is not None:
             result.token_ids = tokens
@@ -1192,6 +1431,11 @@ class TPUWorker(BaseWorker):
             stats["engine_rebuilds"] = self.engine.engine_rebuilds
             if self.engine.last_fault_reason:
                 stats["last_fault_reason"] = self.engine.last_fault_reason
+        # Online-serving counters, superset-only (appear once they move).
+        if self.stream_frames_published:
+            stats["stream_frames_published"] = self.stream_frames_published
+        if self.jobs_cancelled:
+            stats["jobs_cancelled"] = self.jobs_cancelled
         if self.config.prefix_affinity:
             stats = {
                 **stats,

@@ -195,7 +195,9 @@ class TestLastHardwareMetricLine:
 class TestTrimPlan:
     """bench.trim_plan: budget-aware phase trimming against the seconds
     left on LLMQ_BENCH_DEADLINE. The proven bf16 headline is reserved
-    first and never dropped; speculative phases drop the pp rung first
+    first and never dropped; speculative phases drop the serve rung
+    first (diagnostic only — it prices the latency plane, never the
+    headline), then the pp rung
     (diagnostic only — the model fits one host here), then the disagg
     rung (diagnostic, most builds per datapoint), then the prefix
     rung (also diagnostic — it never replaces the headline), then the
@@ -206,87 +208,96 @@ class TestTrimPlan:
     KW = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
               spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0,
               int4_s=1500.0, mixed_s=300.0, prefix_s=240.0,
-              disagg_s=420.0, pp_s=300.0)
+              disagg_s=420.0, pp_s=300.0, serve_s=240.0)
     ALL = {"quant": True, "kernel_ab": True, "full_ladder": True,
            "spec_ladder": True, "tp_overlap": True, "int4_ladder": True,
            "mixed_step": True, "prefix_rung": True, "disagg_rung": True,
-           "pp_rung": True}
+           "pp_rung": True, "serve_rung": True}
     # Remaining-seconds sweep covering every drop boundary (phase sums
     # + the 300 s proven floor): see the per-test comments.
     SWEEP = (350.0, 720.0, 800.0, 1440.0, 1500.0, 1740.0, 1900.0,
              2100.0, 2500.0, 3600.0, 3700.0, 3840.0, 4000.0, 5340.0,
-             5400.0, 5580.0, 5820.0, 6000.0, 6300.0, 6600.0)
+             5400.0, 5580.0, 5820.0, 6000.0, 6300.0, 6540.0, 6600.0)
 
     def test_no_deadline_runs_everything(self):
         assert bench.trim_plan(None, **self.KW) == self.ALL
 
     def test_roomy_budget_runs_everything(self):
-        # 300 (proven) + 300 (pp) + 420 (disagg) + 240 (prefix)
-        # + 1500 (int4) + 240 + 1500 + 360 + 300 + 720 + 420 = 6300 fits.
-        assert bench.trim_plan(6300.0, **self.KW) == self.ALL
+        # 300 (proven) + 240 (serve) + 300 (pp) + 420 (disagg)
+        # + 240 (prefix) + 1500 (int4) + 240 + 1500 + 360 + 300 + 720
+        # + 420 = 6540 fits.
+        assert bench.trim_plan(6540.0, **self.KW) == self.ALL
 
-    def test_pp_rung_dropped_first(self):
-        # Everything but the pp rung fits (5700 after the floor),
-        # + 300 does not.
+    def test_serve_rung_dropped_first(self):
+        # Everything but the serve rung fits (6000 after the floor),
+        # + 240 does not.
+        plan = bench.trim_plan(6300.0, **self.KW)
+        assert plan == {**self.ALL, "serve_rung": False}
+
+    def test_pp_rung_dropped_second(self):
+        # After shedding the serve rung, everything but the pp rung
+        # fits (5700 after the floor), + 300 does not.
         plan = bench.trim_plan(6000.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False}
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False}
 
-    def test_disagg_rung_dropped_second(self):
-        # After shedding the pp rung, everything but the disagg rung
-        # fits (5280 after the floor), + 420 does not.
+    def test_disagg_rung_dropped_third(self):
+        # After shedding the serve + pp rungs, everything but the
+        # disagg rung fits (5280 after the floor), + 420 does not.
         plan = bench.trim_plan(5820.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False}
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False}
 
-    def test_prefix_rung_dropped_third(self):
-        # After shedding the pp + disagg rungs, everything but the
-        # prefix rung fits (5040 after the floor), + 240 does not.
+    def test_prefix_rung_dropped_fourth(self):
+        # After shedding the serve + pp + disagg rungs, everything but
+        # the prefix rung fits (5040 after the floor), + 240 does not.
         plan = bench.trim_plan(5400.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False,
                         "disagg_rung": False, "prefix_rung": False}
 
-    def test_int4_dropped_fourth(self):
+    def test_int4_dropped_fifth(self):
         # Everything through the ladder fits (3540 after the floor),
         # + 1500 (int4) does not.
         plan = bench.trim_plan(4000.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False,
                         "prefix_rung": False, "int4_ladder": False}
 
-    def test_tp_overlap_dropped_fifth(self):
+    def test_tp_overlap_dropped_sixth(self):
         plan = bench.trim_plan(3700.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False,
                         "prefix_rung": False, "int4_ladder": False,
                         "tp_overlap": False}
 
-    def test_quant_dropped_sixth(self):
+    def test_quant_dropped_seventh(self):
         # 300 (proven) + 420 + 720 + 360 + 300 fits, + 1500 does not.
         plan = bench.trim_plan(2500.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False,
                         "prefix_rung": False, "int4_ladder": False,
                         "tp_overlap": False, "quant": False}
 
-    def test_spec_rung_dropped_seventh(self):
+    def test_spec_rung_dropped_eighth(self):
         # 300 + 420 + 720 + 300 fits, + 360 (spec rung) does not.
         plan = bench.trim_plan(1900.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False,
                         "prefix_rung": False, "int4_ladder": False,
                         "tp_overlap": False, "quant": False,
                         "spec_ladder": False}
 
-    def test_mixed_rung_dropped_eighth(self):
+    def test_mixed_rung_dropped_ninth(self):
         # 300 + 420 + 720 fits, + 300 (mixed rung) does not.
         plan = bench.trim_plan(1500.0, **self.KW)
-        assert plan == {**self.ALL, "pp_rung": False,
-                        "disagg_rung": False,
+        assert plan == {**self.ALL, "serve_rung": False,
+                        "pp_rung": False, "disagg_rung": False,
                         "prefix_rung": False, "int4_ladder": False,
                         "tp_overlap": False, "quant": False,
                         "spec_ladder": False, "mixed_step": False}
 
-    def test_ladder_dropped_ninth(self):
+    def test_ladder_dropped_tenth(self):
         # 300 + 420 fits, + 720 does not.
         plan = bench.trim_plan(800.0, **self.KW)
         assert plan == {k: False for k in self.ALL} | {"kernel_ab": True}
@@ -297,11 +308,12 @@ class TestTrimPlan:
 
     def test_proven_floor_reserved_before_phases(self):
         # Exactly the full phase sum of budget but NO room for the
-        # proven floor on top -> the floor wins, the pp rung goes.
-        plan = bench.trim_plan(6000.0, **self.KW)
-        assert plan["pp_rung"] is False
+        # proven floor on top -> the floor wins, the serve rung goes.
+        plan = bench.trim_plan(6240.0, **self.KW)
+        assert plan["serve_rung"] is False
 
     def test_boundaries_inclusive(self):
+        assert bench.trim_plan(6540.0, **self.KW)["serve_rung"] is True
         assert bench.trim_plan(6300.0, **self.KW)["pp_rung"] is True
         assert bench.trim_plan(6000.0, **self.KW)["disagg_rung"] is True
         assert bench.trim_plan(5580.0, **self.KW)["prefix_rung"] is True
@@ -316,7 +328,8 @@ class TestTrimPlan:
     def test_drop_order_invariants(self):
         # A more speculative phase never survives a less speculative
         # one's drop, at any budget.
-        order = ("pp_rung", "disagg_rung", "prefix_rung", "int4_ladder",
+        order = ("serve_rung", "pp_rung", "disagg_rung", "prefix_rung",
+                 "int4_ladder",
                  "tp_overlap", "quant", "spec_ladder", "mixed_step",
                  "full_ladder", "kernel_ab")
         for remaining in self.SWEEP:
@@ -327,8 +340,9 @@ class TestTrimPlan:
                 )
 
     def test_legacy_defaults_omit_new_rungs_free(self):
-        # Callers that never pass int4_s/mixed_s/prefix_s/disagg_s/pp_s
-        # get them at zero cost: the keys exist but never consume budget.
+        # Callers that never pass int4_s/mixed_s/prefix_s/disagg_s/
+        # pp_s/serve_s get them at zero cost: the keys exist but never
+        # consume budget.
         kw = dict(quant_s=1500.0, ab_s=420.0, ladder_extra_s=720.0,
                   spec_s=360.0, tp_overlap_s=240.0, proven_s=300.0)
         plan = bench.trim_plan(3540.0, **kw)
@@ -336,3 +350,4 @@ class TestTrimPlan:
         assert plan["prefix_rung"] is True
         assert plan["disagg_rung"] is True
         assert plan["pp_rung"] is True
+        assert plan["serve_rung"] is True

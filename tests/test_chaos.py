@@ -475,6 +475,107 @@ class TestChaosKillResume:
             assert names.count("claimed") == 2, names
 
 
+class _MidStreamCrashWorker(DummyWorker):
+    """First attempt: publish two stream frames, then die before the
+    result — the kill-worker-mid-stream window. The redelivered attempt
+    streams normally (from offset 0, as a resumed-on-peer worker would)."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.crashed = False
+
+    async def _stream_output(self, job, output):
+        from llmq_tpu.broker.manager import stream_queue_name
+
+        if self.crashed:
+            await super()._stream_output(job, output)
+            return
+        self.crashed = True
+        sq = stream_queue_name(self.queue, job.id)
+        await self.broker.broker.declare_queue(
+            sq, ttl_ms=60_000, max_redeliveries=1_000_000_000
+        )
+        for off, chunk in ((0, "echo "), (5, "stream ")):
+            await self.broker.broker.publish(
+                sq,
+                json.dumps(
+                    {
+                        "id": job.id,
+                        "text_offset": off,
+                        "text": chunk,
+                        "worker_id": self.worker_id,
+                    }
+                ).encode("utf-8"),
+                message_id=f"{job.id}.{off}.crash",
+            )
+        raise RuntimeError("worker killed mid-stream")
+
+
+class TestStreamKillResume:
+    async def test_kill_worker_mid_stream_resumes_dedup(self, mem_ns):
+        """A worker dies after streaming two frames of an SSE request.
+        The redelivered job re-streams from offset 0; the gateway's
+        high-water mark dedups the overlap, so the client sees every
+        byte exactly once, a clean stop finish, and exactly one result
+        settles the job."""
+        import http.client
+
+        from llmq_tpu.gateway import ServingGateway
+
+        cfg = Config(broker_url=f"memory://{mem_ns}", max_redeliveries=10)
+        gw = ServingGateway("sq", config=cfg, port=0, request_timeout_s=60)
+        await gw.astart()
+        worker = _MidStreamCrashWorker("sq", delay=0, config=cfg, concurrency=1)
+        wtask = asyncio.ensure_future(worker.run())
+
+        def collect_sse():
+            conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=60)
+            conn.request(
+                "POST",
+                "/v1/completions",
+                json.dumps({"prompt": "stream resume check", "stream": True}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            events, buf = [], b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    ev, buf = buf.split(b"\n\n", 1)
+                    if ev.startswith(b"data: "):
+                        events.append(ev[6:].decode())
+            conn.close()
+            return events
+
+        try:
+            events = await asyncio.to_thread(collect_sse)
+            assert events[-1] == "[DONE]"
+            text = "".join(
+                json.loads(e)["choices"][0].get("text", "")
+                for e in events[:-1]
+            )
+            # Exactly once despite the offset-0 re-stream: no doubled
+            # "echo stream " prefix, nothing missing.
+            assert text == "echo stream resume check"
+            final = json.loads(events[-2])
+            assert final["choices"][0]["finish_reason"] == "stop"
+            assert worker.crashed and worker.jobs_failed == 1
+            assert worker.jobs_processed == 1
+            # Exactly one result: it settled the request (no orphans),
+            # and nothing else waits on the results queue.
+            assert gw.orphan_results == 0
+            async with BrokerManager(cfg) as mgr:
+                stats = await mgr.get_queue_stats("sq.results")
+                assert stats.message_count == 0
+        finally:
+            worker.request_shutdown()
+            await asyncio.wait_for(wtask, timeout=30.0)
+            await gw.astop()
+
+
 @pytest.mark.slow
 class TestDisaggKillWindows:
     """The two disaggregation-specific crash windows: a prefill worker

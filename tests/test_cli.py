@@ -3,6 +3,8 @@ SURVEY.md §4 notes the gap; we cover the surface)."""
 
 import json
 
+import pytest
+
 from click.testing import CliRunner
 
 from llmq_tpu.cli.main import cli
@@ -539,3 +541,129 @@ async def test_errors_view_shows_failure_reason(mem_url, monkeypatch, capsys):
     out = capsys.readouterr().out
     assert "late-1" in out
     assert "deadline_exceeded" in out
+
+
+def test_submit_priority_option(mem_url, monkeypatch):
+    """`submit --priority interactive` stamps the SLO class on every job
+    (row-level priority fields win); bad classes are rejected by click."""
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    runner = CliRunner()
+    jobs = "\n".join(
+        json.dumps({"id": f"s{i}", "prompt": "p"}) for i in range(2)
+    )
+    result = runner.invoke(
+        cli,
+        ["submit", "prq", "-", "--priority", "interactive"],
+        input=jobs + "\n",
+    )
+    assert result.exit_code == 0, result.output
+
+    result = runner.invoke(
+        cli,
+        ["submit", "prq", "-", "--priority", "urgent"],
+        input=jobs + "\n",
+    )
+    assert result.exit_code != 0
+    assert "priority" in result.output
+
+
+async def test_submit_priority_stamped_on_rows(mem_url, tmp_path, monkeypatch):
+    """The CLI class lands on priority-less rows only — a row that set
+    its own class keeps it — and stamped jobs ride the fast lane."""
+    from llmq_tpu.broker.manager import BrokerManager, interactive_queue_name
+    from llmq_tpu.cli.submit import JobSubmitter
+    from llmq_tpu.core.config import Config
+
+    monkeypatch.setenv("LLMQ_BROKER_URL", mem_url)
+    src = tmp_path / "jobs.jsonl"
+    src.write_text(
+        '{"id": "a", "prompt": "p"}\n'
+        '{"id": "b", "prompt": "p", "priority": "batch"}\n'
+    )
+    sub = JobSubmitter("prq", str(src), priority="interactive")
+    assert await sub.run() == 2
+    async with BrokerManager(Config(broker_url=mem_url)) as mgr:
+        lane = await mgr.broker.get(interactive_queue_name("prq"))
+        assert lane is not None
+        assert json.loads(lane.body)["priority"] == "interactive"
+        await lane.ack()
+        main = await mgr.broker.get("prq")
+        assert main is not None
+        assert json.loads(main.body)["priority"] == "batch"
+        await main.ack()
+
+    with pytest.raises(ValueError, match="priority"):
+        JobSubmitter("q", "-", priority="urgent")
+
+
+def test_monitor_top_priority_columns_thousand_worker_fleet():
+    """SLO-serving fleet at scale (1,000 heartbeats): workers reporting
+    per-class latency stats grow the interactive ttft/itl column, and
+    the header gains fast-lane depth + fleet preemption count.
+    Superset-only: a priority-free fleet renders none of it."""
+    from rich.console import Console
+
+    from llmq_tpu.cli.monitor import _render_top
+    from llmq_tpu.core.models import QueueStats, WorkerHealth, utcnow
+
+    now = utcnow()
+    beats = {}
+    for i in range(1000):
+        wid = f"w-{i:04d}"
+        engine_stats = {
+            "tokens_per_sec": 1.0,
+            "batch_occupancy": i / 1000.0,
+        }
+        # Only part of the fleet has seen interactive traffic (including
+        # busy rows that render); the column still appears fleet-wide.
+        if i >= 900:
+            engine_stats["ttft_p95_ms_interactive"] = 55.0
+            engine_stats["itl_p95_ms_interactive"] = 5.0
+            engine_stats["priority_preemptions"] = 2
+        beats[wid] = WorkerHealth(
+            worker_id=wid,
+            status="running",
+            last_seen=now,
+            jobs_processed=i,
+            engine_stats=engine_stats,
+        )
+    stats = QueueStats(queue_name="bigq", message_count_ready=5)
+    frame = _render_top("bigq", beats, stats, top=40, interactive_depth=3)
+    console = Console(width=240, record=True)
+    console.print(frame)
+    out = console.export_text()
+
+    assert "interactive ready 3" in out
+    assert "preempts 200" in out  # fleet-wide sum, not just top rows
+    assert "int ttft/itl" in out  # column header (may wrap)
+    assert "55/5" in out
+    assert "1000 fresh worker(s)" in out
+
+    # Superset-only: a fleet with no interactive traffic and no fast
+    # lane renders no priority surface at all.
+    plain = {
+        wid: WorkerHealth(
+            worker_id=wid,
+            status="running",
+            last_seen=now,
+            jobs_processed=1,
+            engine_stats={"tokens_per_sec": 1.0},
+        )
+        for wid in ("u-0", "u-1")
+    }
+    plain_frame = _render_top("bigq", plain, stats, top=40)
+    console = Console(width=240, record=True)
+    console.print(plain_frame)
+    plain_out = console.export_text()
+    assert "interactive ready" not in plain_out
+    assert "preempts" not in plain_out
+    assert "int ttft/itl" not in plain_out
+
+
+def test_serve_cli_exposes_options():
+    """`llmq-tpu serve` is registered with host/port/priority knobs."""
+    result = CliRunner().invoke(cli, ["serve", "--help"])
+    assert result.exit_code == 0
+    assert "--port" in result.output
+    assert "--priority" in result.output
+    assert "OpenAI" in result.output or "gateway" in result.output.lower()

@@ -171,8 +171,8 @@ def test_ladders_parse():
     """Both runbooks yield their full command ladders (a parser that
     silently matches nothing would make every other test vacuous)."""
     names = [name for name, _, _ in all_steps()]
-    assert sum(n.startswith("hardware_session") for n in names) >= 11
-    assert sum(n.startswith("chip_watch") for n in names) >= 18
+    assert sum(n.startswith("hardware_session") for n in names) >= 12
+    assert sum(n.startswith("chip_watch") for n in names) >= 19
     joined = " ".join(names)
     assert "kernel_v123" in joined and "queue_drain_tpu" in joined
     assert "metrics_probe" in joined
@@ -183,6 +183,7 @@ def test_ladders_parse():
     assert "shardcheck_probe" in joined
     assert "disagg_probe" in joined
     assert "pp_probe" in joined
+    assert "serve_probe" in joined
 
 
 def test_referenced_files_exist():

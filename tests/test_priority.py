@@ -1,0 +1,276 @@
+"""SLO priority classes end to end on CPU: the Job extra, fast-lane
+routing, priority-aware engine scheduling with greedy token parity,
+cancellation, streaming token callbacks, and the dummy worker's stream
+frames.
+
+The engine legs reuse one tiny model (module-level params) like
+test_engine.py; everything broker-side runs on the in-process memory
+core.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llmq_tpu.broker.manager import (
+    BrokerManager,
+    interactive_queue_name,
+    stream_queue_name,
+)
+from llmq_tpu.core.config import Config
+from llmq_tpu.core.models import JOB_PRIORITIES, Job
+from llmq_tpu.engine.engine import AsyncEngine, EngineConfig, EngineCore
+from llmq_tpu.engine.sampling import SamplingParams
+from llmq_tpu.engine.tokenizer import ByteTokenizer
+from llmq_tpu.models.config import ModelConfig
+from llmq_tpu.models.transformer import init_params
+from llmq_tpu.parallel import make_mesh
+from llmq_tpu.workers.dummy import DummyWorker
+
+CFG = ModelConfig.tiny(vocab_size=304)
+PARAMS = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def make_core(**overrides) -> EngineCore:
+    defaults = dict(
+        max_num_seqs=4,
+        max_model_len=96,
+        page_size=8,
+        num_pages=64,
+        kv_dtype=jnp.float32,
+        min_prefill_bucket=16,
+    )
+    defaults.update(overrides)
+    return EngineCore(
+        CFG, PARAMS, ByteTokenizer(), mesh=make_mesh(tensor_parallel=1),
+        engine_config=EngineConfig(**defaults),
+    )
+
+
+def greedy(max_tokens=8):
+    return SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True
+    )
+
+
+class TestJobPriority:
+    def test_priority_rides_extras_and_validates(self):
+        job = Job(id="j", prompt="p", priority="interactive")
+        assert job.priority_class == "interactive"
+        assert json.loads(job.model_dump_json())["priority"] == "interactive"
+        assert Job(id="j", prompt="p").priority_class == "batch"
+        with pytest.raises(ValueError, match="priority"):
+            Job(id="j", prompt="p", priority="urgent")
+
+    def test_plain_job_payload_has_no_priority_key(self):
+        """Superset-only: a job that never set a class publishes the
+        exact pre-priority payload."""
+        payload = json.loads(Job(id="j", prompt="p").model_dump_json())
+        assert "priority" not in payload
+        assert JOB_PRIORITIES == ("interactive", "batch")
+
+
+class TestFastLaneRouting:
+    async def test_interactive_routes_to_fast_lane(self, mem_url):
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job("q", Job(id="b", prompt="p"))
+            await mgr.publish_job(
+                "q", Job(id="i", prompt="p", priority="interactive")
+            )
+            assert mgr.interactive_routed == 1
+            lane = await mgr.broker.get(interactive_queue_name("q"))
+            assert lane is not None and json.loads(lane.body)["id"] == "i"
+            await lane.ack()
+            main = await mgr.broker.get("q")
+            assert main is not None and json.loads(main.body)["id"] == "b"
+            await main.ack()
+
+    async def test_fast_lane_gated_by_config(self, mem_url):
+        cfg = Config(broker_url=mem_url, priority_classes=False)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job(
+                "q", Job(id="i", prompt="p", priority="interactive")
+            )
+            assert mgr.interactive_routed == 0
+            msg = await mgr.broker.get("q")
+            assert msg is not None and json.loads(msg.body)["id"] == "i"
+            await msg.ack()
+
+    async def test_workers_drain_fast_lane_first(self, mem_url):
+        """A busy backlog doesn't starve the interactive class: the
+        worker claims from <q>.interactive ahead of the shared queue."""
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            for i in range(6):
+                await mgr.publish_job("q", Job(id=f"b{i}", prompt="p"))
+            await mgr.publish_job(
+                "q", Job(id="vip", prompt="p", priority="interactive")
+            )
+            worker = DummyWorker("q", delay=0, config=cfg, concurrency=1)
+            order = []
+            orig = worker._process_job
+
+            async def spy(job):
+                order.append(job.id)
+                return await orig(job)
+
+            worker._process_job = spy
+            task = asyncio.ensure_future(worker.run())
+            deadline = asyncio.get_running_loop().time() + 10
+            while len(order) < 7:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            worker.request_shutdown()
+            await asyncio.wait_for(task, timeout=15)
+            assert order[0] == "vip", order
+
+
+class TestEnginePriority:
+    def _co_scheduled(self, priority_on):
+        """4 slots, 6 batch requests, 2 interactive injected mid-decode."""
+        core = make_core()
+        for i in range(6):
+            core.add_request(
+                f"b{i}", prompt=f"batch prompt number {i} padded out",
+                params=greedy(16),
+            )
+        outs, steps, added = {}, 0, 0
+        while core.has_work or added < 2:
+            if steps >= 3 and added < 2:
+                core.add_request(
+                    f"i{added}", prompt=f"interactive {added}",
+                    params=greedy(6),
+                    priority="interactive" if priority_on else "batch",
+                )
+                added += 1
+            for out in core.step():
+                outs[out.rid] = out
+            steps += 1
+        return outs, core.stats()
+
+    def test_preemption_preserves_greedy_tokens(self):
+        golden, base_stats = self._co_scheduled(priority_on=False)
+        assert "priority_preemptions" not in base_stats  # superset-only
+        prio, stats = self._co_scheduled(priority_on=True)
+        assert set(golden) == set(prio)
+        for rid in golden:
+            assert golden[rid].token_ids == prio[rid].token_ids, rid
+        assert stats["priority_preemptions"] > 0
+        assert stats["finished_interactive"] == 2
+        assert stats["finished_batch"] == 6
+        assert stats["tokens_interactive"] == 12
+        assert "ttft_p95_ms_interactive" in stats
+
+    def test_priority_disabled_ignores_class(self):
+        core = make_core(priority_classes=False)
+        core.add_request(
+            "i", prompt="hello", params=greedy(4), priority="interactive"
+        )
+        while core.has_work:
+            for out in core.step():
+                assert out.finish_reason == "length"
+        assert "priority_preemptions" not in core.stats()
+
+    def test_cancel_frees_pages_mid_decode(self):
+        core = make_core()
+        avail = core.scheduler.allocator.available
+        core.add_request("c", prompt="cancel me please", params=greedy(48))
+        for _ in range(3):
+            core.step()
+        core.cancel_request("c")
+        outs = {}
+        while core.has_work:
+            for out in core.step():
+                outs[out.rid] = out
+        assert outs["c"].finish_reason == "cancelled"
+        assert core.scheduler.allocator.available == avail
+        assert core.stats()["cancellations"] == 1
+
+    def test_cancel_waiting_request_never_runs(self):
+        core = make_core()
+        core.add_request("w", prompt="waiting", params=greedy(4))
+        core.cancel_request("w")
+        outs = {}
+        while core.has_work:
+            for out in core.step():
+                outs[out.rid] = out
+        assert outs["w"].finish_reason == "cancelled"
+        assert outs["w"].completion_tokens == 0
+
+
+class TestAsyncEnginePriority:
+    async def test_token_callbacks_stream_every_token(self):
+        engine = AsyncEngine(make_core())
+        try:
+            seen = []
+            engine.set_token_callback("s", lambda tok, n: seen.append((tok, n)))
+            out = await engine.generate(
+                rid="s", prompt="stream tokens", params=greedy(6),
+                priority="interactive",
+            )
+            engine.clear_token_callback("s")
+            assert out.completion_tokens == 6
+            assert [t for t, _ in seen] == list(out.token_ids)
+            assert [n for _, n in seen] == [1, 2, 3, 4, 5, 6]
+        finally:
+            engine.shutdown()
+
+    async def test_async_cancel_resolves_future(self):
+        engine = AsyncEngine(make_core())
+        try:
+            task = asyncio.ensure_future(
+                engine.generate(rid="c", prompt="long one", params=greedy(64))
+            )
+            await asyncio.sleep(0.2)
+            engine.cancel("c")
+            out = await asyncio.wait_for(task, timeout=30)
+            assert out.finish_reason == "cancelled"
+        finally:
+            engine.shutdown()
+
+
+class TestDummyStreaming:
+    async def test_stream_frames_round_trip(self, mem_url):
+        """Jobs with a truthy ``stream`` extra get offset frames plus a
+        terminal done frame; plain jobs publish none (superset-only)."""
+        cfg = Config(broker_url=mem_url)
+        async with BrokerManager(cfg) as mgr:
+            await mgr.setup_queue_infrastructure("q")
+            await mgr.publish_job(
+                "q", Job(id="s1", prompt="one two", stream=True)
+            )
+            await mgr.publish_job("q", Job(id="p1", prompt="plain"))
+            worker = DummyWorker("q", delay=0, config=cfg, concurrency=1)
+            task = asyncio.ensure_future(worker.run())
+            deadline = asyncio.get_running_loop().time() + 10
+            while worker.jobs_processed < 2:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            worker.request_shutdown()
+            await asyncio.wait_for(task, timeout=15)
+
+            frames = []
+            sq = stream_queue_name("q", "s1")
+            while True:
+                msg = await mgr.broker.get(sq)
+                if msg is None:
+                    break
+                frames.append(json.loads(msg.body))
+                await msg.ack()
+            assert frames, "streaming job published no frames"
+            assert frames[-1]["done"] and frames[-1]["finish_reason"] == "stop"
+            text = "".join(f["text"] for f in frames)
+            assert text == "echo one two"
+            for f in frames:
+                assert f["worker_id"] == worker.worker_id
+            offs = [f["text_offset"] for f in frames]
+            assert offs == sorted(offs)
+            # Plain job: no stream queue traffic at all.
+            assert await mgr.broker.get(stream_queue_name("q", "p1")) is None

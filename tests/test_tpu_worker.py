@@ -72,6 +72,11 @@ async def test_tpu_worker_end_to_end(mem_url):
         assert r.duration_ms > 0
         # extra-field passthrough
         assert r.model_dump()["word"].startswith("w")
+        # The engine's terminal finish_reason rides the Result (these
+        # jobs hit max_tokens under ignore_eos → "length"): the gateway's
+        # blocking path reports it, so it must match the stream done
+        # frame, not default to "stop".
+        assert r.model_dump()["finish_reason"] == "length"
 
 
 def test_worker_id_unique_in_process(mem_url):

@@ -57,6 +57,10 @@ run 900 integrity_probe python tools/integrity_probe.py
 #     policy-regression baseline with detune teeth (virtual clock,
 #     host-side only; cheap, stays ahead of the long benches).
 run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
+# 1k. Online-serving plane: gateway SSE round-trip parity, priority
+#     preemption token parity vs a priority-off golden run, and
+#     cancel-frees-pages (engine legs on the real chip).
+run 900 serve_probe python tools/serve_probe.py
 # 1j. Disaggregated prefill/decode plane: KV adoption handshake parity,
 #     snapshot-fallback parity, auto-role switch — the handoff snapshot
 #     is extracted from device-resident KV on the real chip.

@@ -76,6 +76,11 @@ run 900 integrity_probe python tools/integrity_probe.py
 # the policy planes the probes above exercise pinned to their recorded
 # baselines on this image).
 run 900 sim_probe env JAX_PLATFORMS=cpu python tools/sim_probe.py
+# Online-serving plane: gateway SSE round-trip parity over the memory
+# broker, interactive-preempts-batch token parity vs a priority-off
+# golden run, and cancel-frees-pages — the SLO scheduling path the
+# serve bench rung measures (engine legs run on the chip here).
+run 900 serve_probe python tools/serve_probe.py
 # Sharding-analysis plane: AST sweep + lowered-HLO collective-signature
 # diff vs the committed baseline + MoE token-pin detune teeth (runs its
 # jax legs in CPU subprocesses; never touches the accelerator).
